@@ -1,0 +1,29 @@
+"""presto_tpu — a TPU-native distributed SQL query engine.
+
+A ground-up re-design of the reference engine (frankzye/presto, Presto 0.220) for TPU
+hardware: columnar pages as dense JAX arrays, physical operators as jitted XLA/Pallas
+kernels, distributed exchange as ICI-mesh collectives under shard_map, and a Python
+control plane (parser/analyzer/planner/scheduler) where the reference uses latency-
+tolerant Java coordinator code.
+
+Layer map (mirrors SURVEY.md §1):
+  types/block/memory      — data substrate (Page/Block/Type, memory accounting)
+  spi/                    — connector plugin boundary
+  sql/                    — parser, analyzer, logical planner, optimizer, fragmenter
+  ops/                    — physical TPU operators (filter/project, hash agg, join, ...)
+  exec/                   — driver loop, task executor, local planner, scheduler
+  parallel/               — device mesh, partitioning, collective exchange
+  connectors/             — tpch, tpcds, memory, blackhole
+  server/                 — client protocol, REST server, CLI
+"""
+import jax as _jax
+
+# Exact BIGINT/DECIMAL arithmetic needs 64-bit lanes (XLA emulates them on TPU; hot
+# kernels deliberately stay in 32-bit — see ops/).
+_jax.config.update("jax_enable_x64", True)
+
+from .types import (BIGINT, BOOLEAN, DATE, DOUBLE, INTEGER, REAL, SMALLINT,  # noqa: E402,F401
+                    TIMESTAMP, VARCHAR, DecimalType, Type, parse_type)
+from .block import Block, Dictionary, Page, page_from_arrays, page_from_pylists  # noqa: E402,F401
+
+__version__ = "0.1.0"

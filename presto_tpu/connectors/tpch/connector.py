@@ -1,0 +1,190 @@
+"""TPC-H connector: SPI implementation over the deterministic generator.
+
+Analogue of presto-tpch (tpch/TpchConnectorFactory.java:32, TpchMetadata,
+TpchSplitManager.java:45, TpchRecordSet). Schemas are scale factors: `tiny` (0.01),
+`sf1`, `sf10`, `sf100`, ... Splits are contiguous row ranges (order ranges for
+lineitem) so every worker/chip generates its shard locally — the TPU analogue of
+split-at-the-data scheduling (SOURCE_DISTRIBUTION).
+
+Supports pushed-down partitioning on the primary key like the reference's
+TpchNodePartitioningProvider, which lets co-partitioned scans skip the mesh exchange.
+"""
+from __future__ import annotations
+
+import math
+from typing import Dict, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+from ...block import Block, Page
+from ...spi.connector import (ColumnHandle, ColumnMetadata, ColumnStatistics, Connector,
+                              ConnectorFactory, ConnectorMetadata,
+                              ConnectorNodePartitioningProvider, ConnectorPageSource,
+                              ConnectorPageSourceProvider, ConnectorSplitManager,
+                              Constraint, SchemaTableName, Split, TableHandle,
+                              TableMetadata, TableStatistics)
+from ...types import BIGINT
+from . import generator as g
+
+SCHEMAS = {"tiny": 0.01, "sf1": 1.0, "sf10": 10.0, "sf100": 100.0, "sf300": 300.0,
+           "sf1000": 1000.0}
+
+_TABLE_NAMES = ["region", "nation", "supplier", "part", "partsupp", "customer",
+                "orders", "lineitem"]
+
+
+def _columns_of(table: str):
+    if table == "lineitem":
+        return [(n, t, d) for (n, t, d) in g.LINEITEM_COLUMNS]
+    t = g.TPCH_TABLES[table]
+    return [(c.name, c.type, c.dictionary) for c in t.columns]
+
+
+class TpchMetadata(ConnectorMetadata):
+    def __init__(self, connector_id: str):
+        self.connector_id = connector_id
+
+    def list_schemas(self) -> List[str]:
+        return list(SCHEMAS)
+
+    def list_tables(self, schema: Optional[str] = None) -> List[SchemaTableName]:
+        schemas = [schema] if schema else list(SCHEMAS)
+        return [SchemaTableName(s, t) for s in schemas for t in _TABLE_NAMES]
+
+    def get_table_handle(self, name: SchemaTableName) -> Optional[TableHandle]:
+        if name.schema in SCHEMAS and name.table in _TABLE_NAMES:
+            return TableHandle(self.connector_id, name, extra=(SCHEMAS[name.schema],))
+        return None
+
+    def get_table_metadata(self, table: TableHandle) -> TableMetadata:
+        cols = tuple(ColumnMetadata(n, t) for (n, t, _) in _columns_of(table.schema_table.table))
+        return TableMetadata(table.schema_table, cols)
+
+    def get_table_statistics(self, table: TableHandle, constraint: Constraint) -> TableStatistics:
+        name = table.schema_table.table
+        sf = table.extra[0]
+        rows = float(g.table_row_count(name, sf))
+        stats = TableStatistics(row_count=rows)
+        for (cname, ctype, cdict) in _columns_of(name):
+            cs = ColumnStatistics(null_fraction=0.0)
+            if cdict is not None and type(cdict).__name__ == "Dictionary":
+                cs.distinct_count = float(len(cdict))
+            elif cname.endswith(("key",)):
+                cs.distinct_count = rows
+            stats.columns[cname] = cs
+        return stats
+
+
+class TpchSplitManager(ConnectorSplitManager):
+    """Row-range splits; lineitem is split by order range (see generator docstring)."""
+
+    def __init__(self, connector_id: str, splits_per_table: int = 8):
+        self.connector_id = connector_id
+        self.splits_per_table = splits_per_table
+
+    def get_splits(self, table: TableHandle, constraint: Constraint,
+                   desired_splits: int) -> List[Split]:
+        name = table.schema_table.table
+        sf = table.extra[0]
+        if name == "lineitem":
+            units = g.TPCH_TABLES["orders"].row_count(sf)  # split the order keyspace
+        else:
+            units = g.table_row_count(name, sf)
+        n_splits = max(1, min(desired_splits or self.splits_per_table, units))
+        step = math.ceil(units / n_splits)
+        splits = []
+        for b, lo in enumerate(range(0, units, step)):
+            hi = min(lo + step, units)
+            splits.append(Split(self.connector_id, payload=(name, sf, lo, hi), bucket=b))
+        return splits
+
+
+class TpchPageSource(ConnectorPageSource):
+    def __init__(self, split: Split, columns: Sequence[ColumnHandle], page_capacity: int):
+        self.split = split
+        self.columns = list(columns)
+        self.capacity = page_capacity
+        self._bytes = 0
+
+    def __iter__(self) -> Iterator[Page]:
+        name, sf, lo, hi = self.split.payload
+        names = [c.name for c in self.columns]
+        col_info = {n: (t, d) for (n, t, d) in _columns_of(name)}
+        if name == "lineitem":
+            # generate in order-chunks that produce <= capacity rows (max 7 lines/order)
+            order_step = max(1, self.capacity // 7)
+            for olo in range(lo, hi, order_step):
+                ohi = min(olo + order_step, hi)
+                data = g.lineitem_for_orders(olo, ohi, sf, names)
+                yield from self._emit(data, names, col_info)
+        else:
+            for rlo in range(lo, hi, self.capacity):
+                rhi = min(rlo + self.capacity, hi)
+                data = g.generate_rows(name, rlo, rhi, sf, names)
+                yield from self._emit(data, names, col_info)
+
+    def _emit(self, data: Dict[str, np.ndarray], names, col_info) -> Iterator[Page]:
+        n = len(next(iter(data.values()))) if data else 0
+        for plo in range(0, max(n, 1), self.capacity):
+            phi = min(plo + self.capacity, n)
+            blocks = []
+            for cname in names:
+                ctype, cdict = col_info[cname]
+                arr = data[cname][plo:phi] if cname in data else np.zeros(0)
+                arr = np.asarray(arr).astype(ctype.np_dtype)
+                if len(arr) < self.capacity:
+                    arr = np.concatenate(
+                        [arr, np.zeros(self.capacity - len(arr), dtype=arr.dtype)])
+                self._bytes += arr.nbytes
+                blocks.append(Block(ctype, arr, None, cdict))
+            mask = np.arange(self.capacity) < (phi - plo)
+            yield Page(tuple(blocks), mask)
+            if n == 0:
+                break
+
+    def completed_bytes(self) -> int:
+        return self._bytes
+
+
+class TpchPageSourceProvider(ConnectorPageSourceProvider):
+    def create_page_source(self, split: Split, columns: Sequence[ColumnHandle],
+                           page_capacity: int,
+                           constraint: Constraint = Constraint.all()) -> ConnectorPageSource:
+        return TpchPageSource(split, columns, page_capacity)
+
+
+class TpchNodePartitioningProvider(ConnectorNodePartitioningProvider):
+    """Primary-key range bucketing (reference TpchNodePartitioningProvider analogue)."""
+
+    def bucket_count(self, table: TableHandle) -> Optional[int]:
+        return None  # engine chooses; splits already carry bucket ids
+
+
+class TpchConnector(Connector):
+    def __init__(self, connector_id: str, splits_per_table: int = 8):
+        self._metadata = TpchMetadata(connector_id)
+        self._splits = TpchSplitManager(connector_id, splits_per_table)
+        self._sources = TpchPageSourceProvider()
+        self._partitioning = TpchNodePartitioningProvider()
+
+    def metadata(self) -> ConnectorMetadata:
+        return self._metadata
+
+    def split_manager(self) -> ConnectorSplitManager:
+        return self._splits
+
+    def page_source_provider(self) -> ConnectorPageSourceProvider:
+        return self._sources
+
+    def node_partitioning_provider(self) -> ConnectorNodePartitioningProvider:
+        return self._partitioning
+
+
+class TpchConnectorFactory(ConnectorFactory):
+    @property
+    def name(self) -> str:
+        return "tpch"
+
+    def create(self, catalog_name: str, config: Dict[str, str]) -> Connector:
+        return TpchConnector(catalog_name,
+                             int(config.get("tpch.splits-per-node", "8")))

@@ -1,0 +1,135 @@
+"""Driver: the operator-chain pump.
+
+Analogue of operator/Driver.java:347-434 (processInternal — the reference's hottest
+loop) plus DriverYieldSignal. Semantics kept: for each adjacent operator pair, pull a
+page from `current` and push into `next`; propagate finish; honor blocking; yield
+cooperatively after a time quantum so the task executor can time-slice drivers
+(executor/PrioritizedSplitRunner.java:42's 1-second quantum).
+
+TPU difference: a "page hand-off" here is a device-array handle passing between jitted
+kernels — XLA async dispatch means the Python loop runs ahead enqueueing kernels while
+the device crunches; the loop only syncs when an operator must inspect a value
+(e.g. a finished hash build).
+"""
+from __future__ import annotations
+
+import enum
+import time
+from typing import Callable, List, Optional
+
+from ..block import Page
+from ..ops.operator import Operator
+
+
+class DriverYieldSignal:
+    """Cooperative yield (operator/DriverYieldSignal.java)."""
+
+    def __init__(self):
+        self._deadline_ns: Optional[int] = None
+
+    def arm(self, quantum_ns: int) -> None:
+        self._deadline_ns = time.perf_counter_ns() + quantum_ns
+
+    def disarm(self) -> None:
+        self._deadline_ns = None
+
+    def should_yield(self) -> bool:
+        return self._deadline_ns is not None and time.perf_counter_ns() > self._deadline_ns
+
+
+class ProcessState(enum.Enum):
+    MADE_PROGRESS = 1
+    BLOCKED = 2
+    FINISHED = 3
+    YIELDED = 4
+
+
+class Driver:
+    """One pipeline instance: source operator .. sink operator."""
+
+    def __init__(self, operators: List[Operator], yield_signal: Optional[DriverYieldSignal] = None):
+        assert operators, "driver needs at least one operator"
+        self.operators = operators
+        self.yield_signal = yield_signal or DriverYieldSignal()
+        self._closed = False
+
+    def is_finished(self) -> bool:
+        return self._closed or self.operators[-1].is_finished()
+
+    def blocked_on(self) -> Optional[Callable[[], bool]]:
+        for op in self.operators:
+            b = op.is_blocked()
+            if b is not None and not b():
+                return b
+        return None
+
+    def process(self, quantum_ns: int = 200_000_000) -> ProcessState:
+        """Run until blocked/finished/yield. Mirrors Driver.processInternal."""
+        self.yield_signal.arm(quantum_ns)
+        try:
+            while True:
+                if self.is_finished():
+                    return ProcessState.FINISHED
+                b = self.blocked_on()
+                if b is not None:
+                    return ProcessState.BLOCKED
+                if self.yield_signal.should_yield():
+                    return ProcessState.YIELDED
+                progressed = self._process_once()
+                if self.is_finished():
+                    self._close_operators()
+                    return ProcessState.FINISHED
+                if not progressed:
+                    if self.blocked_on() is not None:
+                        return ProcessState.BLOCKED
+                    # no operator moved and none blocked: pipeline is draining finishes
+                    self._propagate_finish()
+        finally:
+            self.yield_signal.disarm()
+
+    def _process_once(self) -> bool:
+        """One sweep over adjacent pairs (Driver.java:379-385)."""
+        ops = self.operators
+        progressed = False
+        for i in range(len(ops) - 1):
+            cur, nxt = ops[i], ops[i + 1]
+            if cur.is_finished() and not nxt.is_finished() and nxt.needs_input():
+                nxt.finish()
+                progressed = True
+                continue
+            if nxt.needs_input() and not cur.is_finished() and cur.is_blocked() is None \
+                    and nxt.is_blocked() is None:
+                page = cur.get_output()
+                if page is not None:
+                    nxt.add_input(page)
+                    progressed = True
+        # drain the sink (last operator) so buffered output moves out
+        last = ops[-1]
+        if not last.is_finished() and last.is_blocked() is None:
+            out = last.get_output()
+            if out is not None:
+                progressed = True
+        return progressed
+
+    def _propagate_finish(self) -> None:
+        for i in range(len(self.operators) - 1):
+            cur, nxt = self.operators[i], self.operators[i + 1]
+            if cur.is_finished() and not nxt.is_finished():
+                nxt.finish()
+
+    def _close_operators(self) -> None:
+        if not self._closed:
+            for op in self.operators:
+                op.close()
+            self._closed = True
+
+    def run_to_completion(self, poll_sleep_s: float = 0.001) -> None:
+        """Convenience for tests/benchmarks: drive until FINISHED."""
+        while True:
+            state = self.process()
+            if state == ProcessState.FINISHED:
+                return
+            if state == ProcessState.BLOCKED:
+                b = self.blocked_on()
+                while b is not None and not b():
+                    time.sleep(poll_sleep_s)

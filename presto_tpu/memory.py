@@ -1,0 +1,191 @@
+"""Hierarchical memory accounting and pools.
+
+Analogue of presto-memory-context (context/AggregatedMemoryContext.java, 669 LoC) and
+presto-main memory/MemoryPool.java:43 + memory/ClusterMemoryManager.java:92.
+
+On TPU the scarce resource is HBM, and XLA owns the allocator — so unlike the JVM
+reference, accounting here is *advisory metadata driving scheduling decisions*
+(admission, spill-to-host triggers, OOM-kill policies), not an allocator. The shape is
+kept: operator-local contexts aggregate into task/query contexts which draw from a
+per-chip pool (GENERAL/RESERVED), and a revocation scheduler asks operators to release
+revocable bytes (execution/MemoryRevokingScheduler.java:46) by spilling device state to
+host RAM (the disk-spill analogue).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, List, Optional
+
+
+class ExceededMemoryLimitException(RuntimeError):
+    def __init__(self, what: str, limit: int):
+        super().__init__(f"Query exceeded {what} memory limit of {limit} bytes")
+
+
+class LocalMemoryContext:
+    """Leaf context owned by one operator (context/SimpleLocalMemoryContext analogue)."""
+
+    def __init__(self, parent: "AggregatedMemoryContext", tag: str = ""):
+        self._parent = parent
+        self._bytes = 0
+        self.tag = tag
+
+    def set_bytes(self, new_bytes: int) -> None:
+        delta = new_bytes - self._bytes
+        if delta:
+            self._parent._update(delta)
+            self._bytes = new_bytes
+
+    def add_bytes(self, delta: int) -> None:
+        self.set_bytes(self._bytes + delta)
+
+    def get_bytes(self) -> int:
+        return self._bytes
+
+    def close(self) -> None:
+        self.set_bytes(0)
+
+
+class AggregatedMemoryContext:
+    """Interior node aggregating children (context/AggregatedMemoryContext.java)."""
+
+    def __init__(self, parent: Optional["AggregatedMemoryContext"] = None,
+                 reservation_handler: Optional[Callable[[int, int], None]] = None):
+        self._parent = parent
+        self._bytes = 0
+        self._handler = reservation_handler
+        self._lock = threading.Lock()
+
+    def _update(self, delta: int) -> None:
+        with self._lock:
+            self._bytes += delta
+        if self._handler is not None:
+            self._handler(delta, self._bytes)
+        if self._parent is not None:
+            self._parent._update(delta)
+
+    def get_bytes(self) -> int:
+        return self._bytes
+
+    def new_local_memory_context(self, tag: str = "") -> LocalMemoryContext:
+        return LocalMemoryContext(self, tag)
+
+    def new_aggregated_memory_context(self) -> "AggregatedMemoryContext":
+        return AggregatedMemoryContext(self)
+
+
+class MemoryTrackingContext:
+    """Bundle of user/revocable/system contexts carried by each operator context
+    (presto-memory-context context/MemoryTrackingContext.java)."""
+
+    def __init__(self, user: AggregatedMemoryContext, revocable: AggregatedMemoryContext,
+                 system: AggregatedMemoryContext):
+        self.user = user
+        self.revocable = revocable
+        self.system = system
+
+    def fork(self) -> "MemoryTrackingContext":
+        return MemoryTrackingContext(
+            self.user.new_aggregated_memory_context(),
+            self.revocable.new_aggregated_memory_context(),
+            self.system.new_aggregated_memory_context())
+
+    def total_bytes(self) -> int:
+        return self.user.get_bytes() + self.revocable.get_bytes() + self.system.get_bytes()
+
+
+class MemoryPool:
+    """Per-chip (per-worker) pool: GENERAL or RESERVED (memory/MemoryPool.java:43).
+
+    `reserve` blocks nothing (advisory); exceeding the pool marks it over-committed so
+    the revoking scheduler / low-memory killer can act.
+    """
+
+    def __init__(self, pool_id: str, max_bytes: int):
+        self.id = pool_id
+        self.max_bytes = max_bytes
+        self._reserved: Dict[str, int] = {}  # query_id -> bytes
+        self._revocable: Dict[str, int] = {}
+        self._lock = threading.Lock()
+
+    def reserve(self, query_id: str, delta: int, revocable: bool = False) -> None:
+        with self._lock:
+            d = self._revocable if revocable else self._reserved
+            d[query_id] = d.get(query_id, 0) + delta
+            if d[query_id] <= 0:
+                d.pop(query_id)
+
+    def reserved_bytes(self) -> int:
+        return sum(self._reserved.values()) + sum(self._revocable.values())
+
+    def revocable_bytes(self) -> int:
+        return sum(self._revocable.values())
+
+    def free_bytes(self) -> int:
+        return self.max_bytes - self.reserved_bytes()
+
+    def query_bytes(self, query_id: str) -> int:
+        return self._reserved.get(query_id, 0) + self._revocable.get(query_id, 0)
+
+    def largest_query(self) -> Optional[str]:
+        if not self._reserved and not self._revocable:
+            return None
+        totals: Dict[str, int] = dict(self._reserved)
+        for q, b in self._revocable.items():
+            totals[q] = totals.get(q, 0) + b
+        return max(totals, key=totals.get)
+
+
+GENERAL_POOL = "general"
+RESERVED_POOL = "reserved"
+
+
+class QueryContextMemory:
+    """Per-query memory root with a hard user-memory limit
+    (memory/QueryContext.java analogue)."""
+
+    def __init__(self, query_id: str, pool: MemoryPool, max_user_bytes: int):
+        self.query_id = query_id
+        self.pool = pool
+        self.max_user_bytes = max_user_bytes
+        self.memory = MemoryTrackingContext(
+            AggregatedMemoryContext(reservation_handler=self._on_user),
+            AggregatedMemoryContext(reservation_handler=self._on_revocable),
+            AggregatedMemoryContext())
+
+    def _on_user(self, delta: int, total: int) -> None:
+        if total > self.max_user_bytes:
+            raise ExceededMemoryLimitException("per-query user", self.max_user_bytes)
+        self.pool.reserve(self.query_id, delta, revocable=False)
+
+    def _on_revocable(self, delta: int, total: int) -> None:
+        self.pool.reserve(self.query_id, delta, revocable=True)
+
+
+class MemoryRevoker:
+    """Asks operators to spill when the pool is over target
+    (execution/MemoryRevokingScheduler.java:46,168-205)."""
+
+    def __init__(self, pool: MemoryPool, target_fraction: float = 0.9):
+        self.pool = pool
+        self.target_fraction = target_fraction
+        self._revocables: List = []  # objects exposing revocable_bytes()/start_memory_revoke()
+
+    def register(self, op) -> None:
+        self._revocables.append(op)
+
+    def maybe_revoke(self) -> int:
+        """Revoke largest-first until under target; returns bytes requested."""
+        target = int(self.pool.max_bytes * self.target_fraction)
+        over = self.pool.reserved_bytes() - target
+        if over <= 0:
+            return 0
+        requested = 0
+        for op in sorted(self._revocables, key=lambda o: -o.revocable_bytes()):
+            if requested >= over:
+                break
+            b = op.revocable_bytes()
+            if b > 0:
+                op.start_memory_revoke()
+                requested += b
+        return requested

@@ -1,0 +1,211 @@
+"""Aggregate function library.
+
+Analogue of presto-main operator/aggregation/ (87 files: sum/count/avg/min/max/
+approx_distinct/stddev/...) and AccumulatorCompiler.java:80. The reference compiles
+per-function accumulator classes over flat state memory; here each function is a small
+descriptor whose pieces (input transform, segment-combine, final transform) slot into
+the segment-reduce grouping kernels — state is a struct-of-arrays indexed by group id,
+which is exactly what TPU scatter/segment ops want.
+
+Every function must be decomposable as
+    partial:   contribution_j = input_map(x_j)          (per row)
+    combine:   state_g = REDUCE_j-in-g contribution_j    (sum / min / max per column)
+    final:     output_g = final_map(state_g)
+which covers the algebraic aggregates. Non-algebraic ones (approx_percentile) get
+fixed-size sketch states (qdigest/HLL analogues) in later revisions.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..types import (BIGINT, BOOLEAN, DOUBLE, REAL, Type, DecimalType, UNKNOWN,
+                     is_floating, is_string)
+
+# reduce kinds understood by the grouping kernels
+SUM, MIN, MAX = "sum", "min", "max"
+
+_I64_MAX = np.int64(2**63 - 1)
+_I64_MIN = np.int64(-(2**63))
+
+
+@dataclasses.dataclass
+class StateColumn:
+    """One array in the aggregate's state struct."""
+    dtype: np.dtype
+    reduce: str          # SUM | MIN | MAX
+    identity: object     # fill value for empty groups
+
+
+@dataclasses.dataclass
+class AggregateFunction:
+    """Descriptor: how to turn input rows into state contributions and state to output."""
+    name: str
+    output_type: Type
+    state: List[StateColumn]
+    # (input_arrays, valid_mask) -> per-row contribution arrays (one per state column)
+    input_map: Callable
+    # state arrays -> output array
+    final_map: Callable
+    intermediate_types: List[Type] = dataclasses.field(default_factory=list)
+
+
+def _ones_i64(args, mask):
+    shape = jnp.shape(mask)
+    return (jnp.where(mask, jnp.int64(1), jnp.int64(0)),)
+
+
+def resolve_aggregate(name: str, arg_types: Sequence[Type],
+                      distinct: bool = False) -> AggregateFunction:
+    """FunctionManager.resolveFunction analogue for aggregates."""
+    name = name.lower()
+    if name == "count":
+        if not arg_types:  # count(*)
+            return AggregateFunction(
+                "count", BIGINT,
+                [StateColumn(np.dtype(np.int64), SUM, 0)],
+                _ones_i64,
+                lambda s: s[0],
+                [BIGINT])
+        t = arg_types[0]
+        return AggregateFunction(
+            "count", BIGINT,
+            [StateColumn(np.dtype(np.int64), SUM, 0)],
+            lambda args, mask: (jnp.where(mask, jnp.int64(1), jnp.int64(0)),),
+            lambda s: s[0],
+            [BIGINT])
+
+    if name == "sum":
+        t = arg_types[0]
+        # second state column = contributing-row count; SQL sum over an empty/all-null
+        # group is NULL, surfaced via the (data, null_mask) final_map contract
+        if isinstance(t, DecimalType):
+            out = DecimalType(18, t.scale)
+            return AggregateFunction(
+                "sum", out,
+                [StateColumn(np.dtype(np.int64), SUM, 0),
+                 StateColumn(np.dtype(np.int64), SUM, 0)],
+                lambda args, mask: (jnp.where(mask, args[0].astype(jnp.int64), 0),
+                                    jnp.where(mask, jnp.int64(1), jnp.int64(0))),
+                lambda s: (s[0], s[1] == 0),
+                [out, BIGINT])
+        if is_floating(t):
+            return AggregateFunction(
+                "sum", DOUBLE,
+                [StateColumn(np.dtype(np.float64), SUM, 0.0),
+                 StateColumn(np.dtype(np.int64), SUM, 0)],
+                lambda args, mask: (jnp.where(mask, args[0].astype(jnp.float64), 0.0),
+                                    jnp.where(mask, jnp.int64(1), jnp.int64(0))),
+                lambda s: (s[0], s[1] == 0),
+                [DOUBLE, BIGINT])
+        return AggregateFunction(
+            "sum", BIGINT,
+            [StateColumn(np.dtype(np.int64), SUM, 0),
+             StateColumn(np.dtype(np.int64), SUM, 0)],
+            lambda args, mask: (jnp.where(mask, args[0].astype(jnp.int64), 0),
+                                jnp.where(mask, jnp.int64(1), jnp.int64(0))),
+            lambda s: (s[0], s[1] == 0),
+            [BIGINT, BIGINT])
+
+    if name == "avg":
+        t = arg_types[0]
+        scale = t.scale if isinstance(t, DecimalType) else 0
+        div = 10.0 ** scale
+        return AggregateFunction(
+            "avg", DOUBLE,
+            [StateColumn(np.dtype(np.float64), SUM, 0.0),
+             StateColumn(np.dtype(np.int64), SUM, 0)],
+            lambda args, mask: (jnp.where(mask, args[0].astype(jnp.float64) / div, 0.0),
+                                jnp.where(mask, jnp.int64(1), jnp.int64(0))),
+            lambda s: (s[0] / jnp.maximum(s[1], 1).astype(jnp.float64), s[1] == 0),
+            [DOUBLE, BIGINT])
+
+    if name in ("min", "max"):
+        t = arg_types[0]
+        if is_string(t):
+            # min/max on varchar reduces over dictionary CODES — correct only for
+            # lexicographically-sorted dictionaries (block_from_strings builds sorted
+            # ones). The planner must re-encode through Dictionary.sort_keys() before
+            # aggregating an unsorted dictionary; AggregateCall.output_dictionary
+            # carries the dictionary to the output block.
+            dtype = np.dtype(np.int32)
+            ident = np.int32(2**31 - 1) if name == "min" else np.int32(-(2**31))
+        else:
+            dtype = t.np_dtype
+            if dtype.kind == "f":
+                ident = np.inf if name == "min" else -np.inf
+            else:
+                info = np.iinfo(dtype)
+                ident = info.max if name == "min" else info.min
+        red = MIN if name == "min" else MAX
+        return AggregateFunction(
+            name, t,
+            [StateColumn(dtype, red, ident),
+             StateColumn(np.dtype(np.int64), SUM, 0)],
+            lambda args, mask, _i=ident: (jnp.where(mask, args[0], jnp.asarray(_i)),
+                                          jnp.where(mask, jnp.int64(1), jnp.int64(0))),
+            lambda s: (s[0], s[1] == 0),
+            [t, BIGINT])
+
+    if name in ("stddev", "stddev_samp", "stddev_pop", "variance", "var_samp", "var_pop"):
+        pop = name.endswith("_pop")
+        is_std = name.startswith("stddev")
+        t = arg_types[0]
+        scale = t.scale if isinstance(t, DecimalType) else 0
+        div = 10.0 ** scale
+
+        def input_map(args, mask):
+            x = jnp.where(mask, args[0].astype(jnp.float64) / div, 0.0)
+            return (x, x * x, jnp.where(mask, jnp.int64(1), jnp.int64(0)))
+
+        def final_map(s, _pop=pop, _std=is_std):
+            n = jnp.maximum(s[2], 1).astype(jnp.float64)
+            mean = s[0] / n
+            var = s[1] / n - mean * mean
+            if not _pop:
+                var = var * n / jnp.maximum(n - 1, 1)
+            var = jnp.maximum(var, 0.0)
+            return (jnp.sqrt(var) if _std else var), s[2] == 0
+
+        return AggregateFunction(
+            name, DOUBLE,
+            [StateColumn(np.dtype(np.float64), SUM, 0.0),
+             StateColumn(np.dtype(np.float64), SUM, 0.0),
+             StateColumn(np.dtype(np.int64), SUM, 0)],
+            input_map, final_map,
+            [DOUBLE, DOUBLE, BIGINT])
+
+    if name == "bool_or" or name == "bool_and":
+        is_or = name == "bool_or"
+        return AggregateFunction(
+            name, BOOLEAN,
+            [StateColumn(np.dtype(np.int64), MAX if is_or else MIN, 0 if is_or else 1),
+             StateColumn(np.dtype(np.int64), SUM, 0)],
+            lambda args, mask: (
+                jnp.where(mask, args[0].astype(jnp.int64), 0 if is_or else 1),
+                jnp.where(mask, jnp.int64(1), jnp.int64(0))),
+            lambda s: (s[0] != 0, s[1] == 0),
+            [BOOLEAN, BIGINT])
+
+    if name == "approx_distinct":
+        # dense HLL-ish: 2^11 registers of max(leading-rank); merged by MAX — a fixed
+        # 2048-wide state row per group. Heavy for high-cardinality group-bys; fine
+        # for the global/low-group case it is typically used in.
+        raise NotImplementedError("approx_distinct arrives with the sketch-state rev")
+
+    raise NotImplementedError(f"aggregate function {name}({arg_types})")
+
+
+@dataclasses.dataclass
+class AggregateCall:
+    """One aggregate in a GROUP BY: function + input channels + step."""
+    function: AggregateFunction
+    input_channels: List[int]          # channels in the input page
+    mask_channel: Optional[int] = None  # FILTER (WHERE ...) / mark-distinct channel
+    # when consuming partial states (FINAL step), channels of the state columns:
+    intermediate_channels: Optional[List[int]] = None
+    # dictionary for the output block (min/max over varchar passes codes through):
+    output_dictionary: Optional[object] = None

@@ -1,0 +1,141 @@
+"""Physical operator protocol and operator context.
+
+Analogue of operator/Operator.java:20 (needsInput/addInput/getOutput/isBlocked/finish)
+and operator/OperatorContext.java. The protocol is kept — it is what lets the Driver
+pipeline arbitrary operator chains and lets blocking (join build, exchange) propagate —
+but operators here hold *device arrays* and their compute methods are jitted closures,
+so one addInput/getOutput hop is one fused XLA kernel launch, not a virtual call per row.
+
+Stats: every operator records wall time + rows/pages in/out, rolled up by the driver
+into pipeline/task stats (OperatorStats analogue for EXPLAIN ANALYZE).
+"""
+from __future__ import annotations
+
+import abc
+import dataclasses
+import time
+from typing import Any, Callable, List, Optional, Sequence
+
+from ..block import Page
+from ..memory import AggregatedMemoryContext, MemoryTrackingContext
+from ..types import Type
+
+
+@dataclasses.dataclass
+class OperatorStats:
+    """operator/OperatorStats.java (narrowed)."""
+    operator_id: int = 0
+    name: str = ""
+    add_input_calls: int = 0
+    get_output_calls: int = 0
+    input_rows: int = 0
+    input_pages: int = 0
+    output_rows: int = 0
+    output_pages: int = 0
+    add_input_ns: int = 0
+    get_output_ns: int = 0
+    finish_ns: int = 0
+    peak_memory_bytes: int = 0
+
+    def total_ns(self) -> int:
+        return self.add_input_ns + self.get_output_ns + self.finish_ns
+
+
+class OperatorContext:
+    def __init__(self, operator_id: int, name: str,
+                 memory: Optional[MemoryTrackingContext] = None):
+        self.stats = OperatorStats(operator_id, name)
+        self.memory = memory or MemoryTrackingContext(
+            AggregatedMemoryContext(), AggregatedMemoryContext(), AggregatedMemoryContext())
+        self.user_memory = self.memory.user.new_local_memory_context(name)
+        self.revocable_memory = self.memory.revocable.new_local_memory_context(name)
+
+    def record_input(self, page: Page, rows: int) -> None:
+        self.stats.add_input_calls += 1
+        self.stats.input_pages += 1
+        self.stats.input_rows += rows
+
+    def record_output(self, page: Page, rows: int) -> None:
+        self.stats.output_pages += 1
+        self.stats.output_rows += rows
+
+
+class Operator(abc.ABC):
+    """operator/Operator.java:20 — page-at-a-time pull/push protocol.
+
+    Lifecycle: while not finished: if needs_input and input available: add_input(page);
+    out = get_output(); finish() when upstream exhausted. is_blocked() returns a
+    callable/future-like or None (blocking drives yield, like ListenableFuture in the
+    reference)."""
+
+    def __init__(self, context: OperatorContext):
+        self.context = context
+        self._finishing = False
+
+    @property
+    @abc.abstractmethod
+    def output_types(self) -> List[Type]:
+        ...
+
+    def needs_input(self) -> bool:
+        return not self._finishing
+
+    @abc.abstractmethod
+    def add_input(self, page: Page) -> None:
+        ...
+
+    @abc.abstractmethod
+    def get_output(self) -> Optional[Page]:
+        ...
+
+    def finish(self) -> None:
+        self._finishing = True
+
+    def is_finished(self) -> bool:
+        return self._finishing
+
+    def is_blocked(self) -> Optional[Callable[[], bool]]:
+        """None = not blocked; else a poll-able 'done?' callable."""
+        return None
+
+    def close(self) -> None:
+        pass
+
+    # spill protocol (operator/Operator.java:68 startMemoryRevoke/finishMemoryRevoke)
+    def revocable_bytes(self) -> int:
+        return 0
+
+    def start_memory_revoke(self) -> None:
+        pass
+
+    def finish_memory_revoke(self) -> None:
+        pass
+
+
+class OperatorFactory(abc.ABC):
+    """operator/OperatorFactory — one per plan node, creates per-driver instances."""
+
+    def __init__(self, operator_id: int, name: str):
+        self.operator_id = operator_id
+        self.name = name
+
+    @abc.abstractmethod
+    def create_operator(self) -> Operator:
+        ...
+
+    def no_more_operators(self) -> None:
+        pass
+
+
+def timed(stats_field: str):
+    """Decorator: accumulate wall-clock ns of an operator method into stats."""
+    def deco(fn):
+        def wrapper(self, *a, **kw):
+            t0 = time.perf_counter_ns()
+            try:
+                return fn(self, *a, **kw)
+            finally:
+                setattr(self.context.stats, stats_field,
+                        getattr(self.context.stats, stats_field) + time.perf_counter_ns() - t0)
+        return wrapper
+    return deco

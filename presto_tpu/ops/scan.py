@@ -1,0 +1,83 @@
+"""Table scan operator: connector page source -> device pages.
+
+Analogue of operator/TableScanOperator.java and the fused
+ScanFilterAndProjectOperator.java:55. The host-side generator/connector produces numpy
+pages; this operator uploads them to the device (`jax.device_put`), optionally through
+a fused filter+project processor so the very first device kernel already prunes —
+the host->HBM transfer is the analogue of the reference's page-source read, and
+fusion here minimizes the bytes that ever hit later pipeline stages.
+"""
+from __future__ import annotations
+
+from typing import Iterator, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..block import Page
+from ..spi.connector import ConnectorPageSource
+from ..types import Type
+from .filter_project import PageProcessor
+from .operator import Operator, OperatorContext, OperatorFactory, timed
+
+
+class TableScanOperator(Operator):
+    def __init__(self, context: OperatorContext, source: ConnectorPageSource,
+                 types: List[Type], processor: Optional[PageProcessor] = None,
+                 device=None):
+        super().__init__(context)
+        self.source = source
+        self._iter: Optional[Iterator[Page]] = None
+        self._types = types
+        self.processor = processor
+        self.device = device
+        self._done = False
+
+    @property
+    def output_types(self) -> List[Type]:
+        return self.processor.output_types if self.processor else self._types
+
+    def needs_input(self) -> bool:
+        return False  # source operator
+
+    def add_input(self, page: Page) -> None:
+        raise RuntimeError("table scan takes no input")
+
+    @timed("get_output_ns")
+    def get_output(self) -> Optional[Page]:
+        if self._done:
+            return None
+        if self._iter is None:
+            self._iter = iter(self.source)
+        try:
+            page = next(self._iter)
+        except StopIteration:
+            self._done = True
+            self.source.close()
+            return None
+        # upload: host numpy blocks -> device arrays (async under XLA)
+        page = jax.tree.map(lambda a: jax.device_put(a, self.device), page)
+        self.context.record_input(page, page.capacity)
+        if self.processor is not None:
+            page = self.processor(page)
+        self.context.record_output(page, page.capacity)
+        return page
+
+    def is_finished(self) -> bool:
+        return self._done or self._finishing
+
+
+class TableScanOperatorFactory(OperatorFactory):
+    def __init__(self, operator_id: int, page_sources: List[ConnectorPageSource],
+                 types: List[Type], processor: Optional[PageProcessor] = None):
+        super().__init__(operator_id, "TableScan")
+        self._sources = list(page_sources)
+        self._types = types
+        self._processor = processor
+        self._next = 0
+
+    def create_operator(self) -> Operator:
+        src = self._sources[self._next]
+        self._next += 1
+        return TableScanOperator(OperatorContext(self.operator_id, self.name),
+                                 src, self._types, self._processor)

@@ -1,0 +1,277 @@
+"""Connector SPI — the plugin boundary.
+
+Analogue of presto-spi (spi/Plugin.java:31, spi/connector/Connector.java:27,
+spi/connector/ConnectorMetadata.java:59, spi/ConnectorSplitManager,
+spi/ConnectorPageSource.java:20, spi/ConnectorPageSinkProvider,
+spi/connector/ConnectorNodePartitioningProvider).
+
+Contract, TPU-flavored: a page source yields `Page` batches of a FIXED capacity chosen
+by the engine (so downstream jitted kernels compile once per schema), with the tail
+batch padded + masked. Connectors that know their data layout can expose bucketing via
+`ConnectorNodePartitioningProvider` so co-partitioned joins skip the mesh exchange,
+exactly like the reference's bucketed hive tables.
+"""
+from __future__ import annotations
+
+import abc
+import dataclasses
+from typing import Any, Dict, Iterable, Iterator, List, Optional, Sequence, Tuple
+
+from ..block import Dictionary, Page
+from ..types import Type
+
+
+@dataclasses.dataclass(frozen=True)
+class ColumnMetadata:
+    name: str
+    type: Type
+    hidden: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class ColumnHandle:
+    """Connector-opaque column reference (spi/ColumnHandle)."""
+    connector_id: str
+    name: str
+    type: Type
+    ordinal: int = -1
+
+
+@dataclasses.dataclass(frozen=True)
+class SchemaTableName:
+    schema: str
+    table: str
+
+    def __str__(self):
+        return f"{self.schema}.{self.table}"
+
+
+@dataclasses.dataclass(frozen=True)
+class TableHandle:
+    """spi/ConnectorTableHandle + engine-level metadata/TableHandle rolled together."""
+    connector_id: str
+    schema_table: SchemaTableName
+    extra: Tuple = ()  # connector payload (e.g. tpch scale factor)
+
+
+@dataclasses.dataclass(frozen=True)
+class TableMetadata:
+    name: SchemaTableName
+    columns: Tuple[ColumnMetadata, ...]
+
+    def column(self, name: str) -> ColumnMetadata:
+        for c in self.columns:
+            if c.name == name:
+                return c
+        raise KeyError(name)
+
+
+@dataclasses.dataclass
+class ColumnStatistics:
+    """spi/statistics/ColumnStatistics — feeds the CBO."""
+    distinct_count: Optional[float] = None
+    null_fraction: float = 0.0
+    min_value: Optional[float] = None
+    max_value: Optional[float] = None
+    avg_bytes: Optional[float] = None
+
+
+@dataclasses.dataclass
+class TableStatistics:
+    """spi/statistics/TableStatistics."""
+    row_count: Optional[float] = None
+    columns: Dict[str, ColumnStatistics] = dataclasses.field(default_factory=dict)
+
+    @staticmethod
+    def empty() -> "TableStatistics":
+        return TableStatistics()
+
+
+@dataclasses.dataclass(frozen=True)
+class Split:
+    """spi/ConnectorSplit: a schedulable unit of data. `addresses` drive split-affinity
+    placement (SOURCE_DISTRIBUTION); `bucket` drives grouped/lifespan execution."""
+    connector_id: str
+    payload: Tuple
+    addresses: Tuple[str, ...] = ()
+    remotely_accessible: bool = True
+    bucket: Optional[int] = None
+
+
+class ConnectorPageSource(abc.ABC):
+    """spi/ConnectorPageSource.java:20 — a stream of fixed-capacity masked pages."""
+
+    @abc.abstractmethod
+    def __iter__(self) -> Iterator[Page]:
+        ...
+
+    def close(self) -> None:
+        pass
+
+    def completed_bytes(self) -> int:
+        return 0
+
+
+class FixedPageSource(ConnectorPageSource):
+    def __init__(self, pages: Sequence[Page]):
+        self._pages = list(pages)
+
+    def __iter__(self):
+        return iter(self._pages)
+
+
+class ConnectorPageSink(abc.ABC):
+    """spi/ConnectorPageSink — write path for INSERT/CTAS."""
+
+    @abc.abstractmethod
+    def append_page(self, page: Page) -> None:
+        ...
+
+    def finish(self) -> Any:
+        return None
+
+    def abort(self) -> None:
+        pass
+
+
+@dataclasses.dataclass
+class Constraint:
+    """Pushed-down predicate summary (spi/Constraint + TupleDomain, simplified to
+    per-column [min,max] / in-set domains, which covers TPC pruning)."""
+    domains: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    @staticmethod
+    def all() -> "Constraint":
+        return Constraint()
+
+
+class ConnectorMetadata(abc.ABC):
+    """spi/connector/ConnectorMetadata.java:59 (narrowed to the engine's needs)."""
+
+    @abc.abstractmethod
+    def list_schemas(self) -> List[str]:
+        ...
+
+    @abc.abstractmethod
+    def list_tables(self, schema: Optional[str] = None) -> List[SchemaTableName]:
+        ...
+
+    @abc.abstractmethod
+    def get_table_handle(self, name: SchemaTableName) -> Optional[TableHandle]:
+        ...
+
+    @abc.abstractmethod
+    def get_table_metadata(self, table: TableHandle) -> TableMetadata:
+        ...
+
+    def get_column_handles(self, table: TableHandle) -> Dict[str, ColumnHandle]:
+        meta = self.get_table_metadata(table)
+        return {c.name: ColumnHandle(table.connector_id, c.name, c.type, i)
+                for i, c in enumerate(meta.columns)}
+
+    def get_table_statistics(self, table: TableHandle,
+                             constraint: Constraint) -> TableStatistics:
+        return TableStatistics.empty()
+
+    # write path (optional)
+    def begin_insert(self, table: TableHandle):
+        raise NotImplementedError(f"{type(self).__name__} does not support inserts")
+
+    def finish_insert(self, handle, fragments) -> None:
+        pass
+
+    def create_table(self, metadata: TableMetadata) -> None:
+        raise NotImplementedError(f"{type(self).__name__} does not support CREATE TABLE")
+
+
+class ConnectorSplitManager(abc.ABC):
+    """spi/connector/ConnectorSplitManager."""
+
+    @abc.abstractmethod
+    def get_splits(self, table: TableHandle, constraint: Constraint,
+                   desired_splits: int) -> List[Split]:
+        ...
+
+
+class ConnectorPageSourceProvider(abc.ABC):
+    """spi/connector/ConnectorPageSourceProvider."""
+
+    @abc.abstractmethod
+    def create_page_source(self, split: Split, columns: Sequence[ColumnHandle],
+                           page_capacity: int,
+                           constraint: Constraint = Constraint.all()) -> ConnectorPageSource:
+        ...
+
+
+class ConnectorPageSinkProvider(abc.ABC):
+    @abc.abstractmethod
+    def create_page_sink(self, insert_handle) -> ConnectorPageSink:
+        ...
+
+
+class ConnectorNodePartitioningProvider:
+    """spi/connector/ConnectorNodePartitioningProvider — connector bucketing.
+
+    bucket_count(table) -> Optional[int]; bucket_of(split) -> bucket id. When present the
+    engine can run grouped (lifespan) execution and skip re-exchanges for co-bucketed
+    joins (operator/StageExecutionDescriptor.java:33)."""
+
+    def bucket_count(self, table: TableHandle) -> Optional[int]:
+        return None
+
+
+class Connector(abc.ABC):
+    """spi/connector/Connector.java:27 — bundle of services for one catalog."""
+
+    @abc.abstractmethod
+    def metadata(self) -> ConnectorMetadata:
+        ...
+
+    @abc.abstractmethod
+    def split_manager(self) -> ConnectorSplitManager:
+        ...
+
+    @abc.abstractmethod
+    def page_source_provider(self) -> ConnectorPageSourceProvider:
+        ...
+
+    def page_sink_provider(self) -> Optional[ConnectorPageSinkProvider]:
+        return None
+
+    def node_partitioning_provider(self) -> ConnectorNodePartitioningProvider:
+        return ConnectorNodePartitioningProvider()
+
+    def session_properties(self) -> Dict[str, Any]:
+        return {}
+
+    def shutdown(self) -> None:
+        pass
+
+
+class ConnectorFactory(abc.ABC):
+    """spi/connector/ConnectorFactory."""
+
+    @property
+    @abc.abstractmethod
+    def name(self) -> str:
+        ...
+
+    @abc.abstractmethod
+    def create(self, catalog_name: str, config: Dict[str, str]) -> Connector:
+        ...
+
+
+class Plugin:
+    """spi/Plugin.java:31 — factories a plugin contributes. Subclass and override."""
+
+    def connector_factories(self) -> List[ConnectorFactory]:
+        return []
+
+    def functions(self) -> List:
+        return []
+
+    def types(self) -> List[Type]:
+        return []
+
+    def event_listener_factories(self) -> List:
+        return []

@@ -1,0 +1,324 @@
+"""Type system for the TPU-native engine.
+
+Analogue of the reference SPI type layer (presto-spi/.../spi/type/Type.java:25 and the
+~60 concrete types under presto-spi/src/main/java/com/facebook/presto/spi/type/).
+
+Design (tpu-first, not a translation):
+- Every type maps to a fixed-width on-device representation (a jax dtype) so pages are
+  dense arrays XLA can tile onto the MXU/VPU. Variable-width SQL types (VARCHAR) are
+  dictionary-encoded at ingest: int32 codes on device, the byte dictionary stays host-side
+  (mirroring how the reference leans on spi/block/DictionaryBlock.java for the same reason).
+- DECIMAL(p,s) with p<=18 is exact int64 scaled integers (the reference's short decimal,
+  spi/type/DecimalType.java) — int64 is XLA-emulated on TPU but only touches the narrow
+  final-aggregation path; hot kernels run on int32/float32.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, ClassVar, Optional
+
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class Type:
+    """Base SQL type. Compare by name (like TypeSignature equality in the reference)."""
+
+    name: ClassVar[str] = "unknown"
+
+    @property
+    def np_dtype(self) -> np.dtype:
+        raise NotImplementedError
+
+    @property
+    def comparable(self) -> bool:
+        return True
+
+    @property
+    def orderable(self) -> bool:
+        return True
+
+    @property
+    def fixed_width(self) -> bool:
+        return True
+
+    def display_name(self) -> str:
+        return self.name
+
+    def __str__(self) -> str:  # pragma: no cover - debug aid
+        return self.display_name()
+
+    # Python-value conversion used by the client protocol / oracle comparisons.
+    def to_python(self, raw: Any) -> Any:
+        return raw
+
+
+@dataclasses.dataclass(frozen=True)
+class BigintType(Type):
+    name: ClassVar[str] = "bigint"
+
+    @property
+    def np_dtype(self) -> np.dtype:
+        return np.dtype(np.int64)
+
+    def to_python(self, raw):
+        return int(raw)
+
+
+@dataclasses.dataclass(frozen=True)
+class IntegerType(Type):
+    name: ClassVar[str] = "integer"
+
+    @property
+    def np_dtype(self) -> np.dtype:
+        return np.dtype(np.int32)
+
+    def to_python(self, raw):
+        return int(raw)
+
+
+@dataclasses.dataclass(frozen=True)
+class SmallintType(Type):
+    name: ClassVar[str] = "smallint"
+
+    @property
+    def np_dtype(self) -> np.dtype:
+        return np.dtype(np.int16)
+
+    def to_python(self, raw):
+        return int(raw)
+
+
+@dataclasses.dataclass(frozen=True)
+class DoubleType(Type):
+    name: ClassVar[str] = "double"
+
+    @property
+    def np_dtype(self) -> np.dtype:
+        return np.dtype(np.float64)
+
+    def to_python(self, raw):
+        return float(raw)
+
+
+@dataclasses.dataclass(frozen=True)
+class RealType(Type):
+    name: ClassVar[str] = "real"
+
+    @property
+    def np_dtype(self) -> np.dtype:
+        return np.dtype(np.float32)
+
+    def to_python(self, raw):
+        return float(raw)
+
+
+@dataclasses.dataclass(frozen=True)
+class BooleanType(Type):
+    name: ClassVar[str] = "boolean"
+
+    @property
+    def np_dtype(self) -> np.dtype:
+        return np.dtype(np.bool_)
+
+    def to_python(self, raw):
+        return bool(raw)
+
+
+@dataclasses.dataclass(frozen=True)
+class DateType(Type):
+    """Days since epoch in int32 (matches spi/type/DateType.java representation)."""
+
+    name: ClassVar[str] = "date"
+
+    @property
+    def np_dtype(self) -> np.dtype:
+        return np.dtype(np.int32)
+
+    def to_python(self, raw):
+        import datetime
+
+        return datetime.date(1970, 1, 1) + datetime.timedelta(days=int(raw))
+
+
+@dataclasses.dataclass(frozen=True)
+class TimestampType(Type):
+    """Millis since epoch in int64 (spi/type/TimestampType.java)."""
+
+    name: ClassVar[str] = "timestamp"
+
+    @property
+    def np_dtype(self) -> np.dtype:
+        return np.dtype(np.int64)
+
+
+@dataclasses.dataclass(frozen=True)
+class DecimalType(Type):
+    """Short decimal: unscaled int64 value, compile-time scale.
+
+    Reference: spi/type/DecimalType.java (short decimal path). Long decimals (p>18)
+    are out of scope for the TPC workloads and rejected at analysis time.
+    """
+
+    precision: int = 12
+    scale: int = 2
+    name: ClassVar[str] = "decimal"
+
+    def __post_init__(self):
+        if self.precision > 18:
+            raise ValueError("long decimals (precision > 18) not supported on device")
+
+    @property
+    def np_dtype(self) -> np.dtype:
+        return np.dtype(np.int64)
+
+    def display_name(self) -> str:
+        return f"decimal({self.precision},{self.scale})"
+
+    def to_python(self, raw):
+        from decimal import Decimal
+
+        return Decimal(int(raw)) / (10 ** self.scale)
+
+
+@dataclasses.dataclass(frozen=True)
+class VarcharType(Type):
+    """Dictionary-encoded strings: device side is int32 codes, bytes live host-side.
+
+    Reference: spi/type/VarcharType.java + spi/block/DictionaryBlock.java. On TPU there
+    is no efficient variable-width representation, so *all* varchar blocks are dictionary
+    blocks; string-typed expressions either (a) evaluate on the dictionary host-side and
+    broadcast as code predicates, or (b) compare codes directly when dictionaries match.
+    """
+
+    length: Optional[int] = None  # None == unbounded
+    name: ClassVar[str] = "varchar"
+
+    @property
+    def np_dtype(self) -> np.dtype:
+        return np.dtype(np.int32)  # dictionary code
+
+    @property
+    def fixed_width(self) -> bool:
+        return False
+
+    def display_name(self) -> str:
+        return "varchar" if self.length is None else f"varchar({self.length})"
+
+
+@dataclasses.dataclass(frozen=True)
+class CharType(VarcharType):
+    name: ClassVar[str] = "char"
+
+    def display_name(self) -> str:
+        return f"char({self.length})" if self.length is not None else "char"
+
+
+@dataclasses.dataclass(frozen=True)
+class UnknownType(Type):
+    """Type of NULL literals before coercion (spi/type/UnknownType analogue)."""
+
+    name: ClassVar[str] = "unknown"
+
+    @property
+    def np_dtype(self) -> np.dtype:
+        return np.dtype(np.bool_)
+
+
+BIGINT = BigintType()
+INTEGER = IntegerType()
+SMALLINT = SmallintType()
+DOUBLE = DoubleType()
+REAL = RealType()
+BOOLEAN = BooleanType()
+DATE = DateType()
+TIMESTAMP = TimestampType()
+VARCHAR = VarcharType()
+UNKNOWN = UnknownType()
+
+
+def decimal_type(precision: int = 12, scale: int = 2) -> DecimalType:
+    return DecimalType(precision, scale)
+
+
+_PARSE_TABLE = {
+    "bigint": BIGINT,
+    "integer": INTEGER,
+    "int": INTEGER,
+    "smallint": SMALLINT,
+    "double": DOUBLE,
+    "real": REAL,
+    "boolean": BOOLEAN,
+    "date": DATE,
+    "timestamp": TIMESTAMP,
+    "varchar": VARCHAR,
+    "unknown": UNKNOWN,
+}
+
+
+def parse_type(text: str) -> Type:
+    """Parse a type signature string (TypeSignature.parse analogue, simplified)."""
+    text = text.strip().lower()
+    if text in _PARSE_TABLE:
+        return _PARSE_TABLE[text]
+    if text.startswith("decimal"):
+        inner = text[len("decimal"):].strip("() ")
+        if not inner:
+            return DecimalType()
+        p, s = (int(x) for x in inner.split(","))
+        return DecimalType(p, s)
+    if text.startswith("varchar"):
+        inner = text[len("varchar"):].strip("() ")
+        return VarcharType(int(inner)) if inner else VARCHAR
+    if text.startswith("char"):
+        inner = text[len("char"):].strip("() ")
+        return CharType(int(inner)) if inner else CharType()
+    raise ValueError(f"unknown type: {text}")
+
+
+def is_string(t: Type) -> bool:
+    return isinstance(t, VarcharType)
+
+
+def is_numeric(t: Type) -> bool:
+    return isinstance(t, (BigintType, IntegerType, SmallintType, DoubleType, RealType, DecimalType))
+
+
+def is_integral(t: Type) -> bool:
+    return isinstance(t, (BigintType, IntegerType, SmallintType))
+
+
+def is_floating(t: Type) -> bool:
+    return isinstance(t, (DoubleType, RealType))
+
+
+def common_super_type(a: Type, b: Type) -> Type:
+    """Implicit-coercion lattice (sql/analyzer TypeCoercion analogue, numeric subset)."""
+    if a == b:
+        return a
+    if isinstance(a, UnknownType):
+        return b
+    if isinstance(b, UnknownType):
+        return a
+    if is_string(a) and is_string(b):
+        return VARCHAR
+    order = {"smallint": 0, "integer": 1, "bigint": 2, "decimal": 3, "real": 4, "double": 5}
+    if is_numeric(a) and is_numeric(b):
+        if isinstance(a, DecimalType) and is_integral(b):
+            return a
+        if isinstance(b, DecimalType) and is_integral(a):
+            return b
+        if isinstance(a, DecimalType) and is_floating(b):
+            return DOUBLE
+        if isinstance(b, DecimalType) and is_floating(a):
+            return DOUBLE
+        if isinstance(a, DecimalType) and isinstance(b, DecimalType):
+            scale = max(a.scale, b.scale)
+            prec = min(18, max(a.precision - a.scale, b.precision - b.scale) + scale)
+            return DecimalType(prec, scale)
+        return a if order[a.name] >= order[b.name] else b
+    if isinstance(a, DateType) and isinstance(b, TimestampType):
+        return b
+    if isinstance(b, DateType) and isinstance(a, TimestampType):
+        return a
+    raise TypeError(f"no common type for {a} and {b}")

@@ -1,0 +1,163 @@
+"""Testing utilities: page sinks, operator drivers, and the SQL oracle.
+
+Analogue of the reference testing kit: OperatorAssertion.java, PageConsumerOperator,
+NullOutputOperator (presto-main testing/), and the H2 oracle pattern of
+QueryAssertions.assertQuery (presto-tests/.../QueryAssertions.java:97-119,
+H2QueryRunner.java:88) — here the oracle is sqlite3 over the same generated data.
+"""
+from __future__ import annotations
+
+import math
+import sqlite3
+from typing import Any, Iterable, List, Optional, Sequence
+
+import numpy as np
+
+from ..block import Page
+from ..ops.operator import Operator, OperatorContext, OperatorFactory
+from ..types import Type
+
+
+class PageConsumerOperator(Operator):
+    """Sink that materializes pages (testing/PageConsumerOperator analogue)."""
+
+    def __init__(self, context: OperatorContext, types: List[Type]):
+        super().__init__(context)
+        self._types = types
+        self.pages: List[Page] = []
+
+    @property
+    def output_types(self) -> List[Type]:
+        return self._types
+
+    def add_input(self, page: Page) -> None:
+        self.context.record_input(page, page.capacity)
+        self.pages.append(page)
+
+    def get_output(self) -> Optional[Page]:
+        return None
+
+    def rows(self) -> List[list]:
+        out = []
+        for p in self.pages:
+            out.extend(p.to_pylists())
+        return out
+
+
+class PageConsumerFactory(OperatorFactory):
+    def __init__(self, operator_id: int = 999, types: Optional[List[Type]] = None):
+        super().__init__(operator_id, "PageConsumer")
+        self.types = types or []
+        self.consumers: List[PageConsumerOperator] = []
+
+    def create_operator(self) -> PageConsumerOperator:
+        op = PageConsumerOperator(OperatorContext(self.operator_id, self.name), self.types)
+        self.consumers.append(op)
+        return op
+
+    def rows(self) -> List[list]:
+        out = []
+        for c in self.consumers:
+            out.extend(c.rows())
+        return out
+
+
+def drive_operators(operators: List[Operator]) -> None:
+    """Run an operator chain to completion (OperatorAssertion.toPages analogue)."""
+    from ..exec.driver import Driver
+
+    Driver(operators).run_to_completion()
+
+
+# ---------------------------------------------------------------------------
+# sqlite oracle
+# ---------------------------------------------------------------------------
+
+class SqliteOracle:
+    """Loads generated TPC-H data into sqlite and runs reference SQL.
+
+    Decimal columns are loaded as REAL (sqlite has no decimals) — comparisons use
+    tolerances for floating results and exactness for integers/strings.
+    """
+
+    def __init__(self):
+        self.conn = sqlite3.connect(":memory:")
+
+    def load_tpch(self, schema_sf: float, tables: Sequence[str],
+                  max_rows: Optional[int] = None) -> None:
+        from ..connectors.tpch import generator as g
+
+        cur = self.conn.cursor()
+        for t in tables:
+            cols = (g.LINEITEM_COLUMNS if t == "lineitem"
+                    else [(c.name, c.type, c.dictionary) for c in g.TPCH_TABLES[t].columns])
+            names = [c[0] for c in cols]
+            cur.execute(f"CREATE TABLE IF NOT EXISTS {t} ({', '.join(names)})")
+            if t == "lineitem":
+                n_orders = g.TPCH_TABLES["orders"].row_count(schema_sf)
+                data = g.lineitem_for_orders(0, n_orders, schema_sf, names)
+            else:
+                n = g.table_row_count(t, schema_sf)
+                if max_rows:
+                    n = min(n, max_rows)
+                data = g.generate_rows(t, 0, n, schema_sf, names)
+            pycols = []
+            for (cname, ctype, cdict) in cols:
+                arr = data[cname]
+                if cdict is not None:
+                    pycols.append(cdict.lookup(arr.astype(np.int64)))
+                elif ctype.name == "decimal":
+                    pycols.append(arr.astype(np.float64) / (10 ** ctype.scale))
+                else:
+                    pycols.append(arr)
+            rows = list(zip(*[list(c) for c in pycols]))
+            rows = [tuple(x.item() if isinstance(x, np.generic) else x for x in r)
+                    for r in rows]
+            cur.executemany(
+                f"INSERT INTO {t} VALUES ({', '.join('?' * len(names))})", rows)
+        self.conn.commit()
+
+    def query(self, sql: str) -> List[tuple]:
+        return self.conn.execute(sql).fetchall()
+
+
+def normalize_value(v: Any) -> Any:
+    """Python value -> comparable canonical form."""
+    import datetime
+    from decimal import Decimal
+
+    if isinstance(v, Decimal):
+        return float(v)
+    if isinstance(v, datetime.date):
+        return (v - datetime.date(1970, 1, 1)).days
+    if isinstance(v, np.generic):
+        return v.item()
+    return v
+
+
+def assert_rows_equal(actual: Iterable[Sequence], expected: Iterable[Sequence],
+                      ordered: bool = False, rel_tol: float = 1e-6) -> None:
+    """QueryAssertions.assertEqualsIgnoreOrder analogue with float tolerance."""
+    a = [tuple(normalize_value(x) for x in row) for row in actual]
+    e = [tuple(normalize_value(x) for x in row) for row in expected]
+    if not ordered:
+        a = sorted(a, key=_row_key)
+        e = sorted(e, key=_row_key)
+    assert len(a) == len(e), f"row count mismatch: {len(a)} != {len(e)}\n" \
+                             f"actual[:5]={a[:5]}\nexpected[:5]={e[:5]}"
+    for i, (ra, re_) in enumerate(zip(a, e)):
+        assert len(ra) == len(re_), f"row {i} arity: {ra} vs {re_}"
+        for j, (va, ve) in enumerate(zip(ra, re_)):
+            if isinstance(va, float) or isinstance(ve, float):
+                if va is None or ve is None:
+                    assert va is ve is None, f"row {i} col {j}: {va} != {ve}"
+                    continue
+                ok = math.isclose(float(va), float(ve), rel_tol=rel_tol, abs_tol=1e-9)
+                assert ok, f"row {i} col {j}: {va} != {ve}\nrow actual={ra}\nrow expected={re_}"
+            else:
+                assert va == ve, f"row {i} col {j}: {va!r} != {ve!r}\n" \
+                                 f"row actual={ra}\nrow expected={re_}"
+
+
+def _row_key(row):
+    return tuple((x is None, str(type(x)), str(x)) for x in row)

@@ -1,0 +1,33 @@
+"""Test harness configuration.
+
+Tests run on a virtual 8-device CPU mesh (the DistributedQueryRunner pattern of the
+reference test suite — presto-tests/.../DistributedQueryRunner.java:77 boots N servers
+in one JVM; here N XLA host devices stand in for N TPU chips). Must set flags before
+jax initializes its backends.
+"""
+import os
+
+# force-override: the outer environment pins JAX_PLATFORMS=axon (the real TPU tunnel)
+# and the axon sitecustomize sets jax_platforms="axon,cpu" in jax's config at interpreter
+# start; tests must NOT touch the TPU — they run on the virtual CPU mesh. Both the env
+# var AND the config entry must be reset.
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def eight_devices():
+    import jax
+
+    devs = jax.devices()
+    assert len(devs) >= 8, f"expected 8 virtual devices, got {devs}"
+    return devs

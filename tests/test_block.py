@@ -1,0 +1,69 @@
+"""Ring-1 substrate tests (reference: presto-spi block/type tests, TestPage.java)."""
+import numpy as np
+import pytest
+
+from presto_tpu import BIGINT, DOUBLE, VARCHAR, DecimalType, Page, parse_type
+from presto_tpu.block import (Block, Dictionary, block_from_strings, empty_page,
+                              page_from_arrays, page_from_pylists)
+from presto_tpu.types import (BOOLEAN, DATE, INTEGER, common_super_type, DecimalType,
+                              VarcharType)
+
+
+def test_parse_type_roundtrip():
+    assert parse_type("bigint") is BIGINT
+    assert parse_type("decimal(12,2)") == DecimalType(12, 2)
+    assert parse_type("varchar") == VarcharType()
+    assert parse_type("varchar(25)") == VarcharType(25)
+
+
+def test_common_super_type():
+    assert common_super_type(BIGINT, INTEGER) is BIGINT
+    assert common_super_type(BIGINT, DOUBLE) is DOUBLE
+    assert common_super_type(DecimalType(12, 2), BIGINT) == DecimalType(12, 2)
+    assert common_super_type(DecimalType(12, 2), DOUBLE) is DOUBLE
+
+
+def test_dictionary_block():
+    b = block_from_strings(["MAIL", "SHIP", "MAIL", None])
+    assert b.dictionary.lookup(np.asarray([0, 1])).tolist() == ["MAIL", "SHIP"]
+    vals = b.to_pylist()
+    assert vals == ["MAIL", "SHIP", "MAIL", None]
+
+
+def test_page_mask_and_compact():
+    page = page_from_arrays([BIGINT, DOUBLE],
+                            [np.arange(10), np.arange(10) * 0.5],
+                            count=10, capacity=16)
+    assert page.capacity == 16
+    assert page.size() == 10
+    # select even rows via mask, then compact
+    mask = np.asarray(page.mask) & (np.arange(16) % 2 == 0)
+    filtered = page.with_mask(mask).compact()
+    assert filtered.size() == 5
+    rows = filtered.to_pylists()
+    assert [r[0] for r in rows] == [0, 2, 4, 6, 8]
+    assert [r[1] for r in rows] == [0.0, 1.0, 2.0, 3.0, 4.0]
+
+
+def test_page_from_pylists_decimal_and_null():
+    page = page_from_pylists([BIGINT, DecimalType(10, 2)],
+                             [[1, "3.50"], [2, None], [None, "1.25"]])
+    rows = page.to_pylists()
+    from decimal import Decimal
+    assert rows[0] == [1, Decimal("3.50")]
+    assert rows[1][1] is None
+    assert rows[2][0] is None
+
+
+def test_empty_page():
+    p = empty_page([BIGINT, VARCHAR], capacity=8)
+    assert p.size() == 0
+    assert p.to_pylists() == []
+
+
+def test_compact_full_capacity():
+    # all rows live: compact must be identity
+    page = page_from_arrays([INTEGER], [np.arange(8)], count=8, capacity=8)
+    c = page.compact()
+    assert c.size() == 8
+    assert [r[0] for r in c.to_pylists()] == list(range(8))

@@ -1,0 +1,186 @@
+"""Ring-1 operator tests with oracle checks (reference:
+TestHashAggregationOperator.java, TestFilterAndProjectOperator.java,
+presto-benchmark HandTpchQuery1/6 patterns)."""
+import numpy as np
+import pytest
+
+from presto_tpu.types import BIGINT, BOOLEAN, DATE, DOUBLE, VARCHAR, DecimalType
+from presto_tpu.block import page_from_arrays
+from presto_tpu.ops.expressions import (InputLayout, call, constant, input_ref, special)
+from presto_tpu.ops.filter_project import PageProcessor
+from presto_tpu.ops.aggregates import AggregateCall, resolve_aggregate
+from presto_tpu.ops.hash_agg import (FINAL, PARTIAL, SINGLE,
+                                     HashAggregationOperatorFactory)
+from presto_tpu.utils.testing import assert_rows_equal
+
+DEC = DecimalType(12, 2)
+
+
+def make_page(n=100, cap=128, seed=0):
+    rng = np.random.RandomState(seed)
+    k = rng.randint(0, 5, n).astype(np.int64)
+    v = rng.randint(0, 1000, n).astype(np.int64)
+    d = rng.rand(n)
+    return page_from_arrays([BIGINT, BIGINT, DOUBLE], [k, v, d],
+                            count=n, capacity=cap), k, v, d
+
+
+def test_filter_project_mask():
+    page, k, v, d = make_page()
+    layout = InputLayout([BIGINT, BIGINT, DOUBLE], [None] * 3)
+    pred = call("greater_than", BOOLEAN, input_ref(1, BIGINT), constant(500, BIGINT))
+    proj_sum = call("add", BIGINT, input_ref(0, BIGINT), input_ref(1, BIGINT))
+    proc = PageProcessor(layout, pred, [proj_sum, input_ref(2, DOUBLE)])
+    out = proc(page)
+    rows = out.to_pylists()
+    exp = [[int(ki + vi), float(di)] for ki, vi, di in zip(k, v, d) if vi > 500]
+    assert_rows_equal(rows, exp)
+
+
+def test_grouped_agg_sort_strategy():
+    page, k, v, d = make_page(200, 256)
+    fac = HashAggregationOperatorFactory(
+        0, [0], [BIGINT], [None], None,  # no domain info -> sort strategy
+        [AggregateCall(resolve_aggregate("sum", [BIGINT]), [1]),
+         AggregateCall(resolve_aggregate("count", []), []),
+         AggregateCall(resolve_aggregate("min", [BIGINT]), [1]),
+         AggregateCall(resolve_aggregate("max", [BIGINT]), [1]),
+         AggregateCall(resolve_aggregate("avg", [DOUBLE]), [2])],
+        SINGLE, 256)
+    op = fac.create_operator()
+    op.add_input(page)
+    op.finish()
+    pages = []
+    while not op.is_finished():
+        p = op.get_output()
+        if p is None:
+            break
+        pages.append(p)
+    rows = [r for p in pages for r in p.to_pylists()]
+    exp = []
+    for key in sorted(set(k)):
+        m = k == key
+        exp.append([int(key), int(v[m].sum()), int(m.sum()), int(v[m].min()),
+                    int(v[m].max()), float(d[m].mean())])
+    assert_rows_equal(rows, exp)
+
+
+def test_grouped_agg_direct_strategy():
+    page, k, v, d = make_page(200, 256)
+    fac = HashAggregationOperatorFactory(
+        0, [0], [BIGINT], [None], [5],  # domain known -> direct strategy
+        [AggregateCall(resolve_aggregate("sum", [BIGINT]), [1])],
+        SINGLE, 256)
+    op = fac.create_operator()
+    op.add_input(page)
+    op.finish()
+    rows = []
+    while True:
+        p = op.get_output()
+        if p is None:
+            break
+        rows.extend(p.to_pylists())
+    exp = [[int(key), int(v[k == key].sum())] for key in sorted(set(k))]
+    assert_rows_equal(rows, exp)
+
+
+def test_partial_final_roundtrip():
+    """PARTIAL on two pages -> FINAL combine equals SINGLE over both."""
+    p1, k1, v1, _ = make_page(150, 256, seed=1)
+    p2, k2, v2, _ = make_page(130, 256, seed=2)
+    calls = [AggregateCall(resolve_aggregate("sum", [BIGINT]), [1]),
+             AggregateCall(resolve_aggregate("avg", [BIGINT]), [1])]
+    partial = HashAggregationOperatorFactory(
+        0, [0], [BIGINT], [None], None, calls, PARTIAL, 256)
+    pop = partial.create_operator()
+    pop.add_input(p1)
+    pop.add_input(p2)
+    pop.finish()
+    mid_pages = []
+    while True:
+        p = pop.get_output()
+        if p is None:
+            break
+        mid_pages.append(p)
+    # FINAL step: intermediate channels follow the keys
+    fcalls = [AggregateCall(resolve_aggregate("sum", [BIGINT]), [], intermediate_channels=[1, 2]),
+              AggregateCall(resolve_aggregate("avg", [BIGINT]), [], intermediate_channels=[3, 4])]
+    final = HashAggregationOperatorFactory(
+        1, [0], [BIGINT], [None], None, fcalls, FINAL, 256)
+    fop = final.create_operator()
+    for p in mid_pages:
+        fop.add_input(p)
+    fop.finish()
+    rows = []
+    while True:
+        p = fop.get_output()
+        if p is None:
+            break
+        rows.extend(p.to_pylists())
+    k = np.concatenate([k1, k2])
+    v = np.concatenate([v1, v2])
+    exp = [[int(key), int(v[k == key].sum()), float(v[k == key].mean())]
+           for key in sorted(set(k))]
+    assert_rows_equal(rows, exp)
+
+
+def test_global_agg_empty_input():
+    fac = HashAggregationOperatorFactory(
+        0, [], [], [], None,
+        [AggregateCall(resolve_aggregate("count", []), []),
+         AggregateCall(resolve_aggregate("sum", [BIGINT]), [0])],
+        SINGLE, 64)
+    op = fac.create_operator()
+    op.finish()
+    rows = []
+    while True:
+        p = op.get_output()
+        if p is None:
+            break
+        rows.extend(p.to_pylists())
+    assert rows[0][0] == 0  # count(*) = 0
+
+
+def test_masked_rows_excluded():
+    # rows beyond count must not contribute
+    k = np.asarray([1, 1, 2, 9, 9], dtype=np.int64)
+    v = np.asarray([10, 20, 30, 999, 999], dtype=np.int64)
+    page = page_from_arrays([BIGINT, BIGINT], [k, v], count=3, capacity=5)
+    fac = HashAggregationOperatorFactory(
+        0, [0], [BIGINT], [None], None,
+        [AggregateCall(resolve_aggregate("sum", [BIGINT]), [1])], SINGLE, 8)
+    op = fac.create_operator()
+    op.add_input(page)
+    op.finish()
+    rows = []
+    while True:
+        p = op.get_output()
+        if p is None:
+            break
+        rows.extend(p.to_pylists())
+    assert_rows_equal(rows, [[1, 30], [2, 30]])
+
+
+def test_null_inputs_excluded_and_null_outputs():
+    """Review follow-up: NULL rows must not contribute; empty groups yield NULL sums."""
+    from presto_tpu.block import Block, Page
+    k = np.asarray([1, 1, 2], dtype=np.int64)
+    v = np.asarray([10, 20, 30], dtype=np.int64)
+    vnulls = np.asarray([False, True, True])
+    page = Page((Block(BIGINT, k), Block(BIGINT, v, vnulls)), np.ones(3, dtype=bool))
+    fac = HashAggregationOperatorFactory(
+        0, [0], [BIGINT], [None], None,
+        [AggregateCall(resolve_aggregate("sum", [BIGINT]), [1]),
+         AggregateCall(resolve_aggregate("count", [BIGINT]), [1])],
+        SINGLE, 8)
+    op = fac.create_operator()
+    op.add_input(page)
+    op.finish()
+    rows = []
+    while True:
+        p = op.get_output()
+        if p is None:
+            break
+        rows.extend(p.to_pylists())
+    # group 1: only the non-null 10 counts; group 2: all inputs null -> sum NULL, count 0
+    assert_rows_equal(rows, [[1, 10, 1], [2, None, 0]])

@@ -1,0 +1,45 @@
+"""Ring-2 end-to-end query tests against the sqlite oracle (the reference's
+H2QueryRunner + AbstractTestQueries pattern, presto-tests/.../QueryAssertions.java:97).
+
+Uses schema `tiny` (SF 0.01) so the oracle load stays fast.
+"""
+import pytest
+
+from presto_tpu.models.hand_queries import build_q1, build_q6, run_query
+from presto_tpu.utils.testing import SqliteOracle, assert_rows_equal
+
+
+@pytest.fixture(scope="module")
+def oracle():
+    o = SqliteOracle()
+    o.load_tpch(0.01, ["lineitem"])
+    return o
+
+
+def test_q6_vs_oracle(oracle):
+    rows = run_query(build_q6, "tiny", 1 << 14)
+    exp = oracle.query("""
+        SELECT sum(l_extendedprice * l_discount)
+        FROM lineitem
+        WHERE l_shipdate >= 8766 AND l_shipdate < 9131
+          AND l_discount BETWEEN 0.05 AND 0.07 AND l_quantity < 24
+    """)  # dates as days-since-epoch: 1994-01-01=8766, 1995-01-01=9131
+    assert len(rows) == 1
+    assert_rows_equal(rows, exp, rel_tol=1e-9)
+
+
+def test_q1_vs_oracle(oracle):
+    rows = run_query(build_q1, "tiny", 1 << 14)
+    exp = oracle.query("""
+        SELECT l_returnflag, l_linestatus, sum(l_quantity), sum(l_extendedprice),
+               sum(l_extendedprice * (1 - l_discount)),
+               sum(l_extendedprice * (1 - l_discount) * (1 + l_tax)),
+               avg(l_quantity), avg(l_extendedprice), avg(l_discount), count(*)
+        FROM lineitem
+        WHERE l_shipdate <= 10471
+        GROUP BY l_returnflag, l_linestatus
+        ORDER BY l_returnflag, l_linestatus
+    """)  # 1998-12-01 - 90 days = 10470 days since epoch
+    # our output: group keys + aggregates; sqlite may order differently -> unordered cmp
+    assert len(rows) == len(exp) > 0
+    assert_rows_equal(rows, exp, rel_tol=1e-9)
