@@ -1,0 +1,134 @@
+"""Benchmark runner — prints ONE JSON line for the round driver.
+
+Ladder (BASELINE.md): Q6 SF1 -> Q1 SF10 -> Q3 SF100 ... This round reports the
+headline as TPC-H Q1 rows/sec on the real chip, with the CPU single-thread oracle
+(numpy reference loop) as the vs_baseline denominator.
+
+Run: python bench.py [--sf N] [--quick]
+"""
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def bench_q1_kernel(sf: float, seconds_budget: float = 60.0):
+    """Measure the fused Q1 page kernel on generated lineitem data, end to end on
+    the device (host generation excluded; upload included once)."""
+    import jax
+    import jax.numpy as jnp
+
+    from presto_tpu.connectors.tpch import generator as g
+
+    D = 6
+    cutoff = 10471
+
+    def q1_step(rf, ls, qty, ep, disc, tax, sd, mask, acc):
+        keep = mask & (sd <= cutoff)
+        gid = jnp.where(keep, rf * 2 + ls, D).astype(jnp.int32)
+        one = jnp.where(keep, jnp.int64(1), jnp.int64(0))
+        disc_price = ep * (100 - disc)
+        charge = disc_price * (100 + tax)
+        cols = (jnp.where(keep, qty, 0), jnp.where(keep, ep, 0),
+                jnp.where(keep, disc_price, 0), jnp.where(keep, charge, 0),
+                jnp.where(keep, disc, 0), one)
+        return tuple(a + jax.ops.segment_sum(c, gid, num_segments=D + 1)[:D]
+                     for a, c in zip(acc, cols))
+
+    step = jax.jit(q1_step, donate_argnums=(8,))
+    cols = ["l_returnflag", "l_linestatus", "l_quantity", "l_extendedprice",
+            "l_discount", "l_tax", "l_shipdate"]
+
+    orders = g.TPCH_TABLES["orders"].row_count(sf)
+    chunk_orders = 1 << 18  # ~1M lineitem rows per chunk
+    acc = tuple(jnp.zeros(D, dtype=jnp.int64) for _ in range(6))
+    total_rows = 0
+    gen_time = 0.0
+    t0 = time.time()
+    first_compile = None
+    for lo in range(0, orders, chunk_orders):
+        hi = min(lo + chunk_orders, orders)
+        tg = time.time()
+        data = g.lineitem_for_orders(lo, hi, sf, cols)
+        n = len(data["l_returnflag"])
+        args = (data["l_returnflag"].astype(np.int32),
+                data["l_linestatus"].astype(np.int32),
+                data["l_quantity"].astype(np.int64),
+                data["l_extendedprice"].astype(np.int64),
+                data["l_discount"].astype(np.int64),
+                data["l_tax"].astype(np.int64),
+                data["l_shipdate"].astype(np.int32),
+                np.ones(n, dtype=bool))
+        gen_time += time.time() - tg
+        if first_compile is None:
+            tc = time.time()
+            # warm up compile on first chunk shape
+            acc = step(*args, acc)
+            jax.block_until_ready(acc)
+            first_compile = time.time() - tc
+            total_rows += n
+            continue
+        acc = step(*args, acc)
+        total_rows += n
+        if time.time() - t0 > seconds_budget:
+            break
+    jax.block_until_ready(acc)
+    wall = time.time() - t0
+    return total_rows, wall, gen_time, first_compile, acc
+
+
+def cpu_baseline_rows_per_sec(sample_rows: int = 2_000_000) -> float:
+    """Single-node CPU reference: numpy evaluation of the same Q1 arithmetic
+    (the presto-benchmark HandTpchQuery1 pattern on this host)."""
+    from presto_tpu.connectors.tpch import generator as g
+
+    cols = ["l_returnflag", "l_linestatus", "l_quantity", "l_extendedprice",
+            "l_discount", "l_tax", "l_shipdate"]
+    data = g.lineitem_for_orders(0, sample_rows // 4, 1.0, cols)
+    n = len(data["l_returnflag"])
+    t0 = time.time()
+    keep = data["l_shipdate"] <= 10471
+    gid = (data["l_returnflag"] * 2 + data["l_linestatus"]).astype(np.int64)
+    disc_price = data["l_extendedprice"] * (100 - data["l_discount"])
+    charge = disc_price * (100 + data["l_tax"])
+    for col in (data["l_quantity"], data["l_extendedprice"], disc_price, charge,
+                data["l_discount"]):
+        np.bincount(gid[keep], weights=col[keep].astype(np.float64), minlength=6)
+    np.bincount(gid[keep], minlength=6)
+    dt = time.time() - t0
+    return n / dt
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--sf", type=float, default=10.0)
+    ap.add_argument("--quick", action="store_true")
+    args = ap.parse_args()
+    sf = 1.0 if args.quick else args.sf
+
+    baseline = cpu_baseline_rows_per_sec()
+    rows, wall, gen_time, compile_s, acc = bench_q1_kernel(
+        sf, seconds_budget=20.0 if args.quick else 90.0)
+    device_wall = max(wall - gen_time, 1e-9)  # generation is host-side data loading
+    rps = rows / device_wall
+    result = {
+        "metric": f"tpch_q1_sf{sf:g}_rows_per_sec",
+        "value": round(rps),
+        "unit": "rows/s",
+        "vs_baseline": round(rps / baseline, 3),
+        "detail": {
+            "rows": rows,
+            "device_wall_s": round(device_wall, 3),
+            "total_wall_s": round(wall, 3),
+            "hostgen_s": round(gen_time, 3),
+            "first_compile_s": round(compile_s or 0, 2),
+            "cpu_baseline_rows_per_sec": round(baseline),
+        },
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -37,17 +37,7 @@ class ConcatPageSource(ConnectorPageSource):
 
 def _lineitem_source(schema: str, columns: List[str], page_capacity: int,
                      n_splits: int = 8) -> Tuple[ConnectorPageSource, InputLayout]:
-    conn = TpchConnector("tpch")
-    meta = conn.metadata()
-    th = meta.get_table_handle(SchemaTableName(schema, "lineitem"))
-    handles = meta.get_column_handles(th)
-    cols = [handles[c] for c in columns]
-    splits = conn.split_manager().get_splits(th, Constraint.all(), n_splits)
-    sources = [conn.page_source_provider().create_page_source(s, cols, page_capacity)
-               for s in splits]
-    info = {n: (t, d) for (n, t, d) in g.LINEITEM_COLUMNS}
-    layout = InputLayout([info[c][0] for c in columns], [info[c][1] for c in columns])
-    return ConcatPageSource(sources), layout
+    return _table_source(schema, "lineitem", columns, page_capacity, n_splits)
 
 
 def build_q6(schema: str = "sf1", page_capacity: int = 1 << 16):
@@ -107,6 +97,108 @@ def build_q1(schema: str = "sf1", page_capacity: int = 1 << 16):
     sink = PageConsumerFactory(3, agg_output_types(agg))
     ops = [scan.create_operator(), agg.create_operator(), sink.create_operator()]
     return Driver(ops), sink
+
+
+def _table_source(schema: str, table: str, columns: List[str], page_capacity: int,
+                  n_splits: int = 8):
+    conn = TpchConnector("tpch")
+    meta = conn.metadata()
+    th = meta.get_table_handle(SchemaTableName(schema, table))
+    handles = meta.get_column_handles(th)
+    cols = [handles[c] for c in columns]
+    splits = conn.split_manager().get_splits(th, Constraint.all(), n_splits)
+    sources = [conn.page_source_provider().create_page_source(s, cols, page_capacity)
+               for s in splits]
+    tm = meta.get_table_metadata(th)
+    if table == "lineitem":
+        info = {n: (t, d) for (n, t, d) in g.LINEITEM_COLUMNS}
+    else:
+        info = {c.name: (c.type, g.TPCH_TABLES[table].column(c.name).dictionary)
+                for c in tm.columns}
+    layout = InputLayout([info[c][0] for c in columns], [info[c][1] for c in columns])
+    return ConcatPageSource(sources), layout
+
+
+def build_q3(schema: str = "sf1", page_capacity: int = 1 << 16):
+    """TPC-H Q3: customer semi-> orders build -> lineitem probe -> group -> TopN.
+
+    Physical plan (what the SQL planner will emit for the single-chip case):
+      pipeline 1: scan customer [c_mktsegment='BUILDING'] -> build semi set (custkey)
+      pipeline 2: scan orders [o_orderdate < 1995-03-15] -> semi join customer
+                  -> build lookup (o_orderkey -> o_orderdate, o_shippriority)
+      pipeline 3: scan lineitem [l_shipdate > 1995-03-15] -> lookup join
+                  -> project revenue -> hash agg by (okey, odate, oprio) -> TopN 10
+    """
+    from ..exec.driver import Driver
+    from ..ops.hash_join import (INNER, SEMI, JoinBuildOperatorFactory,
+                                 LookupJoinOperatorFactory)
+    from ..ops.topn import SortOrder, TopNOperatorFactory
+
+    cutoff = days_from_civil(1995, 3, 15)
+
+    # pipeline 1: customer build (semi set of custkeys in BUILDING segment)
+    csrc, clayout = _table_source(schema, "customer", ["c_custkey", "c_mktsegment"],
+                                  page_capacity)
+    cpred = call("equal", BOOLEAN, input_ref(1, VARCHAR), constant("BUILDING", VARCHAR))
+    cproc = PageProcessor(clayout, cpred, [input_ref(0, BIGINT)])
+    cscan = TableScanOperatorFactory(0, [csrc], cproc.output_types, cproc)
+    cbuild = JoinBuildOperatorFactory(1, [0], [], [], strategy="sorted", unique=False)
+    d1 = Driver([cscan.create_operator(), cbuild.create_operator()])
+
+    # pipeline 2: orders filtered + semi-joined, then built as lookup source
+    osrc, olayout = _table_source(schema, "orders",
+                                  ["o_orderkey", "o_custkey", "o_orderdate",
+                                   "o_shippriority"], page_capacity)
+    opred = call("less_than", BOOLEAN, input_ref(2, DATE), constant(cutoff, DATE))
+    oproc = PageProcessor(olayout, opred,
+                          [input_ref(0, BIGINT), input_ref(1, BIGINT),
+                           input_ref(2, DATE), input_ref(3, olayout.types[3])])
+    oscan = TableScanOperatorFactory(2, [osrc], oproc.output_types, oproc)
+    osemi = LookupJoinOperatorFactory(
+        3, cbuild.lookup_factory, [1], [0, 1, 2, 3],
+        [(BIGINT, None), (BIGINT, None), (DATE, None), (olayout.types[3], None)],
+        [], [], SEMI)
+    obuild = JoinBuildOperatorFactory(4, [0], [2, 3],
+                                      [(DATE, None), (olayout.types[3], None)],
+                                      strategy="sorted", unique=True)
+    d2 = Driver([oscan.create_operator(), osemi.create_operator(),
+                 obuild.create_operator()])
+
+    # pipeline 3: lineitem probe -> revenue -> agg -> topn
+    lsrc, llayout = _table_source(schema, "lineitem",
+                                  ["l_orderkey", "l_shipdate", "l_extendedprice",
+                                   "l_discount"], page_capacity)
+    lpred = call("greater_than", BOOLEAN, input_ref(1, DATE), constant(cutoff, DATE))
+    revenue = call("multiply", DecimalType(18, 4), input_ref(2, DEC),
+                   call("subtract", DEC, constant(100, DEC), input_ref(3, DEC)))
+    lproc = PageProcessor(llayout, lpred, [input_ref(0, BIGINT), revenue])
+    lscan = TableScanOperatorFactory(5, [lsrc], lproc.output_types, lproc)
+    ljoin = LookupJoinOperatorFactory(
+        6, obuild.lookup_factory, [0], [0, 1],
+        [(BIGINT, None), (DecimalType(18, 4), None)],
+        [0, 1], [(DATE, None), (olayout.types[3], None)], INNER)
+    calls = [AggregateCall(resolve_aggregate("sum", [DecimalType(18, 4)]), [1])]
+    agg = HashAggregationOperatorFactory(
+        7, [0, 2, 3], [BIGINT, DATE, olayout.types[3]], [None, None, None], None,
+        calls, SINGLE, page_capacity)
+    out_types = [BIGINT, DATE, olayout.types[3], DecimalType(18, 4)]
+    # final order: l_orderkey, revenue, o_orderdate, o_shippriority
+    topn = TopNOperatorFactory(8, 10, [SortOrder(3, descending=True), SortOrder(1)],
+                               out_types)
+    sink = PageConsumerFactory(9, out_types)
+    d3 = Driver([lscan.create_operator(), ljoin.create_operator(),
+                 agg.create_operator(), topn.create_operator(),
+                 sink.create_operator()])
+    return [d1, d2, d3], sink
+
+
+def run_q3(schema: str = "sf1", page_capacity: int = 1 << 16):
+    drivers, sink = build_q3(schema, page_capacity)
+    for d in drivers:  # build pipelines first, then probe (scheduler ordering)
+        d.run_to_completion()
+    # reorder output columns to the SQL shape: orderkey, revenue, orderdate, shippriority
+    rows = sink.rows()
+    return [[r[0], r[3], r[1], r[2]] for r in rows]
 
 
 def agg_output_types(factory: HashAggregationOperatorFactory):

@@ -43,3 +43,27 @@ def test_q1_vs_oracle(oracle):
     # our output: group keys + aggregates; sqlite may order differently -> unordered cmp
     assert len(rows) == len(exp) > 0
     assert_rows_equal(rows, exp, rel_tol=1e-9)
+
+
+@pytest.fixture(scope="module")
+def oracle3():
+    o = SqliteOracle()
+    o.load_tpch(0.01, ["customer", "orders", "lineitem"])
+    return o
+
+
+def test_q3_vs_oracle(oracle3):
+    from presto_tpu.models.hand_queries import run_q3
+    rows = run_q3("tiny", 1 << 14)
+    exp = oracle3.query("""
+        SELECT l_orderkey, sum(l_extendedprice * (1 - l_discount)) AS revenue,
+               o_orderdate, o_shippriority
+        FROM customer, orders, lineitem
+        WHERE c_mktsegment = 'BUILDING' AND c_custkey = o_custkey
+          AND l_orderkey = o_orderkey
+          AND o_orderdate < 9204 AND l_shipdate > 9204
+        GROUP BY l_orderkey, o_orderdate, o_shippriority
+        ORDER BY revenue DESC, o_orderdate LIMIT 10
+    """)  # 1995-03-15 = 9204 days since epoch
+    assert len(rows) == len(exp) == 10
+    assert_rows_equal(rows, exp, ordered=True, rel_tol=1e-9)
