@@ -21,21 +21,13 @@ def bench_q1_kernel(sf: float, seconds_budget: float = 60.0):
     import jax.numpy as jnp
 
     from presto_tpu.connectors.tpch import generator as g
+    from presto_tpu.models.kernels import q1_partials
 
     D = 6
-    cutoff = 10471
 
     def q1_step(rf, ls, qty, ep, disc, tax, sd, mask, acc):
-        keep = mask & (sd <= cutoff)
-        gid = jnp.where(keep, rf * 2 + ls, D).astype(jnp.int32)
-        one = jnp.where(keep, jnp.int64(1), jnp.int64(0))
-        disc_price = ep * (100 - disc)
-        charge = disc_price * (100 + tax)
-        cols = (jnp.where(keep, qty, 0), jnp.where(keep, ep, 0),
-                jnp.where(keep, disc_price, 0), jnp.where(keep, charge, 0),
-                jnp.where(keep, disc, 0), one)
-        return tuple(a + jax.ops.segment_sum(c, gid, num_segments=D + 1)[:D]
-                     for a, c in zip(acc, cols))
+        part = q1_partials(rf, ls, qty, ep, disc, tax, sd, mask)
+        return tuple(a + p for a, p in zip(acc, part))
 
     step = jax.jit(q1_step, donate_argnums=(8,))
     cols = ["l_returnflag", "l_linestatus", "l_quantity", "l_extendedprice",

@@ -1,0 +1,39 @@
+"""Shared hand-built query kernels.
+
+The fused TPC-H Q1 page kernel (filter + decimal projections + direct grouped
+aggregation) is the engine's flagship single-chip program — the analogue of
+presto-benchmark's HandTpchQuery1.java pipeline. It is defined ONCE here and wrapped
+by the bench (bench.py), the compile-check entry (__graft_entry__.entry) and the
+distributed Q1 stage (parallel/distributed.dist_q1_step), so the arithmetic can
+never diverge between them.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+# 1998-12-01 minus 90 days, as days since epoch (the Q1 shipdate cutoff)
+Q1_CUTOFF_DAYS = 10471
+Q1_N_FLAGS = 3    # l_returnflag domain: A N R
+Q1_N_STATUS = 2   # l_linestatus domain: F O
+
+
+def q1_partials(rf, ls, qty, ep, disc, tax, sd, mask,
+                cutoff=Q1_CUTOFF_DAYS, n_flags=Q1_N_FLAGS, n_status=Q1_N_STATUS):
+    """One page of TPC-H Q1 -> per-group partial sums (dense direct grouping).
+
+    Inputs: rf/ls int32 dictionary codes, qty/ep/disc/tax int64 scaled decimals
+    (cents), sd int32 days, mask live rows. Returns a tuple of 6 int64 arrays of
+    shape (n_flags*n_status,): sum_qty, sum_base_price, sum_disc_price(scale 4),
+    sum_charge(scale 6), sum_disc, count.
+    """
+    D = n_flags * n_status
+    keep = mask & (sd <= jnp.int32(cutoff))
+    gid = jnp.where(keep, rf * n_status + ls, D).astype(jnp.int32)
+    one = jnp.where(keep, jnp.int64(1), jnp.int64(0))
+    disc_price = ep * (100 - disc)        # scale 2+2 = 4
+    charge = disc_price * (100 + tax)     # scale 4+2 = 6
+    cols = (jnp.where(keep, qty, 0), jnp.where(keep, ep, 0),
+            jnp.where(keep, disc_price, 0), jnp.where(keep, charge, 0),
+            jnp.where(keep, disc, 0), one)
+    return tuple(jax.ops.segment_sum(c, gid, num_segments=D + 1)[:D] for c in cols)

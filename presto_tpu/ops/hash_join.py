@@ -78,6 +78,8 @@ class LookupSource:
     sorted_row: Optional[jnp.ndarray] = None  # (n,) int32 original row index
     # per-payload-column null masks (None entries = column has no nulls):
     payload_nulls: Tuple = ()
+    # whether any live build row had a NULL key (drives null-aware NOT IN semantics)
+    has_null_key: bool = False
 
     @property
     def exact_keys(self) -> bool:
@@ -116,6 +118,7 @@ class JoinBuildOperator(Operator):
         super().__init__(context)
         self.f = factory
         self._pages: List[Page] = []
+        self._saw_null_key = None  # device bool accumulator, synced once at build
 
     @property
     def output_types(self) -> List[Type]:
@@ -124,6 +127,11 @@ class JoinBuildOperator(Operator):
     @timed("add_input_ns")
     def add_input(self, page: Page) -> None:
         self.context.record_input(page, page.capacity)
+        for c in self.f.key_channels:
+            if page.blocks[c].nulls is not None:
+                seen = jnp.any(page.blocks[c].nulls & page.mask)
+                self._saw_null_key = seen if self._saw_null_key is None \
+                    else (self._saw_null_key | seen)
         self._pages.append(_compact_for_build(page, tuple(self.f.key_channels),
                                               tuple(self.f.payload_channels)))
 
@@ -172,6 +180,7 @@ class JoinBuildOperator(Operator):
             src = _build_sorted(tuple(keys), tuple(payload), mask, n,
                                 self.f.payload_meta, self.f.unique)
         src.payload_nulls = tuple(payload_nulls)
+        src.has_null_key = bool(self._saw_null_key) if self._saw_null_key is not None else False
         return src
 
     def is_finished(self) -> bool:
@@ -235,6 +244,13 @@ class JoinBuildOperatorFactory(OperatorFactory):
                  strategy: str = "sorted", unique: bool = False,
                  dense_min: int = 0, dense_max: int = 0):
         super().__init__(operator_id, "JoinBuild")
+        if strategy == "dense" and not unique:
+            # the dense table stores ONE row index per key slot — a duplicate build
+            # key would silently keep only the last row; refuse at plan time
+            raise ValueError("dense join strategy requires unique build keys; "
+                             "use strategy='sorted' for duplicate-key builds")
+        if strategy == "dense" and len(key_channels) != 1:
+            raise ValueError("dense join strategy requires a single key channel")
         self.key_channels = key_channels
         self.payload_channels = payload_channels
         self.payload_meta = payload_meta
@@ -355,7 +371,16 @@ class LookupJoinOperator(Operator):
                 blocks = list(sel.blocks) + [Block(BOOLEAN, matched)]
                 self._push(Page(tuple(blocks), page.mask))
             else:
-                keep = matched if jt == SEMI else (~matched & page.mask)
+                if jt == SEMI:
+                    keep = matched
+                else:
+                    keep = ~matched & page.mask
+                    if self.f.null_aware:
+                        # NOT IN: NULL probe key -> UNKNOWN -> filtered; any NULL
+                        # build key makes every non-match UNKNOWN -> empty result
+                        keep = keep & probe_mask
+                        if src.has_null_key:
+                            keep = jnp.zeros_like(keep)
                 sel = page.select_channels(self.f.probe_output_channels)
                 self._push(Page(sel.blocks, page.mask & keep))
             return
@@ -465,7 +490,8 @@ class LookupJoinOperatorFactory(OperatorFactory):
                  probe_output_meta: List[Tuple[Type, Optional[Dictionary]]],
                  build_output_channels: List[int],
                  build_output_meta: List[Tuple[Type, Optional[Dictionary]]],
-                 join_type: str = INNER, semi_output_channel: Optional[int] = None):
+                 join_type: str = INNER, semi_output_channel: Optional[int] = None,
+                 null_aware: bool = False):
         super().__init__(operator_id, f"LookupJoin({join_type})")
         self.lookup_factory = lookup_factory
         self.probe_key_channels = probe_key_channels
@@ -473,8 +499,16 @@ class LookupJoinOperatorFactory(OperatorFactory):
         self.build_output_channels = build_output_channels
         self.join_type = join_type
         self.semi_output_channel = semi_output_channel
+        # null_aware = SQL IN/NOT IN semantics: a NULL probe key (or any NULL build
+        # key on NOT IN) compares UNKNOWN, so the row is filtered. Default False =
+        # EXISTS/NOT EXISTS semantics where a null key simply never matches.
+        self.null_aware = null_aware
         self.output_types = [t for (t, _) in probe_output_meta] + \
                             [t for (t, _) in build_output_meta]
+        if semi_output_channel is not None:
+            from ..types import BOOLEAN
+            # mark-column mode appends the membership flag as the LAST channel
+            self.output_types = [t for (t, _) in probe_output_meta] + [BOOLEAN]
 
     def create_operator(self) -> LookupJoinOperator:
         return LookupJoinOperator(OperatorContext(self.operator_id, self.name), self)

@@ -198,7 +198,7 @@ class OrderByOperator(Operator):
                         nseg = jnp.concatenate(
                             [nseg, jnp.zeros(cap - (hi - lo), jnp.bool_)])
                 blocks.append(Block(b.type, seg, nseg, b.dictionary))
-            m = mask_seg = sorted_page.mask[lo:hi]
+            m = sorted_page.mask[lo:hi]
             if hi - lo < cap:
                 m = jnp.concatenate([m, jnp.zeros(cap - (hi - lo), jnp.bool_)])
             out.append(Page(tuple(blocks), m))

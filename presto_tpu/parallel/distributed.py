@@ -17,18 +17,15 @@ This module carries the two canonical stage shapes:
 """
 from __future__ import annotations
 
-import functools
-from typing import Dict, Optional, Sequence, Tuple
-
 import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
-from jax.sharding import NamedSharding, PartitionSpec as P
+from jax.sharding import PartitionSpec as P
 from jax import shard_map
 
 from ..ops.hash_agg import sort_group_reduce
-from .exchange import broadcast_gather, partition_ids, repartition
+from .exchange import repartition
 from .mesh import WORKER_AXIS, MeshContext
 
 
@@ -39,22 +36,13 @@ def dist_q1_step(mesh_ctx: MeshContext, n_flags: int = 3, n_status: int = 2):
       rf, ls: int32 dictionary codes; qty/ep/disc/tax: int64 cents; sd: int32 days;
       mask: live rows. Output: replicated dense group table (n_flags*n_status groups).
     """
-    D = n_flags * n_status
-    cutoff = jnp.int32(10471)  # 1998-12-01 - 90 days
+    from ..models.kernels import q1_partials
 
     def stage(rf, ls, qty, ep, disc, tax, sd, mask):
-        keep = mask & (sd <= cutoff)
-        gid = jnp.where(keep, rf * n_status + ls, D)
-        one = jnp.where(keep, jnp.int64(1), jnp.int64(0))
-        disc_price = ep * (100 - disc)          # scale 4
-        charge = disc_price * (100 + tax)       # scale 6
-        cols = [jnp.where(keep, qty, 0), jnp.where(keep, ep, 0),
-                jnp.where(keep, disc_price, 0), jnp.where(keep, charge, 0),
-                jnp.where(keep, disc, 0), one]
-        sums = [jax.ops.segment_sum(c, gid, num_segments=D + 1)[:D] for c in cols]
+        sums = q1_partials(rf, ls, qty, ep, disc, tax, sd, mask,
+                           n_flags=n_flags, n_status=n_status)
         # final exchange: one psum replaces the entire partial->final HTTP shuffle
-        sums = [lax.psum(s, WORKER_AXIS) for s in sums]
-        return tuple(sums)
+        return tuple(lax.psum(s, WORKER_AXIS) for s in sums)
 
     mesh = mesh_ctx.mesh
     sharded = P(WORKER_AXIS)
@@ -126,9 +114,13 @@ def dist_grouped_agg_step(mesh_ctx: MeshContext, n_keys: int, n_states: int,
             list(gkeys) + list(gstates), gvalid, gkeys[0], W, max_groups)
         rkeys = tuple(arrs[:n_keys])
         rstates = tuple(arrs[n_keys:])
-        fkeys, fstates, fvalid, _ = sort_group_reduce(
+        fkeys, fstates, fvalid, fnum = sort_group_reduce(
             rkeys, m, rstates, kinds, identities, max_groups)
-        return fkeys + fstates + (fvalid, lax.psum(dropped, WORKER_AXIS))
+        # distinct groups beyond max_groups land in sort_group_reduce's trash bin;
+        # surface them in the drop count so callers can fail loudly instead of
+        # accepting silently truncated aggregates
+        overflow = jnp.maximum(fnum - max_groups, 0).astype(dropped.dtype)
+        return fkeys + fstates + (fvalid, lax.psum(dropped + overflow, WORKER_AXIS))
 
     mesh = mesh_ctx.mesh
     s = P(WORKER_AXIS)

@@ -30,16 +30,16 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..ops.hash_join import _mix64
 from .mesh import WORKER_AXIS
 
 
 def partition_ids(key: jnp.ndarray, n_parts: int) -> jnp.ndarray:
     """Row -> target partition (PartitionFunction.getPartition analogue): mix then mod
-    so dense keys spread (HashGenerationOptimizer's raw-hash + modulo)."""
-    x = key.astype(jnp.uint64)
-    x = (x ^ (x >> 33)) * jnp.uint64(0xFF51AFD7ED558CCD)
-    x = (x ^ (x >> 33)) * jnp.uint64(0xC4CEB9FE1A85EC53)
-    x = x ^ (x >> 33)
+    so dense keys spread (HashGenerationOptimizer's raw-hash + modulo). Uses the SAME
+    mix as the join kernels' combined_key so exchange routing and build hashing can
+    never diverge."""
+    x = _mix64(key)
     return (x % jnp.uint64(n_parts)).astype(jnp.int32)
 
 

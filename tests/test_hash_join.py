@@ -98,8 +98,6 @@ def test_expansion_exceeds_page_capacity():
     rows = run_join([build], [probe], bf, pf)
     assert len(rows) == 16
     got = sorted((r[0], r[1]) for r in rows)
-    exp = sorted((int(k), int(v)) for k, v in zip(bkeys, bvals) for _ in (0,)
-                 for _k in [None]) if False else None
     # each probe key k matches the 4 build rows with that key; probe has 1,2,3,1
     expect = []
     for pk in pkeys:

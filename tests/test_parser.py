@@ -1,0 +1,129 @@
+"""Parser ring-1 tests (TestSqlParser analogue: presto-parser/src/test/.../TestSqlParser.java)."""
+import pytest
+
+from presto_tpu.sql import tree as t
+from presto_tpu.sql.parser import ParsingException, SqlParser
+from presto_tpu.models.tpch_sql import QUERIES
+
+P = SqlParser()
+
+
+def test_simple_select():
+    q = P.parse("SELECT a, b AS x FROM t WHERE a > 1")
+    spec = q.body
+    assert isinstance(spec, t.QuerySpecification)
+    assert len(spec.select_items) == 2
+    assert spec.select_items[1].alias == "x"
+    assert isinstance(spec.where, t.ComparisonExpression)
+    assert spec.where.op == ">"
+
+
+def test_precedence():
+    e = P.parse_expression("a + b * c - d")
+    # ((a + (b*c)) - d)
+    assert isinstance(e, t.ArithmeticBinary) and e.op == "-"
+    assert isinstance(e.left, t.ArithmeticBinary) and e.left.op == "+"
+    assert isinstance(e.left.right, t.ArithmeticBinary) and e.left.right.op == "*"
+
+    e = P.parse_expression("a or b and not c = d")
+    assert isinstance(e, t.LogicalBinary) and e.op == "OR"
+    assert isinstance(e.right, t.LogicalBinary) and e.right.op == "AND"
+    assert isinstance(e.right.right, t.NotExpression)
+
+
+def test_between_and_in():
+    e = P.parse_expression("x between 1 and 2 + 3")
+    assert isinstance(e, t.BetweenPredicate)
+    e = P.parse_expression("x not in (1, 2, 3)")
+    assert isinstance(e, t.NotExpression)
+    assert isinstance(e.value, t.InPredicate)
+    assert isinstance(e.value.value_list, t.InListExpression)
+    assert len(e.value.value_list.values) == 3
+
+
+def test_case_cast_extract():
+    e = P.parse_expression("case when a = 1 then 'x' else 'y' end")
+    assert isinstance(e, t.SearchedCaseExpression)
+    e = P.parse_expression("cast(a as decimal(12,2))")
+    assert isinstance(e, t.Cast)
+    assert e.type.name == "decimal" and e.type.parameters == (12, 2)
+    e = P.parse_expression("extract(year from o_orderdate)")
+    assert isinstance(e, t.Extract) and e.field == "YEAR"
+
+
+def test_date_interval():
+    e = P.parse_expression("date '1994-01-01' + interval '1' year")
+    assert isinstance(e, t.ArithmeticBinary)
+    assert isinstance(e.left, t.DateLiteral)
+    assert isinstance(e.right, t.IntervalLiteral)
+    assert e.right.unit == "year"
+
+
+def test_joins():
+    q = P.parse("select * from a join b on a.x = b.y left join c on b.z = c.z")
+    j = q.body.from_
+    assert isinstance(j, t.Join) and j.type == "LEFT"
+    assert isinstance(j.left, t.Join) and j.left.type == "INNER"
+
+
+def test_implicit_join_and_alias():
+    q = P.parse("select n1.n_name from nation n1, nation n2 where n1.n_nationkey = n2.n_nationkey")
+    j = q.body.from_
+    assert isinstance(j, t.Join) and j.type == "IMPLICIT"
+    assert isinstance(j.left, t.AliasedRelation) and j.left.alias == "n1"
+
+
+def test_subqueries():
+    q = P.parse("select * from t where x = (select max(y) from u)")
+    w = q.body.where
+    assert isinstance(w.right, t.SubqueryExpression)
+    q = P.parse("select * from t where exists (select * from u where u.a = t.a)")
+    assert isinstance(q.body.where, t.ExistsPredicate)
+    q = P.parse("select * from (select a from t) as s")
+    assert isinstance(q.body.from_, t.AliasedRelation)
+    assert isinstance(q.body.from_.relation, t.TableSubquery)
+
+
+def test_group_order_limit():
+    q = P.parse("select a, sum(b) from t group by a having sum(b) > 10 "
+                "order by 2 desc, a limit 5")
+    spec = q.body
+    assert spec.group_by and spec.having is not None
+    assert spec.order_by[0].descending
+    assert spec.limit == 5
+
+
+def test_with_and_union():
+    q = P.parse("with r as (select a from t) select * from r union all select * from r")
+    assert q.with_ is not None
+    assert isinstance(q.body, t.SetOperation)
+    assert q.body.op == "UNION" and not q.body.distinct
+
+
+def test_function_distinct_and_star():
+    q = P.parse("select count(*), count(distinct x), t.* from t")
+    items = q.body.select_items
+    assert isinstance(items[0].expression, t.FunctionCall)
+    assert items[0].expression.args == ()
+    assert items[1].expression.distinct
+    assert isinstance(items[2].expression, t.Star) and items[2].expression.qualifier == "t"
+
+
+def test_errors_have_position():
+    with pytest.raises(ParsingException):
+        P.parse("select from where")
+    with pytest.raises(ParsingException):
+        P.parse("select a from t where")
+
+
+def test_explain_and_show():
+    e = P.parse("explain analyze select 1")
+    assert isinstance(e, t.Explain) and e.analyze
+    s = P.parse("show tables from tpch.tiny")
+    assert isinstance(s, t.ShowTables)
+
+
+@pytest.mark.parametrize("qnum", sorted(QUERIES))
+def test_parses_all_tpch(qnum):
+    stmt = P.parse(QUERIES[qnum])
+    assert isinstance(stmt, t.Query)
