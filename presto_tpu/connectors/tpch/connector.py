@@ -57,8 +57,23 @@ class TpchMetadata(ConnectorMetadata):
         return None
 
     def get_table_metadata(self, table: TableHandle) -> TableMetadata:
-        cols = tuple(ColumnMetadata(n, t) for (n, t, _) in _columns_of(table.schema_table.table))
+        cols = tuple(ColumnMetadata(n, t, dictionary=d)
+                     for (n, t, d) in _columns_of(table.schema_table.table))
         return TableMetadata(table.schema_table, cols)
+
+    _UNIQUE_KEYS = {
+        "region": [("r_regionkey",)],
+        "nation": [("n_nationkey",)],
+        "supplier": [("s_suppkey",)],
+        "part": [("p_partkey",)],
+        "partsupp": [("ps_partkey", "ps_suppkey")],
+        "customer": [("c_custkey",)],
+        "orders": [("o_orderkey",)],
+        "lineitem": [("l_orderkey", "l_linenumber")],
+    }
+
+    def get_unique_column_sets(self, table: TableHandle):
+        return list(self._UNIQUE_KEYS.get(table.schema_table.table, []))
 
     def get_table_statistics(self, table: TableHandle, constraint: Constraint) -> TableStatistics:
         name = table.schema_table.table

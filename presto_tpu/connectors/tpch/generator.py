@@ -24,7 +24,7 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from ...block import Dictionary
-from ...types import (BIGINT, DATE, INTEGER, Type, VARCHAR, DecimalType)
+from ...types import (BIGINT, DATE, INTEGER, Type, VARCHAR, WIDE_VARCHAR, DecimalType)
 
 DEC = DecimalType(12, 2)
 
@@ -271,7 +271,7 @@ def _make_region() -> TpchTable:
     return TpchTable("region", 0, lambda sf: 5, [
         TpchColumn("r_regionkey", BIGINT, lambda i, sf: i.astype(np.int64)),
         TpchColumn("r_name", VARCHAR, lambda i, sf: i.astype(np.int32), DICT_REGION_NAME),
-        TpchColumn("r_comment", VARCHAR, lambda i, sf: _comment_codes(0, 2, i), DICT_COMMENT),
+        TpchColumn("r_comment", WIDE_VARCHAR, lambda i, sf: _comment_codes(0, 2, i), DICT_COMMENT),
     ])
 
 
@@ -281,7 +281,7 @@ def _make_nation() -> TpchTable:
         TpchColumn("n_nationkey", BIGINT, lambda i, sf: i.astype(np.int64)),
         TpchColumn("n_name", VARCHAR, lambda i, sf: i.astype(np.int32), DICT_NATION_NAME),
         TpchColumn("n_regionkey", BIGINT, lambda i, sf: regionkeys[i]),
-        TpchColumn("n_comment", VARCHAR, lambda i, sf: _comment_codes(1, 3, i), DICT_COMMENT),
+        TpchColumn("n_comment", WIDE_VARCHAR, lambda i, sf: _comment_codes(1, 3, i), DICT_COMMENT),
     ])
 
 
@@ -290,13 +290,13 @@ def _make_supplier() -> TpchTable:
     return TpchTable("supplier", T, lambda sf: int(sf * 10_000), [
         TpchColumn("s_suppkey", BIGINT, lambda i, sf: i.astype(np.int64) + 1),
         TpchColumn("s_name", VARCHAR, lambda i, sf: (i + 1).astype(np.int32), DICT_SUPP_NAME),
-        TpchColumn("s_address", VARCHAR, lambda i, sf: _stream(T, 2, i).astype(np.int64) % (1 << 40),
+        TpchColumn("s_address", WIDE_VARCHAR, lambda i, sf: _stream(T, 2, i).astype(np.int64) % (1 << 40),
                    DICT_ADDRESS),
         TpchColumn("s_nationkey", BIGINT, lambda i, sf: _uniform(T, 3, i, 0, 24)),
-        TpchColumn("s_phone", VARCHAR, lambda i, sf: _stream(T, 4, i).astype(np.int64) % (1 << 40),
+        TpchColumn("s_phone", WIDE_VARCHAR, lambda i, sf: _stream(T, 4, i).astype(np.int64) % (1 << 40),
                    DICT_PHONE),
         TpchColumn("s_acctbal", DEC, lambda i, sf: _acctbal_cents(T, 5, i)),
-        TpchColumn("s_comment", VARCHAR, lambda i, sf: _comment_codes(T, 6, i), DICT_COMMENT),
+        TpchColumn("s_comment", WIDE_VARCHAR, lambda i, sf: _comment_codes(T, 6, i), DICT_COMMENT),
     ])
 
 
@@ -309,7 +309,7 @@ def _make_part() -> TpchTable:
 
     return TpchTable("part", T, lambda sf: int(sf * 200_000), [
         TpchColumn("p_partkey", BIGINT, lambda i, sf: i.astype(np.int64) + 1),
-        TpchColumn("p_name", VARCHAR, name_codes, DICT_P_NAME),
+        TpchColumn("p_name", WIDE_VARCHAR, name_codes, DICT_P_NAME),
         TpchColumn("p_mfgr", VARCHAR, lambda i, sf: _uniform(T, 2, i, 0, 4).astype(np.int32),
                    DICT_MFGR),
         TpchColumn("p_brand", VARCHAR, lambda i, sf: (
@@ -320,7 +320,7 @@ def _make_part() -> TpchTable:
         TpchColumn("p_container", VARCHAR, lambda i, sf: _uniform(T, 6, i, 0, 39).astype(np.int32),
                    DICT_CONTAINER),
         TpchColumn("p_retailprice", DEC, lambda i, sf: _retail_price_cents(i + 1)),
-        TpchColumn("p_comment", VARCHAR, lambda i, sf: _comment_codes(T, 7, i), DICT_COMMENT),
+        TpchColumn("p_comment", WIDE_VARCHAR, lambda i, sf: _comment_codes(T, 7, i), DICT_COMMENT),
     ])
 
 
@@ -339,7 +339,7 @@ def _make_partsupp() -> TpchTable:
                    lambda i, sf: _supplier_for((i // 4) + 1, i % 4, sf)),
         TpchColumn("ps_availqty", INTEGER, lambda i, sf: _uniform(T, 2, i, 1, 9999).astype(np.int32)),
         TpchColumn("ps_supplycost", DEC, lambda i, sf: _uniform(T, 3, i, 100, 100000)),
-        TpchColumn("ps_comment", VARCHAR, lambda i, sf: _comment_codes(T, 4, i), DICT_COMMENT),
+        TpchColumn("ps_comment", WIDE_VARCHAR, lambda i, sf: _comment_codes(T, 4, i), DICT_COMMENT),
     ])
 
 
@@ -348,15 +348,15 @@ def _make_customer() -> TpchTable:
     return TpchTable("customer", T, lambda sf: int(sf * 150_000), [
         TpchColumn("c_custkey", BIGINT, lambda i, sf: i.astype(np.int64) + 1),
         TpchColumn("c_name", VARCHAR, lambda i, sf: (i + 1).astype(np.int32), DICT_CUST_NAME),
-        TpchColumn("c_address", VARCHAR, lambda i, sf: _stream(T, 2, i).astype(np.int64) % (1 << 40),
+        TpchColumn("c_address", WIDE_VARCHAR, lambda i, sf: _stream(T, 2, i).astype(np.int64) % (1 << 40),
                    DICT_ADDRESS),
         TpchColumn("c_nationkey", BIGINT, lambda i, sf: _uniform(T, 3, i, 0, 24)),
-        TpchColumn("c_phone", VARCHAR, lambda i, sf: _stream(T, 4, i).astype(np.int64) % (1 << 40),
+        TpchColumn("c_phone", WIDE_VARCHAR, lambda i, sf: _stream(T, 4, i).astype(np.int64) % (1 << 40),
                    DICT_PHONE),
         TpchColumn("c_acctbal", DEC, lambda i, sf: _acctbal_cents(T, 5, i)),
         TpchColumn("c_mktsegment", VARCHAR, lambda i, sf: _uniform(T, 6, i, 0, 4).astype(np.int32),
                    DICT_SEGMENT),
-        TpchColumn("c_comment", VARCHAR, lambda i, sf: _comment_codes(T, 7, i), DICT_COMMENT),
+        TpchColumn("c_comment", WIDE_VARCHAR, lambda i, sf: _comment_codes(T, 7, i), DICT_COMMENT),
     ])
 
 
@@ -387,7 +387,7 @@ def _make_orders() -> TpchTable:
                    lambda i, sf: _uniform(T, 6, i, 1, max(int(sf * 1000), 1)).astype(np.int32),
                    DICT_CLERK),
         TpchColumn("o_shippriority", INTEGER, lambda i, sf: np.zeros(len(i), dtype=np.int32)),
-        TpchColumn("o_comment", VARCHAR, lambda i, sf: _comment_codes(T, 8, i), DICT_COMMENT),
+        TpchColumn("o_comment", WIDE_VARCHAR, lambda i, sf: _comment_codes(T, 8, i), DICT_COMMENT),
     ])
 
 
@@ -462,7 +462,7 @@ LINEITEM_COLUMNS: List[Tuple[str, Type, Optional[Dictionary]]] = [
     ("l_receiptdate", DATE, None),
     ("l_shipinstruct", VARCHAR, DICT_SHIP_INSTRUCT),
     ("l_shipmode", VARCHAR, DICT_SHIP_MODE),
-    ("l_comment", VARCHAR, DICT_COMMENT),
+    ("l_comment", WIDE_VARCHAR, DICT_COMMENT),
 ]
 
 

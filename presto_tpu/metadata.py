@@ -1,0 +1,122 @@
+"""Engine metadata layer: sessions, catalogs, and the metadata manager.
+
+Analogue of presto-main's metadata/MetadataManager.java (fronting per-catalog
+connector metadata), metadata/CatalogManager, and Session.java:56. Narrowed to what
+the analyzer/planner need: qualified-name resolution to table handles, column
+enumeration, and statistics for the cost-based join ordering.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from .spi.connector import (ColumnHandle, Connector, Constraint, SchemaTableName,
+                            TableHandle, TableMetadata, TableStatistics)
+
+
+@dataclasses.dataclass
+class Session:
+    """Session.java:56 — per-query context (user, catalog/schema defaults,
+    system + per-catalog session properties, SystemSessionProperties.java:54)."""
+
+    user: str = "user"
+    catalog: Optional[str] = None
+    schema: Optional[str] = None
+    properties: Dict[str, object] = dataclasses.field(default_factory=dict)
+
+    # engine defaults (the SystemSessionProperties subset that matters here)
+    DEFAULTS = {
+        "page_capacity": 1 << 16,
+        "task_concurrency": 1,
+        "join_distribution_type": "AUTOMATIC",   # BROADCAST | PARTITIONED | AUTOMATIC
+        "join_reordering_strategy": "AUTOMATIC",  # NONE | AUTOMATIC
+        "max_groups": 1 << 20,
+    }
+
+    def get(self, name: str, default=None):
+        if name in self.properties:
+            return self.properties[name]
+        if name in self.DEFAULTS:
+            return self.DEFAULTS[name]
+        return default
+
+    def with_properties(self, **kw) -> "Session":
+        props = dict(self.properties)
+        props.update(kw)
+        return dataclasses.replace(self, properties=props)
+
+
+@dataclasses.dataclass(frozen=True)
+class QualifiedObjectName:
+    catalog: str
+    schema: str
+    table: str
+
+    def __str__(self):
+        return f"{self.catalog}.{self.schema}.{self.table}"
+
+
+class CatalogManager:
+    """metadata/CatalogManager — registered connectors by catalog name."""
+
+    def __init__(self):
+        self._catalogs: Dict[str, Connector] = {}
+
+    def register(self, name: str, connector: Connector) -> None:
+        self._catalogs[name] = connector
+
+    def get(self, name: str) -> Optional[Connector]:
+        return self._catalogs.get(name)
+
+    def names(self) -> List[str]:
+        return list(self._catalogs)
+
+
+class MetadataManager:
+    """metadata/MetadataManager.java — engine-facing metadata fronting connectors."""
+
+    def __init__(self, catalogs: CatalogManager):
+        self.catalogs = catalogs
+
+    def resolve_table_name(self, session: Session,
+                           parts: Sequence[str]) -> QualifiedObjectName:
+        """tree.Table name -> fully qualified, filling session defaults
+        (metadata/MetadataUtil.createQualifiedObjectName analogue)."""
+        parts = list(parts)
+        if len(parts) == 1:
+            if not session.catalog or not session.schema:
+                raise ValueError(f"table '{parts[0]}' requires session catalog/schema")
+            return QualifiedObjectName(session.catalog, session.schema, parts[0])
+        if len(parts) == 2:
+            if not session.catalog:
+                raise ValueError(f"table '{'.'.join(parts)}' requires session catalog")
+            return QualifiedObjectName(session.catalog, parts[0], parts[1])
+        if len(parts) == 3:
+            return QualifiedObjectName(*parts)
+        raise ValueError(f"invalid table name {'.'.join(parts)}")
+
+    def get_table_handle(self, session: Session,
+                         name: QualifiedObjectName) -> Optional[TableHandle]:
+        conn = self.catalogs.get(name.catalog)
+        if conn is None:
+            return None
+        return conn.metadata().get_table_handle(SchemaTableName(name.schema, name.table))
+
+    def get_table_metadata(self, table: TableHandle) -> TableMetadata:
+        return self._connector(table).metadata().get_table_metadata(table)
+
+    def get_column_handles(self, table: TableHandle) -> Dict[str, ColumnHandle]:
+        return self._connector(table).metadata().get_column_handles(table)
+
+    def get_table_statistics(self, table: TableHandle,
+                             constraint: Constraint = Constraint.all()) -> TableStatistics:
+        return self._connector(table).metadata().get_table_statistics(table, constraint)
+
+    def connector(self, catalog: str) -> Connector:
+        conn = self.catalogs.get(catalog)
+        if conn is None:
+            raise KeyError(f"unknown catalog {catalog}")
+        return conn
+
+    def _connector(self, table: TableHandle) -> Connector:
+        return self.connector(table.connector_id)

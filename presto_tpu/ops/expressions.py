@@ -59,6 +59,20 @@ class Constant(RowExpression):
 
 
 @dataclasses.dataclass(frozen=True)
+class SymbolRef(RowExpression):
+    """Planner-level column reference by symbol name (sql/planner/Symbol.java).
+
+    Plans carry expressions over symbols; the local execution planner rewrites every
+    SymbolRef to a channel InputRef against the child operator's layout (the same
+    symbol->channel translation LocalExecutionPlanner.java does via
+    SourceLayout/InputChannels)."""
+    name: str
+
+    def __str__(self):
+        return self.name
+
+
+@dataclasses.dataclass(frozen=True)
 class Call(RowExpression):
     name: str
     args: Tuple[RowExpression, ...]
@@ -79,6 +93,43 @@ class SpecialForm(RowExpression):
 
 def input_ref(channel: int, type_: Type) -> InputRef:
     return InputRef(type_, channel)
+
+
+def symbol_ref(name: str, type_: Type) -> SymbolRef:
+    return SymbolRef(type_, name)
+
+
+def rewrite_expression(expr: RowExpression, fn) -> RowExpression:
+    """Bottom-up rewrite: fn(node) -> replacement or None (keep). Children first."""
+    if isinstance(expr, Call):
+        new_args = tuple(rewrite_expression(a, fn) for a in expr.args)
+        expr = Call(expr.type, expr.name, new_args)
+    elif isinstance(expr, SpecialForm):
+        new_args = tuple(rewrite_expression(a, fn) for a in expr.args)
+        expr = SpecialForm(expr.type, expr.form, new_args)
+    out = fn(expr)
+    return expr if out is None else out
+
+
+def symbols_in(expr: RowExpression) -> set:
+    """Names of all SymbolRefs in the tree."""
+    out = set()
+
+    def visit(e):
+        if isinstance(e, SymbolRef):
+            out.add(e.name)
+        return None
+    rewrite_expression(expr, visit)
+    return out
+
+
+def resolve_symbols(expr: RowExpression, channels: Dict[str, int]) -> RowExpression:
+    """SymbolRef -> InputRef via a symbol->channel map (local-planning step)."""
+    def visit(e):
+        if isinstance(e, SymbolRef):
+            return InputRef(e.type, channels[e.name])
+        return None
+    return rewrite_expression(expr, visit)
 
 
 def constant(value: Any, type_: Type) -> Constant:
@@ -673,6 +724,11 @@ class ExpressionCompiler:
         if d is None or not isinstance(start, Constant) or \
                 (length is not None and not isinstance(length, Constant)):
             raise NotImplementedError("substr requires dictionary input + literal bounds")
+        if not hasattr(d, "values"):
+            # virtual dictionaries (FormattedDictionary) materialize no values array
+            raise NotImplementedError(
+                f"substr over a virtual dictionary ({type(d).__name__}) needs a "
+                "synthesized-prefix rule (planned for the Q22 rev)")
         s = int(start.value) - 1
         ln = int(length.value) if length is not None else None
         new_values = [v[s:s + ln] if ln is not None else v[s:] for v in d.values]
@@ -690,6 +746,20 @@ class ExpressionCompiler:
 # ---------------------------------------------------------------------------
 # helpers
 # ---------------------------------------------------------------------------
+
+def _merge_dicts(a: Optional[Dictionary], b: Optional[Dictionary]) -> Optional[Dictionary]:
+    """Output dictionary of a branch merge (IF/SWITCH/COALESCE). Branches that are
+    NULL or non-string carry no dictionary; distinct dictionaries would need a
+    re-encode pass (not needed by the TPC workloads yet)."""
+    if a is None:
+        return b
+    if b is None:
+        return a
+    if a is b:
+        return a
+    raise NotImplementedError(
+        "CASE/COALESCE across two distinct dictionaries requires re-encoding")
+
 
 def _combine_nulls(a: Optional[Array], b: Optional[Array]) -> Optional[Array]:
     if a is None:

@@ -97,11 +97,14 @@ class FilterProjectOperator(Operator):
 
 
 class FilterProjectOperatorFactory(OperatorFactory):
-    def __init__(self, operator_id: int, layout: InputLayout,
-                 filter_expr: Optional[RowExpression], projections: Sequence[RowExpression],
-                 compact_output: bool = False):
+    def __init__(self, operator_id: int, layout: Optional[InputLayout] = None,
+                 filter_expr: Optional[RowExpression] = None,
+                 projections: Sequence[RowExpression] = (),
+                 compact_output: bool = False,
+                 processor: Optional[PageProcessor] = None):
         super().__init__(operator_id, "FilterProject")
-        self.processor = PageProcessor(layout, filter_expr, projections, compact_output)
+        self.processor = processor if processor is not None else \
+            PageProcessor(layout, filter_expr, projections, compact_output)
 
     def create_operator(self) -> Operator:
         return FilterProjectOperator(OperatorContext(self.operator_id, self.name),

@@ -131,6 +131,8 @@ def sort_group_reduce(keys: Tuple[jnp.ndarray, ...], mask: jnp.ndarray,
 class GroupedAggregationBuilder:
     """Sort-strategy accumulator (InMemoryHashAggregationBuilder analogue)."""
 
+    compact_table = True  # finish() returns a prefix-valid table
+
     def __init__(self, key_types: Sequence[Type], key_dicts: Sequence[Optional[Dictionary]],
                  calls: Sequence[AggregateCall], page_capacity: int,
                  max_groups: int = 1 << 20, from_intermediate: bool = False):
@@ -148,6 +150,11 @@ class GroupedAggregationBuilder:
         self._pending_rows = 0
         self._page_kernel = jax.jit(self._page_partial, static_argnames=("out_groups",))
         self._overflowed = False
+        # adaptive compact-table size: starts at the first fold's true group count
+        # (rounded up to a power of two) and grows on demand — the rehash analogue
+        # of MultiChannelGroupByHash.java:363-409, but table growth here re-runs one
+        # sort kernel at the next size bucket instead of rehashing in place
+        self._table_size: Optional[int] = None
 
     # --- per page ---------------------------------------------------------
 
@@ -185,10 +192,24 @@ class GroupedAggregationBuilder:
         states = tuple(jnp.concatenate([p[1][i] for p in parts])
                        for i in range(len(self.kinds)))
         valid = jnp.concatenate([p[2] for p in parts])
-        gkeys, gstates, gvalid, ngroups = _combine_kernel(
-            keys, valid, states, self.kinds, self.identities, self.max_groups)
-        if int(ngroups) > self.max_groups:
+        size = self._table_size or _pow2(min(int(valid.shape[0]), self.max_groups))
+        while True:
+            gkeys, gstates, gvalid, ngroups = _combine_kernel(
+                keys, valid, states, self.kinds, self.identities, size)
+            n = int(ngroups)
+            if n <= size or size >= self.max_groups:
+                break
+            size = min(_pow2(n), self.max_groups)  # grow and refold
+        if n > self.max_groups:
             self._overflowed = True
+        # shrink the table to the true group count's bucket: gvalid is a prefix,
+        # so slicing keeps every live group and future folds sort less
+        tight = min(_pow2(max(n, 1)), self.max_groups)
+        if tight < size:
+            gkeys = tuple(k[:tight] for k in gkeys)
+            gstates = tuple(s[:tight] for s in gstates)
+            gvalid = gvalid[:tight]
+        self._table_size = tight
         self._acc = (gkeys, gstates, gvalid)
 
     def finish(self):
@@ -212,10 +233,16 @@ def _combine_kernel(keys, valid, states, kinds, identities, max_groups):
     return sort_group_reduce(keys, valid, states, kinds, identities, max_groups)
 
 
+def _pow2(n: int) -> int:
+    return 1 << max(4, (n - 1).bit_length())
+
+
 class DirectAggregationBuilder:
     """Small-domain strategy: dense state table indexed by linear key code.
 
     BigintGroupByHash analogue; domain = product of per-key dictionary/domain sizes."""
+
+    compact_table = False  # domain-indexed table: valid mask has holes
 
     def __init__(self, key_types, key_dicts, domains: Sequence[int], calls,
                  from_intermediate: bool = False):
@@ -385,7 +412,13 @@ class HashAggregationOperator(Operator):
     def _build_result(self) -> None:
         keys, states, valid = self.builder.finish()
         pages: List[Page] = []
-        total = int(valid.shape[0])
+        # sort-builder tables are compact (valid is a prefix): trim to live groups.
+        # direct-builder tables are domain-indexed with holes: keep the full (small)
+        # table and let the page masks carry liveness.
+        if getattr(self.builder, "compact_table", True):
+            total = int(jnp.sum(valid))
+        else:
+            total = int(valid.shape[0])
         cap = self.output_capacity
         # final transform per aggregate
         out_cols: List[Tuple] = []  # (type, data, dictionary, nulls)

@@ -400,23 +400,33 @@ class LookupJoinOperator(Operator):
 
     def _emit_expanded(self, page: Page, probe_keys, probe_mask) -> None:
         src = self._source
-        if self.f.join_type != INNER:
+        jt = self.f.join_type
+        if jt not in (INNER, LEFT):
             raise NotImplementedError(
-                "outer joins on non-unique build sides need unmatched-row emission; "
-                "the planner routes outer joins through the unique path for now")
+                "RIGHT/FULL joins on non-unique build sides need build-side "
+                "visited tracking (planned rev)")
+        left = jt == LEFT
+        if left and not src.exact_keys:
+            # a mixed-hash collision would mask a probe row's only match slots and
+            # silently drop the row; LEFT semantics need exact combined keys
+            raise NotImplementedError(
+                "multi-key LEFT join on a non-unique build needs exact-key "
+                "verification with null-row fallback (single-key LEFT is exact)")
         ck = combined_key(probe_keys)
-        lo, hi, total = _range_kernel(src.sorted_key, ck, probe_mask)
+        lo, emit, match_counts, total = _range_kernel(
+            src.sorted_key, ck, probe_mask, page.mask, left)
         total = int(total)  # host sync: output cardinality for this page
         cap = page.capacity
         n_chunks = max(1, -(-total // cap)) if total > 0 else 0
-        offsets = jnp.cumsum(hi - lo)
+        offsets = jnp.cumsum(emit)
         for c in range(n_chunks):
-            out = _expand_kernel(page, tuple(probe_keys), lo, offsets, src.sorted_row,
+            out = _expand_kernel(page, tuple(probe_keys), lo, offsets,
+                                 match_counts, src.sorted_row,
                                  tuple(src.key_arrays), tuple(src.payload),
                                  tuple(src.payload_nulls),
                                  tuple(self.f.probe_output_channels),
                                  tuple(self.f.build_output_channels),
-                                 c * cap, total,
+                                 c * cap, total, left,
                                  tuple((t, d) for (t, d) in
                                        _payload_meta_selected(src, self.f)))
             self._push(out)
@@ -440,21 +450,29 @@ def _payload_meta_selected(src: LookupSource, f) -> List[Tuple[Type, Optional[Di
     return [src.payload_meta[i] for i in f.build_output_channels]
 
 
-@jax.jit
-def _range_kernel(sorted_key, probe_ck, probe_mask):
+@functools.partial(jax.jit, static_argnames=("left",))
+def _range_kernel(sorted_key, probe_ck, probe_mask, emit_mask, left=False):
+    """Match ranges per probe row. Returns (lo, emit_counts, match_counts, total).
+    LEFT joins emit one row for match-less live probe rows (null build side)."""
     lo = jnp.searchsorted(sorted_key, probe_ck, side="left")
     hi = jnp.searchsorted(sorted_key, probe_ck, side="right")
     lo = jnp.where(probe_mask, lo, 0)
     hi = jnp.where(probe_mask, hi, 0)
-    return lo.astype(jnp.int32), hi.astype(jnp.int32), jnp.sum(hi - lo)
+    match = (hi - lo).astype(jnp.int32)
+    if left:
+        emit = jnp.where(emit_mask, jnp.maximum(match, 1), 0).astype(jnp.int32)
+    else:
+        emit = match
+    return lo.astype(jnp.int32), emit, match, jnp.sum(emit)
 
 
 @functools.partial(jax.jit, static_argnames=("probe_channels", "build_channels",
-                                             "payload_meta"))
-def _expand_kernel(page: Page, probe_keys, lo, offsets, sorted_row, key_arrays,
-                   payload, payload_nulls, probe_channels, build_channels,
-                   out_base, total, payload_meta):
-    """Emit output rows [out_base, out_base+cap) of the expanded inner join."""
+                                             "left", "payload_meta"))
+def _expand_kernel(page: Page, probe_keys, lo, offsets, match_counts, sorted_row,
+                   key_arrays, payload, payload_nulls, probe_channels,
+                   build_channels, out_base, total, left, payload_meta):
+    """Emit output rows [out_base, out_base+cap) of the expanded join. For LEFT,
+    an emit slot beyond a probe row's match count is its null-build row."""
     cap = page.mask.shape[0]
     j = jnp.arange(cap, dtype=jnp.int32) + out_base
     live = j < total
@@ -463,15 +481,16 @@ def _expand_kernel(page: Page, probe_keys, lo, offsets, sorted_row, key_arrays,
     pi = jnp.clip(pi, 0, cap - 1)
     prev = jnp.where(pi > 0, offsets[jnp.maximum(pi - 1, 0)], 0)
     k = j - prev
+    is_match = k < match_counts[pi]
     spos = lo[pi] + k
     spos = jnp.clip(spos, 0, sorted_row.shape[0] - 1)
-    brow = sorted_row[spos]
+    brow = jnp.where(is_match, sorted_row[spos], 0)
     # verify true keys (collision safety on multi-key mixes)
     ok = live
     for pkc, bk in zip(range(len(probe_keys)), key_arrays):
         pv = probe_keys[pkc][pi]
         bv = bk[brow]
-        ok = ok & (bv == pv)
+        ok = ok & (~is_match | (bv == pv)) if left else ok & (bv == pv)
     blocks = []
     for c in probe_channels:
         b = page.blocks[c]
@@ -479,8 +498,10 @@ def _expand_kernel(page: Page, probe_keys, lo, offsets, sorted_row, key_arrays,
         blocks.append(Block(b.type, b.data[pi], nulls, b.dictionary))
     for bi, (t, d) in zip(build_channels, payload_meta):
         bn = payload_nulls[bi] if bi < len(payload_nulls) else None
-        blocks.append(Block(t, payload[bi][brow],
-                            bn[brow] if bn is not None else None, d))
+        nulls = bn[brow] if bn is not None else None
+        if left:
+            nulls = ~is_match if nulls is None else (nulls | ~is_match)
+        blocks.append(Block(t, payload[bi][brow], nulls, d))
     return Page(tuple(blocks), ok)
 
 

@@ -1,0 +1,76 @@
+"""EnforceSingleRow: scalar-subquery cardinality guard.
+
+Analogue of presto-main operator/EnforceSingleRowOperator.java (planned by
+plan/EnforceSingleRowNode): buffers its input, fails if more than one row arrives,
+and emits exactly one row — an all-null row when the input is empty, matching SQL
+scalar-subquery semantics.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..block import Block, Dictionary, Page
+from ..types import Type
+from .operator import Operator, OperatorContext, OperatorFactory, timed
+
+
+class EnforceSingleRowOperator(Operator):
+    def __init__(self, context: OperatorContext, types: List[Type],
+                 dicts: List[Optional[Dictionary]]):
+        super().__init__(context)
+        self.types = types
+        self.dicts = dicts
+        self._row: Optional[Page] = None
+        self._emitted = False
+
+    @property
+    def output_types(self) -> List[Type]:
+        return self.types
+
+    @timed("add_input_ns")
+    def add_input(self, page: Page) -> None:
+        n = page.size()
+        if n == 0:
+            return
+        if self._row is not None or n > 1:
+            raise RuntimeError("scalar subquery returned more than one row")
+        compacted = page.compact()
+        # keep only the first slot (capacity-1 page) to bound memory
+        blocks = tuple(
+            Block(b.type, jnp.asarray(np.asarray(b.data)[:1]),
+                  jnp.asarray(np.asarray(b.nulls)[:1]) if b.nulls is not None else None,
+                  b.dictionary)
+            for b in compacted.blocks)
+        self._row = Page(blocks, jnp.ones(1, dtype=jnp.bool_))
+
+    @timed("get_output_ns")
+    def get_output(self) -> Optional[Page]:
+        if not self._finishing or self._emitted:
+            return None
+        self._emitted = True
+        if self._row is not None:
+            return self._row
+        # empty input -> one all-null row
+        blocks = tuple(
+            Block(t, jnp.zeros(1, dtype=t.np_dtype),
+                  jnp.ones(1, dtype=jnp.bool_), d)
+            for t, d in zip(self.types, self.dicts))
+        return Page(blocks, jnp.ones(1, dtype=jnp.bool_))
+
+    def is_finished(self) -> bool:
+        return self._emitted
+
+
+class EnforceSingleRowOperatorFactory(OperatorFactory):
+    def __init__(self, operator_id: int, types: List[Type],
+                 dicts: Optional[List[Optional[Dictionary]]] = None):
+        super().__init__(operator_id, "EnforceSingleRow")
+        self.types = types
+        self.dicts = dicts or [None] * len(types)
+
+    def create_operator(self) -> EnforceSingleRowOperator:
+        return EnforceSingleRowOperator(
+            OperatorContext(self.operator_id, self.name), self.types, self.dicts)

@@ -1,0 +1,96 @@
+"""LocalQueryRunner: parse -> analyze/plan -> optimize -> execute, in-process.
+
+Analogue of presto-main testing/LocalQueryRunner.java:210 (executeInternal :620,
+createDrivers :679): the single-process full-engine path used by ring-2 tests and
+benchmarks — no HTTP, real operators. The distributed runner
+(parallel/distributed.py) layers the mesh exchange on the same plans.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional
+
+from .connectors.tpch.connector import TpchConnector
+from .exec.local_planner import LocalExecutionPlanner
+from .metadata import CatalogManager, MetadataManager, Session
+from .sql import tree as t
+from .sql.parser import SqlParser
+from .sql.planner.optimizer import optimize
+from .sql.planner.plan import OutputNode, plan_to_text
+from .sql.planner.planner import LogicalPlanner
+
+
+@dataclasses.dataclass
+class QueryResult:
+    rows: List[list]
+    column_names: List[str]
+
+
+class LocalQueryRunner:
+    """In-process engine instance bound to a catalog registry."""
+
+    def __init__(self, session: Optional[Session] = None,
+                 catalogs: Optional[CatalogManager] = None,
+                 page_capacity: int = 1 << 14):
+        if catalogs is None:
+            catalogs = CatalogManager()
+            catalogs.register("tpch", TpchConnector("tpch"))
+        self.catalogs = catalogs
+        self.metadata = MetadataManager(catalogs)
+        self.session = session or Session(catalog="tpch", schema="tiny")
+        if "page_capacity" not in self.session.properties:
+            self.session = self.session.with_properties(page_capacity=page_capacity)
+        self.parser = SqlParser()
+
+    # ------------------------------------------------------------------ api
+
+    def plan_sql(self, sql: str) -> OutputNode:
+        stmt = self.parser.parse(sql)
+        if not isinstance(stmt, t.Query):
+            raise ValueError(f"cannot plan {type(stmt).__name__}")
+        return self.plan_statement(stmt)
+
+    def plan_statement(self, stmt: t.Query) -> OutputNode:
+        planner = LogicalPlanner(self.metadata, self.session)
+        plan = planner.plan(stmt)
+        return optimize(plan, self.metadata, self.session)
+
+    def explain(self, sql: str) -> str:
+        return plan_to_text(self.plan_sql(sql))
+
+    def execute(self, sql: str) -> QueryResult:
+        stmt = self.parser.parse(sql)
+        if isinstance(stmt, t.Explain):
+            inner = stmt.statement
+            if not isinstance(inner, t.Query):
+                raise ValueError("EXPLAIN requires a query")
+            text = plan_to_text(self.plan_statement(inner))
+            return QueryResult([[line] for line in text.split("\n")],
+                               ["Query Plan"])
+        if isinstance(stmt, t.ShowTables):
+            conn = self.metadata.connector(self.session.catalog)
+            tables = conn.metadata().list_tables(self.session.schema)
+            return QueryResult([[st.table] for st in tables], ["Table"])
+        if isinstance(stmt, t.ShowSchemas):
+            conn = self.metadata.connector(self.session.catalog)
+            return QueryResult([[s] for s in conn.metadata().list_schemas()],
+                               ["Schema"])
+        if isinstance(stmt, t.ShowColumns):
+            qname = self.metadata.resolve_table_name(
+                self.session, tuple(p.lower() for p in stmt.table))
+            handle = self.metadata.get_table_handle(self.session, qname)
+            if handle is None:
+                raise ValueError(f"table {qname} does not exist")
+            meta = self.metadata.get_table_metadata(handle)
+            return QueryResult([[c.name, c.type.name] for c in meta.columns],
+                               ["Column", "Type"])
+        if not isinstance(stmt, t.Query):
+            raise ValueError(f"unsupported statement {type(stmt).__name__}")
+
+        plan = self.plan_statement(stmt)
+        local = LocalExecutionPlanner(self.metadata, self.session)
+        exec_plan = local.plan(plan)
+        drivers = exec_plan.create_drivers()
+        for d in drivers:  # dependency order: build pipelines first
+            d.run_to_completion()
+        return QueryResult(exec_plan.sink.rows(), exec_plan.output_names)

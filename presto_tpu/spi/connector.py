@@ -26,6 +26,11 @@ class ColumnMetadata:
     name: str
     type: Type
     hidden: bool = False
+    # Static string dictionary for varchar columns. On TPU, dictionaries are plan-time
+    # metadata: the expression compiler resolves string predicates against them into
+    # integer compares (the role DictionaryBlock plays at runtime in the reference,
+    # spi/block/DictionaryBlock.java).
+    dictionary: Optional[Dictionary] = None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -172,6 +177,12 @@ class ConnectorMetadata(abc.ABC):
     def get_table_statistics(self, table: TableHandle,
                              constraint: Constraint) -> TableStatistics:
         return TableStatistics.empty()
+
+    def get_unique_column_sets(self, table: TableHandle) -> List[Tuple[str, ...]]:
+        """Column sets that uniquely identify a row (primary/unique keys). Lets the
+        planner pick unique-build join kernels (the reference infers the same from
+        spi/statistics distinct counts in DetermineJoinDistributionType)."""
+        return []
 
     # write path (optional)
     def begin_insert(self, table: TableHandle):

@@ -1,0 +1,520 @@
+"""Plan optimizer: predicate pushdown, join ordering, column pruning, TopN fusion.
+
+Analogue of presto-main sql/planner/PlanOptimizers (the ~10 passes TPC needs, per
+the reference's PredicatePushDown.java, iterative/rule/ReorderJoins.java,
+PruneUnreferencedOutputs, MergeLimitWithSort -> TopNNode). Cost model: connector
+row counts (spi/statistics/TableStatistics) with fixed filter selectivities —
+the CBO (cost/StatsCalculator) analogue, narrowed to what join ordering needs.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from ...metadata import MetadataManager, Session
+from ...ops.expressions import (Call, Constant, RowExpression, SpecialForm,
+                                SymbolRef, rewrite_expression, special,
+                                symbols_in, symbol_ref)
+from ...types import BOOLEAN
+from .plan import (AggregationNode, EnforceSingleRowNode, FilterNode, JoinNode,
+                   LimitNode, Ordering, OutputNode, PlanNode, ProjectNode,
+                   SemiJoinNode, SortNode, Symbol, TableScanNode, TopNNode,
+                   UnionNode, ValuesNode, rewrite_plan)
+
+FILTER_SELECTIVITY = 0.25
+SEMI_SELECTIVITY = 0.5
+
+
+def optimize(plan: PlanNode, metadata: MetadataManager,
+             session: Session) -> PlanNode:
+    """PlanOptimizers.java pipeline (fixed order, two pushdown passes around the
+    join reorder exactly like the reference runs PredicatePushDown twice)."""
+    plan = push_down_predicates(plan)
+    plan = reorder_joins(plan, metadata)
+    plan = push_down_predicates(plan)
+    plan = normalize_residuals(plan)
+    plan = fuse_topn(plan)
+    plan = prune_columns(plan)
+    plan = remove_identity_projects(plan)
+    return plan
+
+
+# ---------------------------------------------------------------------------
+# conjunct utilities
+# ---------------------------------------------------------------------------
+
+def split_and(expr: RowExpression) -> List[RowExpression]:
+    if isinstance(expr, SpecialForm) and expr.form == "AND":
+        out: List[RowExpression] = []
+        for a in expr.args:
+            out.extend(split_and(a))
+        return out
+    return [expr]
+
+
+def and_all(parts: Sequence[RowExpression]) -> Optional[RowExpression]:
+    parts = list(parts)
+    if not parts:
+        return None
+    out = parts[0]
+    for p in parts[1:]:
+        out = special("AND", BOOLEAN, out, p)
+    return out
+
+
+def substitute(expr: RowExpression,
+               mapping: Dict[str, RowExpression]) -> RowExpression:
+    def visit(e):
+        if isinstance(e, SymbolRef) and e.name in mapping:
+            return mapping[e.name]
+        return None
+    return rewrite_expression(expr, visit)
+
+
+# ---------------------------------------------------------------------------
+# predicate pushdown (PredicatePushDown.java analogue)
+# ---------------------------------------------------------------------------
+
+def factor_or(expr: RowExpression) -> List[RowExpression]:
+    """(a AND x AND y) OR (a AND z) -> a AND ((x AND y) OR z).
+
+    The ExtractCommonPredicatesExpressionRewriter analogue — without it, TPC-H Q19's
+    join key equality stays trapped inside the OR and the join degenerates to a
+    cross product."""
+    if not (isinstance(expr, SpecialForm) and expr.form == "OR"):
+        return [expr]
+    branches = []
+
+    def collect(e):
+        if isinstance(e, SpecialForm) and e.form == "OR":
+            for a in e.args:
+                collect(a)
+        else:
+            branches.append(split_and(e))
+    collect(expr)
+    common = set(branches[0])
+    for b in branches[1:]:
+        common &= set(b)
+    if not common:
+        return [expr]
+    out = [c for c in branches[0] if c in common]  # keep deterministic order
+    rest_branches = []
+    for b in branches:
+        rest = [c for c in b if c not in common]
+        if not rest:
+            return out  # one branch is fully common -> OR is implied
+        rest_branches.append(and_all(rest))
+    rest_or = rest_branches[0]
+    for rb in rest_branches[1:]:
+        rest_or = special("OR", BOOLEAN, rest_or, rb)
+    return out + [rest_or]
+
+
+def push_down_predicates(plan: PlanNode) -> PlanNode:
+    return _pushdown(plan, [])
+
+
+def _pushdown(node: PlanNode, conjuncts: List[RowExpression]) -> PlanNode:
+    """Push `conjuncts` (over node's output symbols) into/below `node`."""
+    conjuncts = [f for c in conjuncts for f in factor_or(c)]
+    if isinstance(node, FilterNode):
+        return _pushdown(node.source, conjuncts + split_and(node.predicate))
+
+    if isinstance(node, ProjectNode):
+        mapping = {s.name: e for s, e in node.assignments}
+        inlined = [substitute(c, mapping) for c in conjuncts]
+        src = _pushdown(node.source, inlined)
+        return ProjectNode(src, node.assignments)
+
+    if isinstance(node, JoinNode) and node.type == "inner":
+        left_syms = {s.name for s in node.left.outputs()}
+        right_syms = {s.name for s in node.right.outputs()}
+        to_left, to_right, keep = [], [], []
+        for c in conjuncts:
+            syms = symbols_in(c)
+            if syms <= left_syms:
+                to_left.append(c)
+            elif syms <= right_syms:
+                to_right.append(c)
+            else:
+                keep.append(c)
+        residual = split_and(node.residual) if node.residual is not None else []
+        left = _pushdown(node.left, to_left)
+        right = _pushdown(node.right, to_right)
+        out = JoinNode(node.type, left, right, node.criteria,
+                       and_all(residual), node.output_symbols)
+        return _wrap_filter(out, keep)
+
+    if isinstance(node, JoinNode) and node.type == "left":
+        left_syms = {s.name for s in node.left.outputs()}
+        to_left, keep = [], []
+        for c in conjuncts:
+            if symbols_in(c) <= left_syms:
+                to_left.append(c)
+            else:
+                keep.append(c)
+        # ON-clause conjuncts that reference only the build side filter which build
+        # rows can match — safe to push into the right child for LEFT joins
+        residual_keep, to_right = [], []
+        for c in (split_and(node.residual) if node.residual is not None else []):
+            if symbols_in(c) <= {s.name for s in node.right.outputs()}:
+                to_right.append(c)
+            else:
+                residual_keep.append(c)
+        left = _pushdown(node.left, to_left)
+        right = _pushdown(node.right, to_right)
+        out = JoinNode(node.type, left, right, node.criteria,
+                       and_all(residual_keep), node.output_symbols)
+        return _wrap_filter(out, keep)
+
+    if isinstance(node, SemiJoinNode):
+        src_syms = {s.name for s in node.source.outputs()}
+        to_src, keep = [], []
+        for c in conjuncts:
+            (to_src if symbols_in(c) <= src_syms else keep).append(c)
+        src = _pushdown(node.source, to_src)
+        filt = _pushdown(node.filtering_source, [])
+        out = SemiJoinNode(src, filt, node.source_key, node.filtering_key,
+                           node.mark, node.negated, node.null_aware)
+        return _wrap_filter(out, keep)
+
+    if isinstance(node, AggregationNode):
+        key_syms = {k.name for k in node.keys}
+        below, keep = [], []
+        for c in conjuncts:
+            (below if symbols_in(c) <= key_syms else keep).append(c)
+        src = _pushdown(node.source, below)
+        out = AggregationNode(src, node.keys, node.aggregations, node.step)
+        return _wrap_filter(out, keep)
+
+    if isinstance(node, UnionNode):
+        new_sources = []
+        for child, mapping in zip(node.sources, node.symbol_mappings):
+            m = {s.name: symbol_ref(cs.name, cs.type)
+                 for s, cs in zip(node.symbols, mapping)}
+            new_sources.append(_pushdown(child, [substitute(c, m)
+                                                 for c in conjuncts]))
+        return UnionNode(new_sources, node.symbols, node.symbol_mappings)
+
+    # barrier nodes: recurse into children with no conjuncts, re-wrap here
+    children = [_pushdown(c, []) for c in node.children()]
+    node = node.with_children(children) if children else node
+    return _wrap_filter(node, conjuncts)
+
+
+def _wrap_filter(node: PlanNode, conjuncts: List[RowExpression]) -> PlanNode:
+    pred = and_all(conjuncts)
+    return node if pred is None else FilterNode(node, pred)
+
+
+# ---------------------------------------------------------------------------
+# cardinality estimation (cost/StatsCalculator analogue, heavily narrowed)
+# ---------------------------------------------------------------------------
+
+def estimate_rows(node: PlanNode, metadata: MetadataManager) -> float:
+    if isinstance(node, TableScanNode):
+        stats = metadata.get_table_statistics(node.table)
+        return stats.row_count or 1e6
+    if isinstance(node, FilterNode):
+        n = len(split_and(node.predicate))
+        return estimate_rows(node.source, metadata) * (FILTER_SELECTIVITY ** n)
+    if isinstance(node, (ProjectNode, SortNode)):
+        return estimate_rows(node.children()[0], metadata)
+    if isinstance(node, AggregationNode):
+        if not node.keys:
+            return 1.0
+        return max(1.0, estimate_rows(node.source, metadata) * 0.1)
+    if isinstance(node, JoinNode):
+        l = estimate_rows(node.left, metadata)
+        r = estimate_rows(node.right, metadata)
+        if not node.criteria:
+            return l * r
+        return max(l, r)
+    if isinstance(node, SemiJoinNode):
+        return estimate_rows(node.source, metadata) * SEMI_SELECTIVITY
+    if isinstance(node, EnforceSingleRowNode):
+        return 1.0
+    if isinstance(node, ValuesNode):
+        return float(len(node.rows))
+    if isinstance(node, (TopNNode, LimitNode)):
+        return float(min(node.count,
+                         estimate_rows(node.children()[0], metadata)))
+    if isinstance(node, UnionNode):
+        return sum(estimate_rows(c, metadata) for c in node.sources)
+    children = node.children()
+    return estimate_rows(children[0], metadata) if children else 1.0
+
+
+# ---------------------------------------------------------------------------
+# join reordering (iterative/rule/ReorderJoins + DetermineJoinDistributionType)
+# ---------------------------------------------------------------------------
+
+def reorder_joins(plan: PlanNode, metadata: MetadataManager) -> PlanNode:
+    """Greedy left-deep reordering of inner-join regions.
+
+    A region = maximal tree of inner JoinNodes and FilterNodes. The spine (probe
+    side) starts at the largest relation; each step joins the smallest relation
+    equi-connected to the spine (the reference's greedy fallback when the
+    exhaustive ReorderJoins search is off). Build sides end up small -> they fit
+    the TPU-resident hash table; the big fact table streams through as probe."""
+    def visit(node: PlanNode) -> Optional[PlanNode]:
+        # region roots: an inner join, or a filter stack sitting on one (equality
+        # conjuncts that pushdown could not sink into one side land there)
+        root = node
+        while isinstance(root, FilterNode):
+            root = root.source
+        if isinstance(root, JoinNode) and root.type == "inner":
+            relations: List[PlanNode] = []
+            conjuncts: List[RowExpression] = []
+            _flatten_region(node, relations, conjuncts)
+            if len(relations) < 2:
+                return None
+            return _greedy_join(relations, conjuncts, metadata)
+        return None
+
+    return _rewrite_topdown_regions(plan, visit)
+
+
+def _rewrite_topdown_regions(node: PlanNode, visit) -> PlanNode:
+    out = visit(node)
+    if out is not None:
+        # recurse into the new children (region leaves), not the join tree we built
+        return out
+    children = [_rewrite_topdown_regions(c, visit) for c in node.children()]
+    return node.with_children(children) if children else node
+
+
+def _flatten_region(node: PlanNode, relations: List[PlanNode],
+                    conjuncts: List[RowExpression]) -> None:
+    if isinstance(node, JoinNode) and node.type == "inner":
+        for l, r in node.criteria:
+            conjuncts.append(Call(BOOLEAN, "equal",
+                                  (symbol_ref(l.name, l.type),
+                                   symbol_ref(r.name, r.type))))
+        if node.residual is not None:
+            conjuncts.extend(split_and(node.residual))
+        _flatten_region(node.left, relations, conjuncts)
+        _flatten_region(node.right, relations, conjuncts)
+        return
+    if isinstance(node, FilterNode):
+        conjuncts.extend(split_and(node.predicate))
+        _flatten_region(node.source, relations, conjuncts)
+        return
+    relations.append(node)
+
+
+def _greedy_join(relations: List[PlanNode], conjuncts: List[RowExpression],
+                 metadata: MetadataManager) -> PlanNode:
+    rel_syms: List[Set[str]] = [{s.name for s in r.outputs()} for r in relations]
+    sym_types: Dict[str, Symbol] = {}
+    for r in relations:
+        for s in r.outputs():
+            sym_types[s.name] = s
+    sizes = [estimate_rows(r, metadata) for r in relations]
+
+    # recurse into the relation subtrees first (nested regions below barriers)
+    relations = [reorder_joins(r, metadata) for r in relations]
+
+    pending = list(conjuncts)
+    remaining = set(range(len(relations)))
+
+    # spine = largest relation (streams as probe)
+    spine_i = max(remaining, key=lambda i: sizes[i])
+    remaining.discard(spine_i)
+    spine: PlanNode = relations[spine_i]
+    avail: Set[str] = set(rel_syms[spine_i])
+
+    def equi_pairs_for(i: int) -> List[Tuple[Symbol, Symbol]]:
+        pairs = []
+        for c in pending:
+            p = _as_equi(c)
+            if p is None:
+                continue
+            a, b = p
+            if a.name in avail and b.name in rel_syms[i]:
+                pairs.append((a, b))
+            elif b.name in avail and a.name in rel_syms[i]:
+                pairs.append((b, a))
+        return pairs
+
+    def apply_ready_filters():
+        nonlocal spine, pending
+        ready = [c for c in pending if symbols_in(c) <= avail]
+        if ready:
+            spine = FilterNode(spine, and_all(ready))
+            pending = [c for c in pending if c not in ready]
+
+    apply_ready_filters()
+    while remaining:
+        connected = [i for i in remaining if equi_pairs_for(i)]
+        pool = connected or list(remaining)
+        nxt = min(pool, key=lambda i: sizes[i])
+        pairs = equi_pairs_for(nxt)
+        used = []
+        for c in pending:
+            p = _as_equi(c)
+            if p is None:
+                continue
+            a, b = p
+            if (a.name in avail and b.name in rel_syms[nxt]) or \
+                    (b.name in avail and a.name in rel_syms[nxt]):
+                used.append(c)
+        pending = [c for c in pending if c not in used]
+        spine = JoinNode("inner", spine, relations[nxt], pairs, None)
+        avail |= rel_syms[nxt]
+        remaining.discard(nxt)
+        apply_ready_filters()
+
+    if pending:
+        spine = FilterNode(spine, and_all(pending))
+    return spine
+
+
+def _as_equi(c: RowExpression) -> Optional[Tuple[Symbol, Symbol]]:
+    if isinstance(c, Call) and c.name == "equal":
+        a, b = c.args
+        if isinstance(a, SymbolRef) and isinstance(b, SymbolRef) and a.name != b.name:
+            return (Symbol(a.name, a.type), Symbol(b.name, b.type))
+    return None
+
+
+# ---------------------------------------------------------------------------
+# residual normalization
+# ---------------------------------------------------------------------------
+
+def normalize_residuals(plan: PlanNode) -> PlanNode:
+    """INNER join residuals become filters above the join (the executor evaluates
+    them on the joined page). LEFT-join residuals over the build side were pushed
+    down already; anything left is unsupported this round."""
+    def visit(node):
+        if isinstance(node, JoinNode) and node.residual is not None:
+            if node.type == "inner":
+                return FilterNode(
+                    JoinNode(node.type, node.left, node.right, node.criteria,
+                             None, node.output_symbols),
+                    node.residual)
+            raise NotImplementedError(
+                f"{node.type} join residual filter {node.residual} not supported")
+        return None
+    return rewrite_plan(plan, visit)
+
+
+# ---------------------------------------------------------------------------
+# TopN fusion (MergeLimitWithSort)
+# ---------------------------------------------------------------------------
+
+def fuse_topn(plan: PlanNode) -> PlanNode:
+    def visit(node):
+        if isinstance(node, LimitNode):
+            src = node.source
+            if isinstance(src, SortNode):
+                return TopNNode(src.source, node.count, src.orderings)
+            if isinstance(src, ProjectNode) and isinstance(src.source, SortNode):
+                inner = src.source
+                return ProjectNode(
+                    TopNNode(inner.source, node.count, inner.orderings),
+                    src.assignments)
+        return None
+    return rewrite_plan(plan, visit)
+
+
+# ---------------------------------------------------------------------------
+# column pruning (PruneUnreferencedOutputs)
+# ---------------------------------------------------------------------------
+
+def prune_columns(plan: PlanNode) -> PlanNode:
+    if isinstance(plan, OutputNode):
+        required = {s.name for s in plan.symbols}
+        src = _prune(plan.source, required)
+        return OutputNode(src, plan.column_names, plan.symbols)
+    return _prune(plan, {s.name for s in plan.outputs()})
+
+
+def _prune(node: PlanNode, required: Set[str]) -> PlanNode:
+    if isinstance(node, TableScanNode):
+        assigns = [(s, c) for s, c in node.assignments if s.name in required]
+        return TableScanNode(node.table, assigns or node.assignments[:1])
+
+    if isinstance(node, FilterNode):
+        need = required | symbols_in(node.predicate)
+        return FilterNode(_prune(node.source, need), node.predicate)
+
+    if isinstance(node, ProjectNode):
+        assigns = [(s, e) for s, e in node.assignments if s.name in required]
+        need: Set[str] = set()
+        for _, e in assigns:
+            need |= symbols_in(e)
+        return ProjectNode(_prune(node.source, need), assigns)
+
+    if isinstance(node, JoinNode):
+        need = set(required)
+        for l, r in node.criteria:
+            need.add(l.name)
+            need.add(r.name)
+        if node.residual is not None:
+            need |= symbols_in(node.residual)
+        left = _prune(node.left, need)
+        right = _prune(node.right, need)
+        outs = [s for s in left.outputs() + right.outputs() if s.name in required]
+        return JoinNode(node.type, left, right, node.criteria, node.residual, outs)
+
+    if isinstance(node, SemiJoinNode):
+        need = set(required) | {node.source_key.name}
+        src = _prune(node.source, need)
+        filt = _prune(node.filtering_source, {node.filtering_key.name})
+        return SemiJoinNode(src, filt, node.source_key, node.filtering_key,
+                            node.mark, node.negated, node.null_aware)
+
+    if isinstance(node, AggregationNode):
+        aggs = [(s, c) for s, c in node.aggregations if s.name in required] \
+            if node.keys or node.aggregations else []
+        if not aggs and node.aggregations:
+            aggs = node.aggregations[:1]  # keep one (e.g. count) for EXISTS shapes
+        need = {k.name for k in node.keys}
+        for _, c in aggs:
+            need |= {a.name for a in c.args}
+            if c.filter is not None:
+                need.add(c.filter.name)
+        return AggregationNode(_prune(node.source, need), node.keys, aggs,
+                               node.step)
+
+    if isinstance(node, (SortNode, TopNNode)):
+        need = set(required) | {o.symbol.name for o in node.orderings}
+        src = _prune(node.children()[0], need)
+        if isinstance(node, SortNode):
+            return SortNode(src, node.orderings)
+        return TopNNode(src, node.count, node.orderings)
+
+    if isinstance(node, LimitNode):
+        return LimitNode(_prune(node.source, required), node.count)
+
+    if isinstance(node, EnforceSingleRowNode):
+        return EnforceSingleRowNode(_prune(node.source, required))
+
+    if isinstance(node, UnionNode):
+        keep_idx = [i for i, s in enumerate(node.symbols) if s.name in required]
+        if not keep_idx:
+            keep_idx = [0]
+        new_sources = []
+        for child, mapping in zip(node.sources, node.symbol_mappings):
+            need = {mapping[i].name for i in keep_idx}
+            new_sources.append(_prune(child, need))
+        return UnionNode(new_sources,
+                         [node.symbols[i] for i in keep_idx],
+                         [[m[i] for i in keep_idx] for m in node.symbol_mappings])
+
+    children = [_prune(c, {s.name for s in c.outputs()})
+                for c in node.children()]
+    return node.with_children(children) if children else node
+
+
+# ---------------------------------------------------------------------------
+# identity project removal
+# ---------------------------------------------------------------------------
+
+def remove_identity_projects(plan: PlanNode) -> PlanNode:
+    def visit(node):
+        if isinstance(node, ProjectNode) and node.is_identity():
+            return node.source
+        return None
+    return rewrite_plan(plan, visit)

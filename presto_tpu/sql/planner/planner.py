@@ -1,0 +1,692 @@
+"""Logical planner: AST -> symbol-based logical plan.
+
+Analogue of presto-main sql/planner/LogicalPlanner.java:108 + RelationPlanner.java +
+QueryPlanner.java (AST walk, scope threading, aggregate extraction) and
+SubqueryPlanner (uncorrelated IN -> SemiJoin, scalar subquery ->
+EnforceSingleRow + cross join). Where the reference produces symbol-annotated AST
+expressions and lowers later, we emit RowExpressions over SymbolRef immediately
+(see sql/analyzer.py).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ...metadata import MetadataManager, Session
+from ...ops.expressions import (Call, Constant, RowExpression, SpecialForm,
+                                SymbolRef, special, symbol_ref)
+from ...types import BIGINT, BOOLEAN, Type, UNKNOWN
+from .. import tree as t
+from ..analyzer import (AGGREGATE_NAMES, ExpressionTranslator, Field, Scope,
+                        SemanticError, aggregate_output_type, cast_to, common_type,
+                        contains_aggregates, extract_aggregates, rewrite_ast)
+from .plan import (AggregationCall, AggregationNode, EnforceSingleRowNode,
+                   FilterNode, JoinNode, LimitNode, Ordering, OutputNode, PlanNode,
+                   ProjectNode, SemiJoinNode, SortNode, Symbol, SymbolAllocator,
+                   TableScanNode, UnionNode, ValuesNode)
+
+
+@dataclasses.dataclass
+class RelationPlan:
+    """RelationPlanner's (node, scope) pair."""
+    node: PlanNode
+    scope: Scope
+
+
+from .optimizer import and_all as _and_all, split_and as _split_and
+
+
+def _conjuncts(expr: Optional[t.Expression]) -> List[t.Expression]:
+    if expr is None:
+        return []
+    if isinstance(expr, t.LogicalBinary) and expr.op.upper() == "AND":
+        return _conjuncts(expr.left) + _conjuncts(expr.right)
+    return [expr]
+
+
+class LogicalPlanner:
+    """One instance per query (owns the symbol allocator)."""
+
+    def __init__(self, metadata: MetadataManager, session: Session):
+        self.metadata = metadata
+        self.session = session
+        self.symbols = SymbolAllocator()
+        self._ctes: Dict[str, t.Query] = {}
+
+    # ------------------------------------------------------------------ top
+
+    def plan(self, stmt: t.Statement) -> OutputNode:
+        if isinstance(stmt, t.Query):
+            plan, names = self.plan_root_query(stmt)
+            return OutputNode(plan.node, names, [f.symbol for f in plan.scope.fields])
+        raise SemanticError(f"cannot plan statement {type(stmt).__name__}")
+
+    def plan_root_query(self, q: t.Query) -> Tuple[RelationPlan, List[str]]:
+        plan = self.plan_query(q)
+        names = [f.name or f"_col{i}" for i, f in enumerate(plan.scope.fields)]
+        return plan, names
+
+    # ---------------------------------------------------------------- query
+
+    def plan_query(self, q: t.Query) -> RelationPlan:
+        saved = dict(self._ctes)
+        try:
+            if q.with_ is not None:
+                for name, cte in q.with_.queries:
+                    self._ctes[name.lower()] = cte
+            plan = self.plan_relation(q.body)
+            if q.order_by or q.limit is not None:
+                # outer ORDER BY/LIMIT around a set-op or bare spec body
+                plan = self._plan_order_limit(plan, q.order_by, q.limit, None)
+            return plan
+        finally:
+            self._ctes = saved
+
+    def plan_relation(self, rel: t.Relation) -> RelationPlan:
+        if isinstance(rel, t.QuerySpecification):
+            return self.plan_query_spec(rel)
+        if isinstance(rel, t.Table):
+            return self.plan_table(rel)
+        if isinstance(rel, t.AliasedRelation):
+            return self.plan_aliased(rel)
+        if isinstance(rel, t.TableSubquery):
+            inner = self.plan_query(rel.query)
+            return inner
+        if isinstance(rel, t.Join):
+            return self.plan_join(rel)
+        if isinstance(rel, t.Values):
+            return self.plan_values(rel)
+        if isinstance(rel, t.SetOperation):
+            return self.plan_set_operation(rel)
+        raise SemanticError(f"unsupported relation {type(rel).__name__}")
+
+    # ---------------------------------------------------------------- FROM
+
+    def plan_table(self, rel: t.Table) -> RelationPlan:
+        name_parts = tuple(p.lower() for p in rel.name)
+        if len(name_parts) == 1 and name_parts[0] in self._ctes:
+            cte_plan = self.plan_query(self._ctes[name_parts[0]])
+            fields = [Field(f.name, f.symbol, name_parts[0])
+                      for f in cte_plan.scope.fields]
+            return RelationPlan(cte_plan.node, Scope(fields))
+        qname = self.metadata.resolve_table_name(self.session, name_parts)
+        handle = self.metadata.get_table_handle(self.session, qname)
+        if handle is None:
+            raise SemanticError(f"table {qname} does not exist")
+        columns = self.metadata.get_column_handles(handle)
+        meta = self.metadata.get_table_metadata(handle)
+        assignments = []
+        fields = []
+        for cm in meta.columns:
+            sym = self.symbols.new_symbol(cm.name, cm.type)
+            assignments.append((sym, columns[cm.name]))
+            fields.append(Field(cm.name, sym, qname.table))
+        return RelationPlan(TableScanNode(handle, assignments), Scope(fields))
+
+    def plan_aliased(self, rel: t.AliasedRelation) -> RelationPlan:
+        inner = self.plan_relation(rel.relation)
+        alias = rel.alias.lower()
+        fields = []
+        for i, f in enumerate(inner.scope.fields):
+            name = rel.column_names[i].lower() if rel.column_names else f.name
+            fields.append(Field(name, f.symbol, alias))
+        return RelationPlan(inner.node, Scope(fields))
+
+    def plan_values(self, rel: t.Values) -> RelationPlan:
+        rows = []
+        types: List[Type] = []
+        for r in rel.rows:
+            items = r.items if isinstance(r, t.Row) else (r,)
+            tr = ExpressionTranslator(Scope([]))
+            vals = [tr.translate(i) for i in items]
+            if not types:
+                types = [v.type for v in vals]
+            else:
+                types = [common_type(a, v.type) for a, v in zip(types, vals)]
+            rows.append(vals)
+        pyrows = []
+        for vals in rows:
+            out = []
+            for v, tt in zip(vals, types):
+                if not isinstance(v, Constant):
+                    raise SemanticError("VALUES entries must be literals")
+                out.append(v.value)
+            pyrows.append(out)
+        syms = [self.symbols.new_symbol(f"col{i}", tt) for i, tt in enumerate(types)]
+        fields = [Field(f"_col{i}", s, None) for i, s in enumerate(syms)]
+        return RelationPlan(ValuesNode(syms, pyrows), Scope(fields))
+
+    def plan_join(self, rel: t.Join) -> RelationPlan:
+        left = self.plan_relation(rel.left)
+        right = self.plan_relation(rel.right)
+        scope = Scope(left.scope.fields + right.scope.fields)
+        jtype = rel.type.upper()
+        if jtype in ("CROSS", "IMPLICIT"):
+            node = JoinNode("inner", left.node, right.node, [], None)
+            return RelationPlan(node, scope)
+        if jtype == "RIGHT":
+            # RIGHT = LEFT with sides swapped; field order stays user-visible via scope
+            left, right = right, left
+            jtype = "LEFT"
+        criteria: List[Tuple[Symbol, Symbol]] = []
+        residual_parts: List[RowExpression] = []
+        if rel.using:
+            for col in rel.using:
+                lf = left.scope.resolve(col.lower())
+                rf = right.scope.resolve(col.lower())
+                criteria.append((lf.symbol, rf.symbol))
+        elif rel.criteria is not None:
+            tr = ExpressionTranslator(scope)
+            predicate = tr.translate(rel.criteria)
+            left_syms = {f.symbol.name for f in left.scope.fields}
+            right_syms = {f.symbol.name for f in right.scope.fields}
+            for c in _split_and(predicate):
+                pair = _equi_pair(c, left_syms, right_syms)
+                if pair is not None:
+                    criteria.append(pair)
+                else:
+                    residual_parts.append(c)
+        node = JoinNode(jtype.lower(), left.node, right.node, criteria,
+                        _and_all(residual_parts))
+        return RelationPlan(node, scope)
+
+    def plan_set_operation(self, rel: t.SetOperation) -> RelationPlan:
+        if rel.op.upper() != "UNION":
+            raise SemanticError(f"{rel.op} not supported yet "
+                                "(reference rewrites to union+agg)")
+        left = self.plan_relation(rel.left)
+        right = self.plan_relation(rel.right)
+        lf, rf = left.scope.fields, right.scope.fields
+        if len(lf) != len(rf):
+            raise SemanticError("UNION children must have the same arity")
+        types = [common_type(a.type, b.type) for a, b in zip(lf, rf)]
+        sides = []
+        for plan, fields in ((left, lf), (right, rf)):
+            assigns = []
+            syms = []
+            for f, tt in zip(fields, types):
+                e = cast_to(symbol_ref(f.symbol.name, f.type), tt)
+                s = f.symbol if isinstance(e, SymbolRef) else \
+                    self.symbols.new_symbol(f.name or "col", tt)
+                assigns.append((s, e))
+                syms.append(s)
+            node = plan.node
+            if any(not isinstance(e, SymbolRef) for _, e in assigns):
+                node = ProjectNode(node, assigns)
+            sides.append((node, syms))
+        out_syms = [self.symbols.new_symbol(f.name or f"col{i}", tt)
+                    for i, (f, tt) in enumerate(zip(lf, types))]
+        union = UnionNode([n for n, _ in sides], out_syms,
+                          [syms for _, syms in sides])
+        node: PlanNode = union
+        if rel.distinct:
+            node = AggregationNode(node, out_syms, [])
+        fields = [Field(f.name, s, None) for f, s in zip(lf, out_syms)]
+        return RelationPlan(node, Scope(fields))
+
+    # ------------------------------------------------------- query spec core
+
+    def plan_query_spec(self, spec: t.QuerySpecification) -> RelationPlan:
+        if spec.from_ is not None:
+            source = self.plan_relation(spec.from_)
+        else:
+            source = RelationPlan(ValuesNode([], [[]]), Scope([]))
+
+        # WHERE (with subquery conjunct planning)
+        node, scope = source.node, source.scope
+        node = self._plan_where(node, scope, spec.where)
+
+        # expand stars into explicit select items
+        select_items = self._expand_select(spec.select_items, scope)
+
+        grouped = bool(spec.group_by) or \
+            any(contains_aggregates(i.expression) for i in select_items) or \
+            (spec.having is not None and contains_aggregates(spec.having))
+
+        if grouped:
+            return self._plan_grouped(node, scope, spec, select_items)
+        return self._plan_ungrouped(node, scope, spec, select_items)
+
+    def _expand_select(self, items: Sequence[t.SelectItem],
+                       scope: Scope) -> List[t.SelectItem]:
+        out = []
+        for item in items:
+            if isinstance(item.expression, t.Star):
+                q = item.expression.qualifier
+                q = q.lower() if q else None
+                for f in scope.fields:
+                    if q is None or f.qualifier == q:
+                        out.append(t.SelectItem(t.Identifier(f.name), f.name))
+            else:
+                out.append(item)
+        return out
+
+    def _plan_where(self, node: PlanNode, scope: Scope,
+                    where: Optional[t.Expression]) -> PlanNode:
+        plain: List[t.Expression] = []
+        for conj in _conjuncts(where):
+            planned = self._try_plan_subquery_conjunct(node, scope, conj)
+            if planned is not None:
+                node = planned
+            else:
+                plain.append(conj)
+        if plain:
+            tr = ExpressionTranslator(scope)
+            pred = _and_all([tr.translate(c) for c in plain])
+            node = FilterNode(node, pred)
+        return node
+
+    def _try_plan_subquery_conjunct(self, node: PlanNode, scope: Scope,
+                                    conj: t.Expression) -> Optional[PlanNode]:
+        """SubqueryPlanner analogue for WHERE conjuncts. Returns the new source node
+        or None when the conjunct has no subquery."""
+        negated = False
+        inner = conj
+        if isinstance(inner, t.NotExpression):
+            negated, inner = True, inner.value
+
+        # [NOT] IN (subquery)
+        if isinstance(inner, t.InPredicate) and \
+                isinstance(inner.value_list, t.SubqueryExpression):
+            tr = ExpressionTranslator(scope)
+            value = tr.translate(inner.value)
+            sub = self.plan_query(inner.value_list.query)
+            if len(sub.scope.fields) != 1:
+                raise SemanticError("IN subquery must return one column")
+            node, src_sym = self._as_symbol(node, value, "inkey")
+            return SemiJoinNode(node, sub.node, src_sym,
+                                sub.scope.fields[0].symbol, mark=None,
+                                negated=negated, null_aware=True)
+
+        # [NOT] EXISTS (subquery)
+        if isinstance(inner, t.ExistsPredicate):
+            sub_ast = inner.subquery.query
+            corr = self._decorrelate_exists(node, scope, sub_ast, negated)
+            if corr is not None:
+                return corr
+            try:
+                self.plan_query(sub_ast)
+            except SemanticError as e:
+                if self._is_correlated_error(e, scope):
+                    raise SemanticError(
+                        "correlated EXISTS of this shape is not supported yet — "
+                        "only outer=inner equality correlation is decorrelated "
+                        f"({e})") from e
+                raise
+            raise SemanticError("uncorrelated EXISTS not yet supported")
+
+        # scalar subquery comparison: x <op> (subquery)
+        if isinstance(inner, t.ComparisonExpression) and not negated:
+            for value_side, sub_side, flip in ((inner.left, inner.right, False),
+                                               (inner.right, inner.left, True)):
+                if isinstance(sub_side, t.SubqueryExpression):
+                    return self._plan_scalar_compare(node, scope, value_side,
+                                                    sub_side, inner.op, flip)
+        if _contains_subquery(conj):
+            raise SemanticError(f"unsupported subquery form: {conj}")
+        return None
+
+    def _plan_scalar_compare(self, node: PlanNode, scope: Scope,
+                             value_ast: t.Expression, sub: t.SubqueryExpression,
+                             op: str, flipped: bool) -> PlanNode:
+        try:
+            subplan = self.plan_query(sub.query)
+        except SemanticError as e:
+            if self._is_correlated_error(e, scope):
+                raise SemanticError(
+                    "correlated scalar subquery is not supported yet "
+                    f"(outer reference: {e})") from e
+            raise
+        if len(subplan.scope.fields) != 1:
+            raise SemanticError("scalar subquery must return one column")
+        sub_sym = subplan.scope.fields[0].symbol
+        enforced = EnforceSingleRowNode(subplan.node)
+        joined = JoinNode("inner", node, enforced, [], None)
+        tr = ExpressionTranslator(scope)
+        value = tr.translate(value_ast)
+        sref = symbol_ref(sub_sym.name, sub_sym.type)
+        left, right = (sref, value) if flipped else (value, sref)
+        from ..analyzer import _CMP_NAMES
+        pred = Call(BOOLEAN, _CMP_NAMES[op], (left, right))
+        return FilterNode(joined, pred)
+
+    def _decorrelate_exists(self, node: PlanNode, scope: Scope, sub: t.Query,
+                            negated: bool) -> Optional[PlanNode]:
+        """Correlated EXISTS where the subquery's WHERE contains outer = inner
+        equi-conjuncts (TPC-H Q4/Q21/Q22 shape) -> SemiJoin on the correlation key."""
+        body = sub.body
+        if not isinstance(body, t.QuerySpecification) or body.group_by or \
+                body.having is not None or body.from_ is None:
+            return None
+        inner_plan = self.plan_relation(body.from_)
+        inner_scope = inner_plan.scope
+        corr_pairs: List[Tuple[RowExpression, Symbol]] = []  # (outer expr, inner sym)
+        inner_conjs: List[RowExpression] = []
+        for conj in _conjuncts(body.where):
+            pair = self._split_correlated_eq(conj, scope, inner_scope)
+            if pair is not None:
+                corr_pairs.append(pair)
+                continue
+            tr = ExpressionTranslator(inner_scope)
+            try:
+                inner_conjs.append(tr.translate(conj))
+            except SemanticError:
+                return None  # correlation shape we cannot decorrelate yet
+        if not corr_pairs:
+            return None
+        inner_node = inner_plan.node
+        pred = _and_all(inner_conjs)
+        if pred is not None:
+            inner_node = FilterNode(inner_node, pred)
+        if len(corr_pairs) != 1:
+            # multi-key correlation: combine via projection on both sides later rev
+            return None
+        outer_expr, inner_sym = corr_pairs[0]
+        node, src_sym = self._as_symbol(node, outer_expr, "existskey")
+        # EXISTS ignores NULL-key three-valued subtleties (no membership marker)
+        return SemiJoinNode(node, inner_node, src_sym, inner_sym, mark=None,
+                            negated=negated, null_aware=False)
+
+    @staticmethod
+    def _is_correlated_error(e: SemanticError, outer: Scope) -> bool:
+        """Did a standalone subquery plan fail on a column the OUTER scope knows?"""
+        import re
+        m = re.search(r"column '(?:\w+\.)?(\w+)' cannot be resolved", str(e))
+        return m is not None and outer.try_resolve(m.group(1)) is not None
+
+    def _split_correlated_eq(self, conj: t.Expression, outer: Scope,
+                             inner: Scope) -> Optional[Tuple[RowExpression, Symbol]]:
+        if not (isinstance(conj, t.ComparisonExpression) and conj.op == "="):
+            return None
+        for a, b in ((conj.left, conj.right), (conj.right, conj.left)):
+            try:
+                ae = ExpressionTranslator(inner).translate(a)
+            except SemanticError:
+                continue
+            if not isinstance(ae, SymbolRef):
+                continue
+            try:
+                be = ExpressionTranslator(outer).translate(b)
+            except SemanticError:
+                continue
+            return (be, Symbol(ae.name, ae.type))
+        return None
+
+    def _as_symbol(self, node: PlanNode, expr: RowExpression,
+                   hint: str) -> Tuple[PlanNode, Symbol]:
+        if isinstance(expr, SymbolRef):
+            return node, Symbol(expr.name, expr.type)
+        sym = self.symbols.new_symbol(hint, expr.type)
+        assigns = [(s, symbol_ref(s.name, s.type)) for s in node.outputs()]
+        assigns.append((sym, expr))
+        return ProjectNode(node, assigns), sym
+
+    # --------------------------------------------------------- ungrouped
+
+    def _plan_ungrouped(self, node: PlanNode, scope: Scope,
+                        spec: t.QuerySpecification,
+                        select_items: List[t.SelectItem]) -> RelationPlan:
+        assigns: List[Tuple[Symbol, RowExpression]] = []
+        out_fields: List[Field] = []
+        tr = ExpressionTranslator(scope)
+        for i, item in enumerate(select_items):
+            e = tr.translate(item.expression)
+            name = item.alias.lower() if item.alias else _name_of(item.expression, i)
+            if isinstance(e, SymbolRef):
+                sym = Symbol(e.name, e.type)
+            else:
+                sym = self.symbols.new_symbol(name, e.type)
+            assigns.append((sym, e))
+            out_fields.append(Field(name, sym, None))
+        proj = ProjectNode(node, assigns)
+        out = RelationPlan(proj, Scope(out_fields))
+        if spec.distinct:
+            out = RelationPlan(
+                AggregationNode(out.node, [f.symbol for f in out_fields], []),
+                out.scope)
+        return self._plan_order_limit(out, spec.order_by, spec.limit,
+                                      pre_scope=scope, select_items=select_items,
+                                      pre_node=node)
+
+    # ----------------------------------------------------------- grouped
+
+    def _plan_grouped(self, node: PlanNode, scope: Scope,
+                      spec: t.QuerySpecification,
+                      select_items: List[t.SelectItem]) -> RelationPlan:
+        tr = ExpressionTranslator(scope)
+
+        # resolve group-by expressions (ordinals + select aliases allowed)
+        key_asts: List[t.Expression] = []
+        for g in spec.group_by:
+            if isinstance(g, t.LongLiteral):
+                if not 1 <= g.value <= len(select_items):
+                    raise SemanticError(
+                        f"GROUP BY position {g.value} is not in select list "
+                        f"(1..{len(select_items)})")
+                key_asts.append(select_items[g.value - 1].expression)
+                continue
+            if isinstance(g, t.Identifier) and scope.try_resolve(g.name.lower()) is None:
+                match = [i for i in select_items
+                         if i.alias and i.alias.lower() == g.name.lower()]
+                if match:
+                    key_asts.append(match[0].expression)
+                    continue
+            key_asts.append(g)
+
+        pre_assigns: List[Tuple[Symbol, RowExpression]] = []
+        pre_index: Dict[RowExpression, Symbol] = {}
+
+        def pre_project(e: RowExpression, hint: str) -> Symbol:
+            if isinstance(e, SymbolRef):
+                sym = Symbol(e.name, e.type)
+                if e not in pre_index:
+                    pre_index[e] = sym
+                    pre_assigns.append((sym, e))
+                return sym
+            if e in pre_index:
+                return pre_index[e]
+            sym = self.symbols.new_symbol(hint, e.type)
+            pre_index[e] = sym
+            pre_assigns.append((sym, e))
+            return sym
+
+        # group keys
+        ast_subst: Dict[t.Node, t.Node] = {}
+        post_fields: List[Field] = []
+        key_syms: List[Symbol] = []
+        for i, ka in enumerate(key_asts):
+            e = tr.translate(ka)
+            sym = pre_project(e, _name_of(ka, i))
+            key_syms.append(sym)
+            marker = f"$gk{i}"
+            ast_subst[ka] = t.Identifier(marker)
+            post_fields.append(Field(marker, sym, None))
+            if isinstance(ka, t.Identifier):
+                post_fields.append(Field(ka.name.lower(), sym, None))
+            elif isinstance(ka, t.DereferenceExpression) and \
+                    isinstance(ka.base, t.Identifier):
+                post_fields.append(
+                    Field(ka.field.lower(), sym, ka.base.name.lower()))
+
+        # aggregates from select + having + order by
+        agg_asts: List[t.FunctionCall] = []
+        sources = [i.expression for i in select_items]
+        if spec.having is not None:
+            sources.append(spec.having)
+        for s in spec.order_by:
+            sources.append(s.sort_key)
+        for src in sources:
+            for a in extract_aggregates(src):
+                if a not in ast_subst:
+                    agg_asts.append(a)
+
+        aggregations: List[Tuple[Symbol, AggregationCall]] = []
+        for j, a in enumerate(agg_asts):
+            if a in ast_subst:
+                continue
+            name = a.name.lower()
+            arg_syms = []
+            arg_types = []
+            for arg in a.args:
+                ae = tr.translate(arg)
+                arg_syms.append(pre_project(ae, _name_of(arg, j)))
+                arg_types.append(ae.type)
+            filt = None
+            if a.filter is not None:
+                fe = tr.translate(a.filter)
+                filt = pre_project(fe, f"filter{j}")
+            out_t = aggregate_output_type(name, arg_types)
+            sym = self.symbols.new_symbol(name, out_t)
+            aggregations.append(
+                (sym, AggregationCall(name, tuple(arg_syms), a.distinct, filt)))
+            marker = f"$agg{j}"
+            ast_subst[a] = t.Identifier(marker)
+            post_fields.append(Field(marker, sym, None))
+
+        pre = ProjectNode(node, pre_assigns)
+        agg = AggregationNode(pre, key_syms, aggregations)
+        post_scope = Scope(post_fields)
+        node2: PlanNode = agg
+
+        if spec.having is not None:
+            h_ast = rewrite_ast(spec.having, ast_subst)
+            node2 = self._plan_where(node2, post_scope, h_ast)
+
+        # output projection
+        post_tr = ExpressionTranslator(post_scope)
+        assigns: List[Tuple[Symbol, RowExpression]] = []
+        out_fields: List[Field] = []
+        rewritten_items: List[t.SelectItem] = []
+        for i, item in enumerate(select_items):
+            ast = rewrite_ast(item.expression, ast_subst)
+            rewritten_items.append(t.SelectItem(ast, item.alias))
+            e = post_tr.translate(ast)
+            name = item.alias.lower() if item.alias else _name_of(item.expression, i)
+            if isinstance(e, SymbolRef):
+                sym = Symbol(e.name, e.type)
+            else:
+                sym = self.symbols.new_symbol(name, e.type)
+            assigns.append((sym, e))
+            out_fields.append(Field(name, sym, None))
+        proj = ProjectNode(node2, assigns)
+        out = RelationPlan(proj, Scope(out_fields))
+        if spec.distinct:
+            out = RelationPlan(
+                AggregationNode(out.node, [f.symbol for f in out_fields], []),
+                out.scope)
+        order_by = tuple(t.SortItem(rewrite_ast(s.sort_key, ast_subst),
+                                    s.descending, s.nulls_first)
+                         for s in spec.order_by)
+        return self._plan_order_limit(out, order_by, spec.limit,
+                                      pre_scope=post_scope,
+                                      select_items=rewritten_items,
+                                      pre_node=node2)
+
+    # ------------------------------------------------------ order/limit
+
+    def _plan_order_limit(self, out: RelationPlan,
+                          order_by: Sequence[t.SortItem], limit: Optional[int],
+                          pre_scope: Optional[Scope] = None,
+                          select_items: Optional[List[t.SelectItem]] = None,
+                          pre_node: Optional[PlanNode] = None) -> RelationPlan:
+        node = out.node
+        if order_by:
+            orderings = []
+            extra_assigns: List[Tuple[Symbol, RowExpression]] = []
+            out_syms = {f.symbol.name for f in out.scope.fields}
+            for s in order_by:
+                sym = self._resolve_sort_key(s.sort_key, out, select_items,
+                                             pre_scope)
+                if sym is None:
+                    # expression over the pre-projection scope: hidden sort column
+                    if pre_scope is None:
+                        raise SemanticError(f"cannot order by {s.sort_key}")
+                    e = ExpressionTranslator(pre_scope).translate(s.sort_key)
+                    sym = self.symbols.new_symbol("sortkey", e.type)
+                    extra_assigns.append((sym, e))
+                nf = s.nulls_first if s.nulls_first is not None else s.descending
+                orderings.append(Ordering(sym, s.descending, nf))
+            if extra_assigns:
+                # widen the output projection with hidden sort symbols
+                if not isinstance(node, ProjectNode):
+                    raise SemanticError("hidden sort keys need a projection root")
+                node = ProjectNode(node.source,
+                                   list(node.assignments) + extra_assigns)
+            node = SortNode(node, orderings)
+            if extra_assigns:
+                keep = [(f.symbol, symbol_ref(f.symbol.name, f.symbol.type))
+                        for f in out.scope.fields]
+                node = ProjectNode(node, keep)
+        if limit is not None:
+            node = LimitNode(node, limit)
+        return RelationPlan(node, out.scope)
+
+    def _resolve_sort_key(self, key: t.Expression, out: RelationPlan,
+                          select_items: Optional[List[t.SelectItem]],
+                          pre_scope: Optional[Scope]) -> Optional[Symbol]:
+        fields = out.scope.fields
+        if isinstance(key, t.LongLiteral):
+            if not 1 <= key.value <= len(fields):
+                raise SemanticError(
+                    f"ORDER BY position {key.value} is not in select list "
+                    f"(1..{len(fields)})")
+            return fields[key.value - 1].symbol
+        if isinstance(key, t.Identifier):
+            n = key.name.lower()
+            for f in fields:
+                if f.name == n:
+                    return f.symbol
+        if select_items is not None:
+            for i, item in enumerate(select_items):
+                if item.expression == key:
+                    return fields[i].symbol
+        # try translating against the output scope (plain column passthrough)
+        try:
+            e = ExpressionTranslator(out.scope).translate(key)
+            if isinstance(e, SymbolRef):
+                return Symbol(e.name, e.type)
+        except SemanticError:
+            pass
+        return None
+
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+
+def _equi_pair(expr: RowExpression, left_syms: set,
+               right_syms: set) -> Optional[Tuple[Symbol, Symbol]]:
+    if not (isinstance(expr, Call) and expr.name == "equal"):
+        return None
+    a, b = expr.args
+    if not (isinstance(a, SymbolRef) and isinstance(b, SymbolRef)):
+        return None
+    if a.name in left_syms and b.name in right_syms:
+        return (Symbol(a.name, a.type), Symbol(b.name, b.type))
+    if b.name in left_syms and a.name in right_syms:
+        return (Symbol(b.name, b.type), Symbol(a.name, a.type))
+    return None
+
+
+def _contains_subquery(node: t.Node) -> bool:
+    if isinstance(node, (t.SubqueryExpression, t.ExistsPredicate)):
+        return True
+    for f in dataclasses.fields(node):
+        v = getattr(node, f.name)
+        if isinstance(v, t.Node) and _contains_subquery(v):
+            return True
+        if isinstance(v, tuple):
+            for x in v:
+                if isinstance(x, t.Node) and _contains_subquery(x):
+                    return True
+    return False
+
+
+def _name_of(expr: t.Expression, i: int) -> str:
+    if isinstance(expr, t.Identifier):
+        return expr.name.lower()
+    if isinstance(expr, t.DereferenceExpression):
+        return expr.field.lower()
+    if isinstance(expr, t.FunctionCall):
+        return expr.name.lower()
+    return f"_col{i}"

@@ -192,11 +192,15 @@ class VarcharType(Type):
     """
 
     length: Optional[int] = None  # None == unbounded
+    # wide=True: int64 codes, for synthesized dictionaries whose code space exceeds
+    # 31 bits (packed word combinations, formatted id strings — see the tpch
+    # generator's PackedWordsDictionary / FormattedDictionary)
+    wide: bool = False
     name: ClassVar[str] = "varchar"
 
     @property
     def np_dtype(self) -> np.dtype:
-        return np.dtype(np.int32)  # dictionary code
+        return np.dtype(np.int64 if self.wide else np.int32)  # dictionary code
 
     @property
     def fixed_width(self) -> bool:
@@ -234,6 +238,7 @@ BOOLEAN = BooleanType()
 DATE = DateType()
 TIMESTAMP = TimestampType()
 VARCHAR = VarcharType()
+WIDE_VARCHAR = VarcharType(wide=True)
 UNKNOWN = UnknownType()
 
 
