@@ -304,6 +304,7 @@ class LookupJoinOperator(Operator):
         self.f = factory
         self._outputs: List[Page] = []
         self._source: Optional[LookupSource] = None
+        self._semi_kernel = None  # lazily jitted (closes over the join filter)
 
     @property
     def output_types(self) -> List[Type]:
@@ -340,8 +341,13 @@ class LookupJoinOperator(Operator):
                 "the planner must not route them here yet")
         # unique fast path requires exact key equality through sorted_key/dense table;
         # multi-key hashes must range-scan + verify via the expansion path
-        if (src.unique and (src.kind == "dense" or src.exact_keys)) \
-                or self.f.join_type in (SEMI, ANTI):
+        if self.f.join_type in (SEMI, ANTI):
+            if self.f.filter_fn is None and src.exact_keys:
+                row = self._match_rows(src, probe_keys, probe_mask)
+                self._emit_unique(page, row, probe_mask)
+            else:
+                self._emit_semi_expanded(page, probe_keys, probe_mask)
+        elif src.unique and (src.kind == "dense" or src.exact_keys):
             row = self._match_rows(src, probe_keys, probe_mask)
             self._emit_unique(page, row, probe_mask)
         else:
@@ -350,13 +356,70 @@ class LookupJoinOperator(Operator):
     def _match_rows(self, src, probe_keys, probe_mask):
         if src.kind == "dense":
             return _probe_match_unique(src.table, src.base, probe_keys[0], probe_mask)
-        if not src.exact_keys and self.f.join_type in (SEMI, ANTI):
-            raise NotImplementedError(
-                "multi-key semi/anti joins need range-scan verification; "
-                "single-key (the TPC cases) are supported")
         return _probe_match_sorted_unique(src.sorted_key, src.sorted_row,
                                           tuple(probe_keys), probe_mask,
                                           src.key_arrays)
+
+    def _emit_semi_expanded(self, page: Page, probe_keys, probe_mask) -> None:
+        """SEMI/ANTI with a join filter or multi-key: range-scan every candidate
+        match, verify true keys, evaluate the filter on the (probe,build) pair, and
+        OR-reduce per probe row. The SemiJoinOperator-with-filter analogue
+        (reference: LookupJoinOperator + JoinFilterFunctionCompiler)."""
+        src = self._source
+        ck = combined_key(probe_keys)
+        lo, emit, _match, total_dev = _range_kernel(
+            src.sorted_key, ck, probe_mask, page.mask, False)
+        total = int(total_dev)
+        cap = page.capacity
+        offsets = jnp.cumsum(emit)
+        any_match = jnp.zeros(cap, dtype=jnp.bool_)
+        if self._semi_kernel is None:
+            self._semi_kernel = jax.jit(self._semi_chunk)
+        for c in range(max(0, -(-total // cap))):
+            any_match = self._semi_kernel(
+                page, tuple(probe_keys), lo, offsets, src.sorted_row,
+                tuple(src.key_arrays), tuple(src.payload),
+                tuple(src.payload_nulls), jnp.asarray(c * cap),
+                jnp.asarray(total), any_match)
+        if self.f.join_type == SEMI:
+            keep = page.mask & any_match
+        else:
+            keep = page.mask & ~any_match
+            if self.f.null_aware:
+                keep = keep & probe_mask
+                if src.has_null_key:
+                    keep = jnp.zeros_like(keep)
+        sel = page.select_channels(self.f.probe_output_channels)
+        self._push(Page(sel.blocks, keep))
+
+    def _semi_chunk(self, page, probe_keys, lo, offsets, sorted_row, key_arrays,
+                    payload, payload_nulls, out_base, total, any_match):
+        cap = page.mask.shape[0]
+        j = jnp.arange(cap, dtype=jnp.int32) + out_base
+        live = j < total
+        pi = jnp.clip(jnp.searchsorted(offsets, j, side="right").astype(jnp.int32),
+                      0, cap - 1)
+        prev = jnp.where(pi > 0, offsets[jnp.maximum(pi - 1, 0)], 0)
+        spos = jnp.clip(lo[pi] + (j - prev), 0, sorted_row.shape[0] - 1)
+        brow = sorted_row[spos]
+        ok = live
+        for pk, bk in zip(probe_keys, key_arrays):
+            ok = ok & (bk[brow] == pk[pi])
+        if self.f.filter_fn is not None:
+            datas, nulls = [], []
+            for pc in self.f.filter_probe_channels:
+                b = page.blocks[pc]
+                datas.append(b.data[pi])
+                nulls.append(b.nulls[pi] if b.nulls is not None else None)
+            for bc in self.f.filter_build_channels:
+                datas.append(payload[bc][brow])
+                bn = payload_nulls[bc] if bc < len(payload_nulls) else None
+                nulls.append(bn[brow] if bn is not None else None)
+            fd, fnu = self.f.filter_fn(tuple(datas), tuple(nulls))
+            ok = ok & fd
+            if fnu is not None:
+                ok = ok & ~fnu
+        return any_match.at[pi].max(ok)
 
     def _emit_unique(self, page: Page, row, probe_mask) -> None:
         src = self._source
@@ -512,8 +575,16 @@ class LookupJoinOperatorFactory(OperatorFactory):
                  build_output_channels: List[int],
                  build_output_meta: List[Tuple[Type, Optional[Dictionary]]],
                  join_type: str = INNER, semi_output_channel: Optional[int] = None,
-                 null_aware: bool = False):
+                 null_aware: bool = False, filter_fn=None,
+                 filter_probe_channels: Optional[List[int]] = None,
+                 filter_build_channels: Optional[List[int]] = None):
         super().__init__(operator_id, f"LookupJoin({join_type})")
+        # join filter: compiled expression over [filter_probe_channels... page
+        # channels, filter_build_channels... payload columns] evaluated per
+        # candidate (probe,build) pair — JoinFilterFunctionCompiler analogue
+        self.filter_fn = filter_fn
+        self.filter_probe_channels = filter_probe_channels or []
+        self.filter_build_channels = filter_build_channels or []
         self.lookup_factory = lookup_factory
         self.probe_key_channels = probe_key_channels
         self.probe_output_channels = probe_output_channels
