@@ -315,6 +315,9 @@ class LocalExecutionPlanner:
         if node.mark is not None:
             raise NotImplementedError("mark semi join arrives with the "
                                       "subquery-expression rev")
+        if node.residual is not None:
+            raise NotImplementedError("semi-join residual filter arrives with "
+                                      "the Q21 decorrelation rev")
         fac = LookupJoinOperatorFactory(
             next(self._ids), build_fac.lookup_factory,
             [src.channel(node.source_key.name)], out_ch, meta, [], [], jt,

@@ -214,3 +214,15 @@ def run_query(builder, *args, **kw):
     driver, sink = builder(*args, **kw)
     driver.run_to_completion()
     return sink.rows()
+
+
+_QUERY_TABLES = {"q1": ["lineitem"], "q6": ["lineitem"],
+                 "q3": ["lineitem", "orders", "customer"]}
+
+
+def source_rows(query: str, schema: str) -> int:
+    """Total input rows a query scans (the presto-benchmark rows/sec denominator)."""
+    from ..connectors.tpch.connector import SCHEMAS
+
+    sf = SCHEMAS[schema]
+    return sum(g.table_row_count(t, sf) for t in _QUERY_TABLES[query])

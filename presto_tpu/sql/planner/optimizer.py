@@ -175,7 +175,8 @@ def _pushdown(node: PlanNode, conjuncts: List[RowExpression]) -> PlanNode:
         src = _pushdown(node.source, to_src)
         filt = _pushdown(node.filtering_source, [])
         out = SemiJoinNode(src, filt, node.source_key, node.filtering_key,
-                           node.mark, node.negated, node.null_aware)
+                           node.mark, node.negated, node.null_aware,
+                           node.residual)
         return _wrap_filter(out, keep)
 
     if isinstance(node, AggregationNode):
@@ -460,10 +461,16 @@ def _prune(node: PlanNode, required: Set[str]) -> PlanNode:
 
     if isinstance(node, SemiJoinNode):
         need = set(required) | {node.source_key.name}
+        fneed = {node.filtering_key.name}
+        if node.residual is not None:
+            rsyms = symbols_in(node.residual)
+            need |= rsyms
+            fneed |= rsyms
         src = _prune(node.source, need)
-        filt = _prune(node.filtering_source, {node.filtering_key.name})
+        filt = _prune(node.filtering_source, fneed)
         return SemiJoinNode(src, filt, node.source_key, node.filtering_key,
-                            node.mark, node.negated, node.null_aware)
+                            node.mark, node.negated, node.null_aware,
+                            node.residual)
 
     if isinstance(node, AggregationNode):
         aggs = [(s, c) for s, c in node.aggregations if s.name in required] \

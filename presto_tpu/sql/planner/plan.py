@@ -172,7 +172,7 @@ class JoinNode(PlanNode):
 
 @_node
 class SemiJoinNode(PlanNode):
-    """plan/SemiJoinNode: membership of source_keys in filtering_source keys,
+    """plan/SemiJoinNode: membership of source_key in filtering_source keys,
     optionally under a join `residual` filter evaluated per (source,filtering)
     candidate pair (decorrelated EXISTS with non-equi conjuncts, e.g. TPC-H Q21).
     Output = source outputs + mark symbol (when mark is not None); when mark is
@@ -180,8 +180,8 @@ class SemiJoinNode(PlanNode):
     non-members)."""
     source: PlanNode
     filtering_source: PlanNode
-    source_keys: List[Symbol]
-    filtering_keys: List[Symbol]
+    source_key: Symbol
+    filtering_key: Symbol
     mark: Optional[Symbol] = None
     negated: bool = False
     null_aware: bool = True  # IN/NOT IN three-valued semantics vs EXISTS
@@ -197,8 +197,8 @@ class SemiJoinNode(PlanNode):
         return [self.source, self.filtering_source]
 
     def with_children(self, children):
-        return SemiJoinNode(children[0], children[1], self.source_keys,
-                            self.filtering_keys, self.mark, self.negated,
+        return SemiJoinNode(children[0], children[1], self.source_key,
+                            self.filtering_key, self.mark, self.negated,
                             self.null_aware, self.residual)
 
 
@@ -353,8 +353,8 @@ def plan_to_text(node: PlanNode, indent: int = 0) -> str:
         crit = ", ".join(f"{l.name} = {r.name}" for l, r in node.criteria)
         detail = f" [{node.type} {crit}]" + (f" filter [{node.residual}]" if node.residual else "")
     elif isinstance(node, SemiJoinNode):
-        sk = ",".join(s.name for s in node.source_keys)
-        fk = ",".join(s.name for s in node.filtering_keys)
+        sk = node.source_key.name
+        fk = node.filtering_key.name
         detail = f" [{sk} in {fk}{' negated' if node.negated else ''}]" + \
                  (f" filter [{node.residual}]" if node.residual else "")
     elif isinstance(node, (TopNNode, SortNode)):

@@ -1,0 +1,67 @@
+"""Run all 22 TPC-H queries against the sqlite oracle and report pass/fail.
+
+Usage: JAX_PLATFORMS=cpu python tools/tpch_sweep.py [--sf 0.01] [--queries 1,3,5]
+Mirrors the reference's AbstractTestQueries full-suite sweep
+(presto-tests/.../AbstractTestQueries.java) at small scale.
+"""
+import argparse
+import datetime
+import os
+import sys
+import time
+import traceback
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--sf", type=float, default=0.01)
+    ap.add_argument("--queries", type=str, default="")
+    ap.add_argument("--distributed", action="store_true",
+                    help="run through the distributed (mesh) runner")
+    args = ap.parse_args()
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    from presto_tpu.models.tpch_sql import QUERIES
+    from presto_tpu.utils.testing import SqliteOracle, assert_rows_equal
+    from tests.test_sql_e2e import to_sqlite
+
+    if args.distributed:
+        from presto_tpu.parallel.runner import DistributedQueryRunner
+        runner = DistributedQueryRunner()
+    else:
+        from presto_tpu.runner import LocalQueryRunner
+        runner = LocalQueryRunner()
+    oracle = SqliteOracle()
+    oracle.load_tpch(args.sf, ["region", "nation", "supplier", "part",
+                               "partsupp", "customer", "orders", "lineitem"])
+
+    qs = [int(x) for x in args.queries.split(",") if x] or sorted(QUERIES)
+    npass = 0
+    for q in qs:
+        t0 = time.time()
+        try:
+            res = runner.execute(QUERIES[q])
+            exp = oracle.query(to_sqlite(QUERIES[q]))
+
+            def norm(row):
+                return [(v - datetime.date(1970, 1, 1)).days
+                        if isinstance(v, datetime.date) else v for v in row]
+            assert_rows_equal([norm(r) for r in res.rows], exp, ordered=True,
+                              rel_tol=1e-6)
+            npass += 1
+            print(f"Q{q:02d} PASS  {time.time()-t0:6.2f}s  {len(res.rows)} rows")
+        except Exception as e:
+            msg = traceback.format_exception_only(type(e), e)[-1].strip()
+            print(f"Q{q:02d} FAIL  {time.time()-t0:6.2f}s  {msg[:160]}")
+    print(f"\n{npass}/{len(qs)} passed")
+    return 0 if npass == len(qs) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
