@@ -94,7 +94,10 @@ NOISE_WORDS = ("the of and a in to is was he for it with as his on be at by had 
                "but from or have an they which one you were all her she there would their "
                "we him been has when who will no more if out so up said what its about "
                "than into them can only other time new some could these two may first then "
-               "do any like my now over such our man me even most made after also").split()
+               "do any like my now over such our man me even most made after also "
+               # the spec's query-predicate phrases (Q13 '%special%requests%',
+               # Q16 '%Customer%Complaints%') so those LIKEs select real subsets
+               "special requests Customer Complaints").split()
 
 # date window: days since epoch for 1992-01-01 .. 1998-12-31
 MIN_DATE = 8035   # 1992-01-01
@@ -107,13 +110,24 @@ CURRENT_DATE = 9298  # 1995-06-17, spec's ':3' anchor for Q1-style predicates
 # ---------------------------------------------------------------------------
 
 class FormattedDictionary(Dictionary):
-    """code -> format(code); nothing materialized. For Customer#%09d-style columns."""
+    """code -> format(code); nothing materialized. For Customer#%09d-style columns.
 
-    def __init__(self, fmt: Callable[[np.ndarray], np.ndarray], size_hint: int = 0):
+    `substr_rules` maps (start, length) -> (output Dictionary, code transform fn):
+    a synthesized-prefix rule declaring that substring(col, start, length) equals
+    output_dict.lookup(transform(codes)) — e.g. the phone country code. This is how
+    substr over a virtual column lowers to pure device arithmetic instead of a
+    string scan (Q22's substring(c_phone, 1, 2))."""
+
+    def __init__(self, fmt: Callable[[np.ndarray], np.ndarray], size_hint: int = 0,
+                 substr_rules: Optional[dict] = None, monotonic: bool = False):
         # deliberately skip super().__init__: no values array
         self.fmt = fmt
         self.size_hint = size_hint
         self._index = None
+        self.substr_rules = substr_rules or {}
+        # monotonic: code order == lexicographic order of the formatted strings
+        # (zero-padded fixed-width formats); lets ORDER BY sort by raw codes
+        self.monotonic = monotonic
 
     def __len__(self):
         return self.size_hint
@@ -213,17 +227,23 @@ DICT_ORDERSTATUS = Dictionary(["F", "O", "P"])
 DICT_P_NAME = PackedWordsDictionary(COLORS, 5)
 DICT_COMMENT = PackedWordsDictionary(NOISE_WORDS, 6)
 DICT_CUST_NAME = FormattedDictionary(
-    lambda c: np.asarray([f"Customer#{i:09d}" for i in c], dtype=object))
+    lambda c: np.asarray([f"Customer#{i:09d}" for i in c], dtype=object),
+    monotonic=True)
 DICT_SUPP_NAME = FormattedDictionary(
-    lambda c: np.asarray([f"Supplier#{i:09d}" for i in c], dtype=object))
+    lambda c: np.asarray([f"Supplier#{i:09d}" for i in c], dtype=object),
+    monotonic=True)
 DICT_CLERK = FormattedDictionary(
-    lambda c: np.asarray([f"Clerk#{i:09d}" for i in c], dtype=object))
+    lambda c: np.asarray([f"Clerk#{i:09d}" for i in c], dtype=object),
+    monotonic=True)
 DICT_ADDRESS = FormattedDictionary(
     lambda c: np.asarray([f"addr-{i:x}" for i in c], dtype=object))
+DICT_PHONE_COUNTRY = Dictionary([str(11 + k) for k in range(25)])
 DICT_PHONE = FormattedDictionary(
     lambda c: np.asarray(
         [f"{11 + (i % 25)}-{(i // 25) % 900 + 100}-{(i // 977) % 900 + 100}-{i % 9000 + 1000}"
-         for i in c], dtype=object))
+         for i in c], dtype=object),
+    # substring(phone, 1, 2) is the country code "11".."35" = code % 25 + 11
+    substr_rules={(1, 2): (DICT_PHONE_COUNTRY, lambda c: c % 25)})
 
 
 def _comment_codes(tid: int, cid: int, idx: np.ndarray) -> np.ndarray:

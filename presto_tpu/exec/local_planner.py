@@ -304,9 +304,41 @@ class LocalExecutionPlanner:
     def visit_SemiJoinNode(self, node: SemiJoinNode) -> Chain:
         src = self.visit(node.source)
         filt = self.visit(node.filtering_source)
+
+        # residual filter (decorrelated EXISTS with non-equi correlated
+        # conjuncts, Q21): compile over [probe residual cols..., build residual
+        # cols...] and evaluate per candidate (source,filtering) pair — the
+        # JoinFilterFunctionCompiler analogue wired into _emit_semi_expanded
+        filter_fn = None
+        filter_probe_ch: List[int] = []
+        filter_build_ch: List[int] = []
+        payload_ch: List[int] = []
+        payload_meta: List[Tuple[Type, Optional[Dictionary]]] = []
+        if node.residual is not None:
+            from ..ops.expressions import ExpressionCompiler
+            from ..sql.planner.optimizer import symbols_in
+            rsyms = symbols_in(node.residual)
+            src_names = {s.name for s in src.symbols}
+            probe_list = sorted(n for n in rsyms if n in src_names)
+            build_list = sorted(n for n in rsyms if n not in src_names)
+            filter_probe_ch = [src.channel(n) for n in probe_list]
+            payload_ch = [filt.channel(n) for n in build_list]
+            payload_meta = filt.meta(build_list)
+            filter_build_ch = list(range(len(build_list)))
+            mapping = {n: i for i, n in enumerate(probe_list)}
+            mapping.update({n: len(probe_list) + i
+                            for i, n in enumerate(build_list)})
+            layout = InputLayout(
+                [src.symbols[c].type for c in filter_probe_ch] +
+                [t for t, _ in payload_meta],
+                [src.dicts[c] for c in filter_probe_ch] +
+                [d for _, d in payload_meta])
+            resolved = resolve_symbols(node.residual, mapping)
+            filter_fn = ExpressionCompiler(layout).compile(resolved)
+
         build_fac = JoinBuildOperatorFactory(
-            next(self._ids), [filt.channel(node.filtering_key.name)], [], [],
-            strategy="sorted", unique=False)
+            next(self._ids), [filt.channel(node.filtering_key.name)],
+            payload_ch, payload_meta, strategy="sorted", unique=False)
         self.pipelines.append(filt.factories + [build_fac])
         out_ch = list(range(len(src.symbols)))
         meta = src.meta([s.name for s in src.symbols])
@@ -315,13 +347,12 @@ class LocalExecutionPlanner:
         if node.mark is not None:
             raise NotImplementedError("mark semi join arrives with the "
                                       "subquery-expression rev")
-        if node.residual is not None:
-            raise NotImplementedError("semi-join residual filter arrives with "
-                                      "the Q21 decorrelation rev")
         fac = LookupJoinOperatorFactory(
             next(self._ids), build_fac.lookup_factory,
             [src.channel(node.source_key.name)], out_ch, meta, [], [], jt,
-            semi_output_channel=semi_mark, null_aware=node.null_aware)
+            semi_output_channel=semi_mark, null_aware=node.null_aware,
+            filter_fn=filter_fn, filter_probe_channels=filter_probe_ch,
+            filter_build_channels=filter_build_ch)
         return Chain(src.factories + [fac], list(src.symbols), list(src.dicts))
 
     @staticmethod

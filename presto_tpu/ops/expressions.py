@@ -666,35 +666,14 @@ class ExpressionCompiler:
         vf = self._compile(value)[0]
         pred = _like_to_predicate(pattern.value, escape)
 
-        # PackedWordsDictionary fast path: '%word%' / '%w1%w2%' containment patterns
+        # PackedWordsDictionary path: %t1%t2%...% ordered-containment patterns
+        # lower to a DP over the packed word fields (exact LIKE semantics)
         from ..connectors.tpch.generator import PackedWordsDictionary
         if isinstance(d, PackedWordsDictionary):
-            words = re.findall(r"%([^%_]+)%", pattern.value)
-            joined = "%" + "%".join(words) + "%" if words else None
-            if joined == pattern.value and words:
-                word_lists = [w.strip() for w in words]
-                ids = []
-                ok = True
-                for w in word_lists:
-                    # containment of a full word or sub-phrase of fields
-                    if " " in w or d.word_id(w) < 0:
-                        ok = False
-                        break
-                    ids.append(d.word_id(w))
-                if ok:
-                    bits, nf = d.BITS, d.n_fields
-
-                    def fn(datas, nulls):
-                        vd, vn = vf(datas, nulls)
-                        c = vd.astype(jnp.int64)
-                        res = jnp.ones(jnp.shape(c), jnp.bool_)
-                        for wid in ids:
-                            hit = jnp.zeros(jnp.shape(c), jnp.bool_)
-                            for f_ in range(nf):
-                                hit = hit | (((c >> (bits * f_)) & ((1 << bits) - 1)) == wid)
-                            res = res & hit
-                        return res, vn
-                    return fn
+            # escaped patterns would need escape-aware tokenization; fall through
+            fn = None if escape is not None else _packed_like(d, pattern.value, vf)
+            if fn is not None:
+                return fn
             # fall through: cannot evaluate analytically
             raise NotImplementedError(f"LIKE {pattern.value!r} on packed column")
         codes = d.codes_where(pred)
@@ -725,10 +704,24 @@ class ExpressionCompiler:
                 (length is not None and not isinstance(length, Constant)):
             raise NotImplementedError("substr requires dictionary input + literal bounds")
         if not hasattr(d, "values"):
-            # virtual dictionaries (FormattedDictionary) materialize no values array
+            # virtual dictionaries (FormattedDictionary) materialize no values
+            # array; a synthesized substring rule maps codes to a small real
+            # dictionary with pure device arithmetic (e.g. phone country code)
+            rule = getattr(d, "substr_rules", {}).get(
+                (int(start.value),
+                 int(length.value) if length is not None else None))
+            if rule is not None:
+                nd_, transform = rule
+                vf_ = self._compile(value)[0]
+
+                def vfn(datas, nulls):
+                    vd, vn = vf_(datas, nulls)
+                    return transform(vd).astype(jnp.int32), vn
+                return vfn, nd_
             raise NotImplementedError(
-                f"substr over a virtual dictionary ({type(d).__name__}) needs a "
-                "synthesized-prefix rule (planned for the Q22 rev)")
+                f"substr over a virtual dictionary ({type(d).__name__}) has no "
+                f"synthesized rule for ({start.value}, "
+                f"{length.value if length is not None else None})")
         s = int(start.value) - 1
         ln = int(length.value) if length is not None else None
         new_values = [v[s:s + ln] if ln is not None else v[s:] for v in d.values]
@@ -746,6 +739,86 @@ class ExpressionCompiler:
 # ---------------------------------------------------------------------------
 # helpers
 # ---------------------------------------------------------------------------
+
+def _packed_like(d, pattern: str, vf):
+    """LIKE over a PackedWordsDictionary column, exactly, without materializing
+    strings: patterns of the form %t1%t2%...% (tokens free of '%'/'_'/separator)
+    are an ordered-substring-containment test, evaluated as a dynamic program
+    over the packed word fields. State s = "first s tokens matched"; a field's
+    word can advance s->e when tokens[s:e] appear in order inside that word
+    (a single word may satisfy several consecutive tokens). Word-id -> advance
+    lookup tables are precomputed host-side; the device side is n_fields gathers.
+    Returns None when the pattern is not of this shape (caller falls back)."""
+    if "_" in pattern:
+        return None
+    anchored_start = not pattern.startswith("%")
+    anchored_end = not pattern.endswith("%")
+    tokens = [t for t in pattern.split("%") if t != ""]
+    if not tokens or any(d.sep in t for t in tokens):
+        return None
+    k = len(tokens)
+
+    def contains_seq(word: str, toks, at_start=False, at_end=False) -> bool:
+        """tokens appear in order within the word; optionally the first must
+        start at position 0 / the last must end at the word's end."""
+        pos = 0
+        for i, t in enumerate(toks):
+            if i == 0 and at_start:
+                if not word.startswith(t):
+                    return False
+                j = 0
+            else:
+                j = word.find(t, pos)
+                if j < 0:
+                    return False
+            pos = j + len(t)
+        if at_end and toks:
+            last = toks[-1]
+            # re-find the last token as far right as possible after the prior ones
+            prior_end = 0
+            for t in toks[:-1]:
+                prior_end = word.find(t, prior_end) + len(t)
+            return word.endswith(last) and word.rfind(last) >= prior_end
+        return True
+
+    # advance tables: word moves the DP from "s tokens matched" to "e matched".
+    # Anchored variants pin the first/last token to the word's boundary; an
+    # anchored start additionally restricts matching to field 0.
+    tables = {}
+    for s in range(k):
+        for e in range(s + 1, k + 1):
+            a_s = anchored_start and s == 0
+            a_e = anchored_end and e == k
+            tables[(s, e, a_s, a_e)] = np.asarray(
+                [contains_seq(w, tokens[s:e], a_s, a_e) for w in d.words],
+                dtype=bool)
+    bits, nf, nw = d.BITS, d.n_fields, len(d.words)
+
+    def fn(datas, nulls):
+        vd, vn = vf(datas, nulls)
+        c = vd.astype(jnp.int64)
+        shape = jnp.shape(c)
+        states = [jnp.ones(shape, jnp.bool_)] + \
+                 [jnp.zeros(shape, jnp.bool_) for _ in range(k)]
+        for f in range(nf):
+            wid = jnp.clip((c >> (bits * f)) & ((1 << bits) - 1), 0, nw - 1)
+            new = list(states)
+            for e in range(1, k + 1):
+                for s in range(e):
+                    a_s = anchored_start and s == 0 and f == 0
+                    a_e = anchored_end and e == k
+                    if anchored_start and s == 0 and f > 0:
+                        continue  # match must begin in field 0
+                    if a_e and f != nf - 1:
+                        continue  # match must end in the last field
+                    hit = jnp.asarray(tables[(s, e, a_s, a_e)])[wid]
+                    new[e] = new[e] | (states[s] & hit)
+            states = new
+            if anchored_start and f == 0:
+                states[0] = jnp.zeros(shape, jnp.bool_)
+        return states[k], vn
+    return fn
+
 
 def _merge_dicts(a: Optional[Dictionary], b: Optional[Dictionary]) -> Optional[Dictionary]:
     """Output dictionary of a branch merge (IF/SWITCH/COALESCE). Branches that are

@@ -41,8 +41,15 @@ def _sort_key_arrays(page: Page, orders: Sequence[SortOrder]) -> Tuple[jnp.ndarr
         b = page.blocks[o.channel]
         x = b.data
         if is_string(b.type) and b.dictionary is not None:
-            ranks = jnp.asarray(b.dictionary.sort_keys())
-            x = ranks[x]
+            d = b.dictionary
+            if hasattr(d, "values"):
+                ranks = jnp.asarray(d.sort_keys())
+                x = ranks[x]
+            elif not getattr(d, "monotonic", False):
+                # virtual dictionaries sort by code only when the format is
+                # order-preserving (e.g. zero-padded Supplier#%09d)
+                raise NotImplementedError(
+                    f"ORDER BY over non-monotonic virtual dictionary {d!r}")
         if x.dtype == jnp.bool_:
             x = x.astype(jnp.int32)
         if o.descending:

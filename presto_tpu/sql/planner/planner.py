@@ -301,7 +301,8 @@ class LogicalPlanner:
         # [NOT] EXISTS (subquery)
         if isinstance(inner, t.ExistsPredicate):
             sub_ast = inner.subquery.query
-            corr = self._decorrelate_exists(node, scope, sub_ast, negated)
+            corr = self._speculate(self._decorrelate_exists, node, scope,
+                                   sub_ast, negated)
             if corr is not None:
                 return corr
             try:
@@ -329,6 +330,15 @@ class LogicalPlanner:
     def _plan_scalar_compare(self, node: PlanNode, scope: Scope,
                              value_ast: t.Expression, sub: t.SubqueryExpression,
                              op: str, flipped: bool) -> PlanNode:
+        from ..analyzer import _CMP_NAMES
+        dec = self._speculate(self._decorrelate_scalar_agg, node, scope, sub.query)
+        if dec is not None:
+            joined, val_sym = dec
+            value = ExpressionTranslator(scope).translate(value_ast)
+            sref = symbol_ref(val_sym.name, val_sym.type)
+            left, right = (sref, value) if flipped else (value, sref)
+            pred = Call(BOOLEAN, _CMP_NAMES[op], (left, right))
+            return FilterNode(joined, pred)
         try:
             subplan = self.plan_query(sub.query)
         except SemanticError as e:
@@ -350,6 +360,127 @@ class LogicalPlanner:
         pred = Call(BOOLEAN, _CMP_NAMES[op], (left, right))
         return FilterNode(joined, pred)
 
+    def _speculate(self, fn, *args):
+        """Run a speculative decorrelation attempt; on bail-out (None) restore the
+        symbol allocator so the discarded sub-plan doesn't consume names that the
+        generic re-planning path would then uglify with _1 suffixes."""
+        saved = dict(self.symbols._counts)
+        out = fn(*args)
+        if out is None:
+            self.symbols._counts = saved
+        return out
+
+    def _decorrelate_scalar_agg(self, node: PlanNode, scope: Scope,
+                                sub: t.Query) -> Optional[Tuple[PlanNode, Symbol]]:
+        """Correlated scalar aggregate subquery (TPC-H Q2/Q17/Q20 shape):
+        value <op> (SELECT f(agg(..)) FROM .. WHERE outer=inner [AND inner-only..])
+        -> group the subquery by its correlation keys and inner-join the outer
+        side on them (the reference's
+        iterative/rule/TransformCorrelatedScalarAggregationToJoin.java).
+
+        The inner join is exact here: a correlation key with no inner rows makes
+        the scalar subquery yield NULL, and NULL satisfies no comparison, so
+        dropping the key via the join matches. That argument fails for count-like
+        aggregates (0 on empty input), which bail out to the generic error path."""
+        if sub.with_ is not None or sub.order_by or sub.limit is not None:
+            return None
+        body = sub.body
+        if not isinstance(body, t.QuerySpecification) or body.group_by or \
+                body.having is not None or body.from_ is None or \
+                len(body.select_items) != 1 or body.distinct:
+            return None
+        item = body.select_items[0]
+        if not contains_aggregates(item.expression):
+            return None
+        aggs = extract_aggregates(item.expression)
+        if any(a.name.lower() in ("count", "count_if") for a in aggs):
+            return None
+        # the inner-join argument also requires the select expression to be
+        # NULL-strict in the aggregates: coalesce(sum(y), 0)-style wrappers give
+        # empty groups a non-NULL value, which the join would wrongly drop
+        if _contains_null_masking(item.expression):
+            return None
+        inner_plan = self.plan_relation(body.from_)
+        inner_scope = inner_plan.scope
+        corr_pairs: List[Tuple[RowExpression, Symbol]] = []
+        inner_conjs: List[RowExpression] = []
+        for conj in _conjuncts(body.where):
+            # innermost scope wins: only a conjunct that does NOT resolve against
+            # the subquery's own relations can be a correlation predicate
+            try:
+                inner_conjs.append(ExpressionTranslator(inner_scope).translate(conj))
+                continue
+            except SemanticError:
+                pass
+            pair = self._split_correlated_eq(conj, scope, inner_scope)
+            if pair is not None:
+                corr_pairs.append(pair)
+                continue
+            return None  # correlation shape we cannot decorrelate yet
+        if not corr_pairs:
+            return None  # uncorrelated: generic scalar path handles it
+        inner_node = inner_plan.node
+        pred = _and_all(inner_conjs)
+        if pred is not None:
+            inner_node = FilterNode(inner_node, pred)
+
+        key_syms = [sym for _, sym in corr_pairs]
+        tr = ExpressionTranslator(inner_scope)
+        pre_assigns: List[Tuple[Symbol, RowExpression]] = []
+        pre_index: Dict[RowExpression, Symbol] = {}
+
+        def pre_project(e: RowExpression, hint: str) -> Symbol:
+            if isinstance(e, SymbolRef):
+                sym = Symbol(e.name, e.type)
+            elif e in pre_index:
+                return pre_index[e]
+            else:
+                sym = self.symbols.new_symbol(hint, e.type)
+            if e not in pre_index:
+                pre_index[e] = sym
+                pre_assigns.append((sym, e))
+            return sym
+
+        for sym in key_syms:
+            pre_project(symbol_ref(sym.name, sym.type), sym.name)
+        ast_subst: Dict[t.Node, t.Node] = {}
+        aggregations: List[Tuple[Symbol, AggregationCall]] = []
+        post_fields: List[Field] = []
+        for j, a in enumerate(aggs):
+            if a in ast_subst:
+                continue
+            name = a.name.lower()
+            arg_syms, arg_types = [], []
+            for arg in a.args:
+                ae = tr.translate(arg)
+                arg_syms.append(pre_project(ae, _name_of(arg, j)))
+                arg_types.append(ae.type)
+            filt = None
+            if a.filter is not None:
+                filt = pre_project(tr.translate(a.filter), f"filter{j}")
+            out_t = aggregate_output_type(name, arg_types)
+            asym = self.symbols.new_symbol(name, out_t)
+            aggregations.append(
+                (asym, AggregationCall(name, tuple(arg_syms), a.distinct, filt)))
+            marker = f"$cagg{j}"
+            ast_subst[a] = t.Identifier(marker)
+            post_fields.append(Field(marker, asym, None))
+
+        agg = AggregationNode(ProjectNode(inner_node, pre_assigns), key_syms,
+                              aggregations)
+        post_tr = ExpressionTranslator(Scope(post_fields))
+        val_expr = post_tr.translate(rewrite_ast(item.expression, ast_subst))
+        val_sym = self.symbols.new_symbol("subqval", val_expr.type)
+        assigns = [(s, symbol_ref(s.name, s.type)) for s in key_syms]
+        assigns.append((val_sym, val_expr))
+        sub_node: PlanNode = ProjectNode(agg, assigns)
+
+        criteria: List[Tuple[Symbol, Symbol]] = []
+        for outer_expr, inner_sym in corr_pairs:
+            node, osym = self._as_symbol(node, outer_expr, "corrkey")
+            criteria.append((osym, inner_sym))
+        return JoinNode("inner", node, sub_node, criteria, None), val_sym
+
     def _decorrelate_exists(self, node: PlanNode, scope: Scope, sub: t.Query,
                             negated: bool) -> Optional[PlanNode]:
         """Correlated EXISTS where the subquery's WHERE contains outer = inner
@@ -362,14 +493,24 @@ class LogicalPlanner:
         inner_scope = inner_plan.scope
         corr_pairs: List[Tuple[RowExpression, Symbol]] = []  # (outer expr, inner sym)
         inner_conjs: List[RowExpression] = []
+        residual_parts: List[RowExpression] = []  # over outer+inner symbols
         for conj in _conjuncts(body.where):
+            # innermost scope wins (same rule as _decorrelate_scalar_agg)
+            try:
+                inner_conjs.append(ExpressionTranslator(inner_scope).translate(conj))
+                continue
+            except SemanticError:
+                pass
             pair = self._split_correlated_eq(conj, scope, inner_scope)
             if pair is not None:
                 corr_pairs.append(pair)
                 continue
-            tr = ExpressionTranslator(inner_scope)
+            # general correlated conjunct (e.g. Q21's l2.l_suppkey <> l1.l_suppkey):
+            # keep as a semi-join residual evaluated per (source,filtering) pair
             try:
-                inner_conjs.append(tr.translate(conj))
+                combined = ExpressionTranslator(
+                    Scope(list(scope.fields) + list(inner_scope.fields)))
+                residual_parts.append(combined.translate(conj))
             except SemanticError:
                 return None  # correlation shape we cannot decorrelate yet
         if not corr_pairs:
@@ -385,7 +526,8 @@ class LogicalPlanner:
         node, src_sym = self._as_symbol(node, outer_expr, "existskey")
         # EXISTS ignores NULL-key three-valued subtleties (no membership marker)
         return SemiJoinNode(node, inner_node, src_sym, inner_sym, mark=None,
-                            negated=negated, null_aware=False)
+                            negated=negated, null_aware=False,
+                            residual=_and_all(residual_parts))
 
     @staticmethod
     def _is_correlated_error(e: SemanticError, outer: Scope) -> bool:
@@ -666,6 +808,25 @@ def _equi_pair(expr: RowExpression, left_syms: set,
     if b.name in left_syms and a.name in right_syms:
         return (Symbol(b.name, b.type), Symbol(a.name, a.type))
     return None
+
+
+def _contains_null_masking(node: t.Node) -> bool:
+    """Does the expression contain a construct that can map NULL to non-NULL
+    (COALESCE / CASE / IS [NOT] NULL)? Such expressions are not NULL-strict, so
+    scalar-agg decorrelation via inner join is unsound for them."""
+    if isinstance(node, (t.CoalesceExpression, t.SearchedCaseExpression,
+                         t.SimpleCaseExpression, t.IsNullPredicate,
+                         t.IsNotNullPredicate)):
+        return True
+    for f in dataclasses.fields(node):
+        v = getattr(node, f.name)
+        if isinstance(v, t.Node) and _contains_null_masking(v):
+            return True
+        if isinstance(v, tuple):
+            for x in v:
+                if isinstance(x, t.Node) and _contains_null_masking(x):
+                    return True
+    return False
 
 
 def _contains_subquery(node: t.Node) -> bool:

@@ -178,48 +178,9 @@ def test_cross_join_small(runner, oracle):
 
 
 # ---------------------------------------------------------------------------
-# TPC-H north star: Q1 / Q3 / Q5 / Q6 / Q9 (+ wider coverage)
+# TPC-H: the full 22-query suite vs the oracle (AbstractTestQueries pattern)
 # ---------------------------------------------------------------------------
 
-def _tpch(runner, oracle, n, **kw):
-    return check(runner, oracle, QUERIES[n], **kw)
-
-
-def test_tpch_q1(runner, oracle):
-    _tpch(runner, oracle, 1, ordered=True, rel_tol=1e-9)
-
-
-def test_tpch_q3(runner, oracle):
-    _tpch(runner, oracle, 3, ordered=True, rel_tol=1e-9)
-
-
-def test_tpch_q5(runner, oracle):
-    _tpch(runner, oracle, 5, ordered=True, rel_tol=1e-9)
-
-
-def test_tpch_q6(runner, oracle):
-    _tpch(runner, oracle, 6, rel_tol=1e-9)
-
-
-def test_tpch_q9(runner, oracle):
-    _tpch(runner, oracle, 9, ordered=True, rel_tol=1e-9)
-
-
-def test_tpch_q10(runner, oracle):
-    _tpch(runner, oracle, 10, ordered=True, rel_tol=1e-9)
-
-
-def test_tpch_q11(runner, oracle):
-    _tpch(runner, oracle, 11, ordered=True, rel_tol=1e-9)
-
-
-def test_tpch_q12(runner, oracle):
-    _tpch(runner, oracle, 12, ordered=True, rel_tol=1e-9)
-
-
-def test_tpch_q14(runner, oracle):
-    _tpch(runner, oracle, 14, rel_tol=1e-9)
-
-
-def test_tpch_q19(runner, oracle):
-    _tpch(runner, oracle, 19, rel_tol=1e-9)
+@pytest.mark.parametrize("q", sorted(QUERIES))
+def test_tpch(runner, oracle, q):
+    check(runner, oracle, QUERIES[q], ordered=True, rel_tol=1e-9)
