@@ -86,6 +86,8 @@ class LocalExecutionPlan:
     pipelines: List[List[object]]   # factory chains, dependency order
     sink: PageConsumerFactory
     output_names: List[str]
+    output_types: List[Type] = dataclasses.field(default_factory=list)
+    output_dicts: List[Optional[Dictionary]] = dataclasses.field(default_factory=list)
 
     def create_drivers(self) -> List[Driver]:
         return [Driver([f.create_operator() for f in chain])
@@ -93,12 +95,21 @@ class LocalExecutionPlan:
 
 
 class LocalExecutionPlanner:
-    """One instance per query."""
+    """One instance per query (per worker task in distributed mode).
 
-    def __init__(self, metadata: MetadataManager, session: Session):
+    `worker` = (index, count) scopes table scans to this worker's splits
+    (SOURCE distribution: SqlStageExecution split assignment analogue);
+    `remote_pages` maps producer fragment id -> this worker's exchange output
+    pages, read by RemoteSourceNode (the ExchangeOperator analogue)."""
+
+    def __init__(self, metadata: MetadataManager, session: Session,
+                 worker: Optional[Tuple[int, int]] = None,
+                 remote_pages: Optional[Dict[int, List[Page]]] = None):
         self.metadata = metadata
         self.session = session
         self.page_capacity = int(session.get("page_capacity"))
+        self.worker = worker
+        self.remote_pages = remote_pages or {}
         self._ids = itertools.count()
         self.pipelines: List[List[object]] = []
 
@@ -115,7 +126,9 @@ class LocalExecutionPlanner:
         sink = PageConsumerFactory(next(self._ids),
                                    [s.type for s in chain.symbols])
         self.pipelines.append(chain.factories + [sink])
-        return LocalExecutionPlan(self.pipelines, sink, root.column_names)
+        return LocalExecutionPlan(self.pipelines, sink, root.column_names,
+                                  [s.type for s in chain.symbols],
+                                  list(chain.dicts))
 
     # ------------------------------------------------------------ dispatch
 
@@ -180,6 +193,9 @@ class LocalExecutionPlanner:
     def _page_sources(self, node: TableScanNode) -> List[ConnectorPageSource]:
         conn = self.metadata.connector(node.table.connector_id)
         splits = conn.split_manager().get_splits(node.table, Constraint.all(), 8)
+        if self.worker is not None:
+            w, count = self.worker
+            splits = [s for i, s in enumerate(splits) if i % count == w]
         cols = [c for _, c in node.assignments]
         provider = conn.page_source_provider()
         sources = [provider.create_page_source(s, cols, self.page_capacity)
@@ -195,6 +211,15 @@ class LocalExecutionPlanner:
                                        processor.output_types, processor)
         return Chain([fac], [s for s, _ in node.assignments],
                      processor.output_dicts)
+
+    def visit_RemoteSourceNode(self, node) -> Chain:
+        """Replay this worker's exchange-output pages (ExchangeOperator.java:35
+        analogue — the collective already ran; this is the local endpoint)."""
+        pages, dicts = self.remote_pages[node.fragment_id]
+        from ..spi.connector import FixedPageSource
+        fac = TableScanOperatorFactory(next(self._ids), [FixedPageSource(pages)],
+                                       [s.type for s in node.symbols], None)
+        return Chain([fac], list(node.symbols), list(dicts))
 
     def visit_ValuesNode(self, node: ValuesNode) -> Chain:
         cap = max(len(node.rows), 1)
@@ -414,12 +439,30 @@ class LocalExecutionPlanner:
         key_domains = domains if domains and all(x is not None for x in domains) \
             else None
 
+        from ..sql.planner.plan import FINAL as P_FINAL, PARTIAL as P_PARTIAL
+        from ..ops.hash_agg import FINAL as OP_FINAL, PARTIAL as OP_PARTIAL
+
+        step = node.step
         calls = []
         out_dicts = list(key_dicts)
-        for sym, ac in node.aggregations:
-            arg_ch = [src.channel(a.name) for a in ac.args]
+        out_syms = list(node.keys)
+        for i, (sym, ac) in enumerate(node.aggregations):
             arg_types = [a.type for a in ac.args]
             fn = resolve_aggregate(ac.name, arg_types, ac.distinct)
+            if step == P_FINAL:
+                # inputs are the partial state columns named by the exchange plan
+                isyms = node.intermediate_symbols[i]
+                inter_ch = [src.channel(s.name) for s in isyms]
+                out_dict = src.dicts[inter_ch[0]] \
+                    if ac.name in ("min", "max", "arbitrary", "any_value") and \
+                    inter_ch and src.dicts[inter_ch[0]] is not None else None
+                calls.append(AggregateCall(fn, [], None,
+                                           intermediate_channels=inter_ch,
+                                           output_dictionary=out_dict))
+                out_dicts.append(out_dict)
+                out_syms.append(sym)
+                continue
+            arg_ch = [src.channel(a.name) for a in ac.args]
             mask_ch = src.channel(ac.filter.name) if ac.filter is not None else None
             out_dict = None
             if ac.name in ("min", "max", "arbitrary", "any_value") and arg_ch \
@@ -427,13 +470,22 @@ class LocalExecutionPlanner:
                 out_dict = src.dicts[arg_ch[0]]
             calls.append(AggregateCall(fn, arg_ch, mask_ch,
                                        output_dictionary=out_dict))
-            out_dicts.append(out_dict)
+            if step == P_PARTIAL:
+                isyms = node.intermediate_symbols[i]
+                out_syms.extend(isyms)
+                # min/max state over a dict column carries codes: keep the dict
+                # on the first state column so the exchange + FINAL can decode
+                for j, s in enumerate(isyms):
+                    out_dicts.append(out_dict if j == 0 else None)
+            else:
+                out_syms.append(sym)
+                out_dicts.append(out_dict)
 
+        op_step = {P_PARTIAL: OP_PARTIAL, P_FINAL: OP_FINAL}.get(step, SINGLE)
         fac = HashAggregationOperatorFactory(
             next(self._ids), key_ch, key_types, key_dicts, key_domains, calls,
-            SINGLE, self.page_capacity,
+            op_step, self.page_capacity,
             max_groups=int(self.session.get("max_groups")))
-        out_syms = list(node.keys) + [s for s, _ in node.aggregations]
         return Chain(src.factories + [fac], out_syms, out_dicts)
 
     def visit_UnionNode(self, node: UnionNode) -> Chain:

@@ -1,0 +1,241 @@
+"""DistributedQueryRunner: planner-driven SQL execution over the device mesh.
+
+The multi-chip analogue of presto-tests DistributedQueryRunner.java:77 — but
+where the reference boots N HTTP servers, here "workers" are mesh devices:
+
+  parse -> analyze/plan -> optimize -> AddExchanges -> PlanFragmenter
+  -> per fragment (bottom-up): drive each worker's operator pipeline over its
+     shard (worker-scoped splits or exchange-output pages)
+  -> route the fragment's output through ONE shard_map collective over the ICI
+     mesh (all_to_all repartition / all_gather broadcast / gather-to-root)
+
+The data plane between fragments is the real XLA collective — the engine's
+answer to the reference's HTTP+LZ4 shuffle (PartitionedOutputOperator.java:380,
+ExchangeClient.java). Worker tasks within a fragment currently run sequentially
+on the host control thread (the task-executor rev threads them); the collective
+itself always runs as one SPMD program over all workers.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..block import Block, Dictionary, Page
+from ..exec.local_planner import LocalExecutionPlanner
+from ..metadata import CatalogManager, Session
+from ..runner import LocalQueryRunner, QueryResult
+from ..sql import tree as t
+from ..sql.planner.add_exchanges import add_exchanges
+from ..sql.planner.fragmenter import (Fragment, SINGLE_PART, SOURCE_PART,
+                                      SubPlan, fragment_plan)
+from ..sql.planner.optimizer import optimize
+from ..sql.planner.plan import (BROADCAST, GATHER, OutputNode, REPARTITION,
+                                RemoteSourceNode, plan_to_text)
+from ..sql.planner.planner import LogicalPlanner
+from ..types import Type
+from .mesh import MeshContext, WORKER_AXIS
+
+# (pages for each worker, shared column dictionaries)
+RemoteInput = Tuple[List[Page], List[Optional[Dictionary]]]
+
+
+class DistributedQueryRunner:
+    """In-process multi-worker engine over a jax.sharding.Mesh."""
+
+    def __init__(self, mesh: Optional[MeshContext] = None,
+                 session: Optional[Session] = None,
+                 catalogs: Optional[CatalogManager] = None,
+                 page_capacity: int = 1 << 14):
+        self.local = LocalQueryRunner(session, catalogs, page_capacity)
+        self.mesh = mesh if mesh is not None else MeshContext()
+
+    @property
+    def metadata(self):
+        return self.local.metadata
+
+    @property
+    def session(self):
+        return self.local.session
+
+    # ------------------------------------------------------------------ api
+
+    def plan_sql(self, sql: str) -> SubPlan:
+        stmt = self.local.parser.parse(sql)
+        if not isinstance(stmt, t.Query):
+            raise ValueError(f"cannot plan {type(stmt).__name__}")
+        return self.plan_statement(stmt)
+
+    def plan_statement(self, stmt: t.Query) -> SubPlan:
+        planner = LogicalPlanner(self.metadata, self.session)
+        plan = planner.plan(stmt)
+        plan = optimize(plan, self.metadata, self.session)
+        plan = add_exchanges(plan, planner.symbols)
+        return fragment_plan(plan)
+
+    def explain(self, sql: str) -> str:
+        sub = self.plan_sql(sql)
+        parts = []
+        for f in sub.fragments:
+            head = f"Fragment {f.id} [{f.partitioning}]"
+            if f.output_kind:
+                keys = f" keys={[k.name for k in f.output_keys]}" \
+                    if f.output_keys else ""
+                head += f" output={f.output_kind}{keys}"
+            parts.append(head + "\n" + plan_to_text(f.root, indent=1))
+        return "\n".join(parts)
+
+    def execute(self, sql: str) -> QueryResult:
+        stmt = self.local.parser.parse(sql)
+        if not isinstance(stmt, t.Query):
+            return self.local.execute(sql)  # EXPLAIN/SHOW et al stay local
+        sub = self.plan_statement(stmt)
+        return self._execute_subplan(sub)
+
+    # ------------------------------------------------------------ execution
+
+    def _execute_subplan(self, sub: SubPlan) -> QueryResult:
+        W = self.mesh.n_workers
+        # fid -> (per-worker routed pages, column dictionaries)
+        routed_inputs: Dict[int, Tuple[List[List[Page]],
+                                       List[Optional[Dictionary]]]] = {}
+        for frag in sub.fragments:
+            is_root = frag is sub.root_fragment
+            if is_root:
+                root = OutputNode(frag.root, sub.column_names,
+                                  sub.output_symbols)
+            else:
+                syms = frag.root.outputs()
+                root = OutputNode(frag.root, [s.name for s in syms], syms)
+            workers = [0] if frag.partitioning == SINGLE_PART else list(range(W))
+            per_worker: List[List[Page]] = [[] for _ in range(W)]
+            out_types: List[Type] = []
+            out_dicts: List[Optional[Dictionary]] = []
+            for w in workers:
+                remote = {fid: (pages[w], dicts)
+                          for fid, (pages, dicts) in routed_inputs.items()}
+                lp = LocalExecutionPlanner(
+                    self.metadata, self.session,
+                    worker=(w, W) if frag.partitioning == SOURCE_PART else None,
+                    remote_pages=remote)
+                ep = lp.plan(root)
+                for d in ep.create_drivers():
+                    d.run_to_completion()
+                out_types, out_dicts = ep.output_types, ep.output_dicts
+                if is_root:
+                    return QueryResult(ep.sink.rows(), sub.column_names)
+                per_worker[w] = [p for c in ep.sink.consumers for p in c.pages]
+            key_idx = None
+            if frag.output_kind == REPARTITION:
+                names = [s.name for s in frag.root.outputs()]
+                key_idx = [names.index(k.name) for k in frag.output_keys]
+            routed = run_exchange(self.mesh, frag.output_kind, key_idx,
+                                  per_worker, out_types, out_dicts)
+            routed_inputs[frag.id] = (routed, out_dicts)
+        raise AssertionError("root fragment must terminate execution")
+
+
+# ---------------------------------------------------------------------------
+# the exchange bridge: per-worker page lists -> one collective -> per-worker
+# page lists (the engine's entire shuffle data plane)
+# ---------------------------------------------------------------------------
+
+def _flatten_worker(pages: List[Page], types: Sequence[Type],
+                    length: int) -> Tuple[List[np.ndarray], List[np.ndarray],
+                                          np.ndarray]:
+    """Concat + pad this worker's pages to `length` rows per column."""
+    ncols = len(types)
+    datas: List[np.ndarray] = []
+    nulls: List[np.ndarray] = []
+    for c in range(ncols):
+        dt = np.dtype(types[c].np_dtype)
+        parts = [np.asarray(p.blocks[c].data) for p in pages]
+        col = np.concatenate(parts) if parts else np.zeros(0, dtype=dt)
+        col = col.astype(dt, copy=False)
+        nparts = [np.asarray(p.blocks[c].nulls) if p.blocks[c].nulls is not None
+                  else np.zeros(p.capacity, dtype=bool) for p in pages]
+        nm = np.concatenate(nparts) if nparts else np.zeros(0, dtype=bool)
+        pad = length - len(col)
+        if pad:
+            col = np.concatenate([col, np.zeros(pad, dtype=dt)])
+            nm = np.concatenate([nm, np.zeros(pad, dtype=bool)])
+        datas.append(col)
+        nulls.append(nm)
+    mparts = [np.asarray(p.mask) for p in pages]
+    mask = np.concatenate(mparts) if mparts else np.zeros(0, dtype=bool)
+    if length - len(mask):
+        mask = np.concatenate([mask, np.zeros(length - len(mask), dtype=bool)])
+    return datas, nulls, mask
+
+
+def run_exchange(mesh: MeshContext, kind: str, key_idx: Optional[List[int]],
+                 per_worker_pages: List[List[Page]], types: Sequence[Type],
+                 dicts: Sequence[Optional[Dictionary]]) -> List[List[Page]]:
+    """Route every worker's output pages to their consumers with ONE shard_map
+    collective over the mesh (REPARTITION=all_to_all, BROADCAST=all_gather,
+    GATHER=all_gather masked to worker 0)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from jax import shard_map
+
+    from ..ops.hash_join import combined_key
+    from .exchange import broadcast_gather, gather_to_single, repartition
+
+    W = mesh.n_workers
+    ncols = len(types)
+    L = max([sum(p.capacity for p in pages) for pages in per_worker_pages] + [1])
+
+    # stack to (W*L,) global arrays, leading axis sharded over workers
+    g_datas, g_nulls, g_mask = [], [], []
+    flat = [_flatten_worker(pages, types, L) for pages in per_worker_pages]
+    for c in range(ncols):
+        g_datas.append(np.concatenate([f[0][c] for f in flat]))
+        g_nulls.append(np.concatenate([f[1][c] for f in flat]))
+    g_mask = np.concatenate([f[2] for f in flat])
+
+    sharding = NamedSharding(mesh.mesh, P(WORKER_AXIS))
+    dev_arrays = [jax.device_put(a, sharding) for a in g_datas + g_nulls]
+    dev_mask = jax.device_put(g_mask, sharding)
+
+    def stage(arrays, mask):
+        if kind == REPARTITION:
+            keys = [jnp.where(arrays[ncols + i], 0, arrays[i]).astype(jnp.int64)
+                    for i in key_idx]
+            out, m, _dropped = repartition(list(arrays), mask,
+                                           combined_key(keys), W, L)
+            return tuple(out), m
+        if kind == BROADCAST:
+            out, m = broadcast_gather(list(arrays), mask)
+            return tuple(out), m
+        if kind == GATHER:
+            out, m = gather_to_single(list(arrays), mask)
+            return tuple(out), m
+        raise AssertionError(kind)
+
+    smapped = shard_map(
+        stage, mesh=mesh.mesh,
+        in_specs=(tuple(P(WORKER_AXIS) for _ in dev_arrays), P(WORKER_AXIS)),
+        out_specs=(tuple(P(WORKER_AXIS) for _ in dev_arrays), P(WORKER_AXIS)))
+    out_arrays, out_mask = jax.jit(smapped)(tuple(dev_arrays), dev_mask)
+
+    # split back into one page per worker
+    out_np = [np.asarray(a) for a in out_arrays]
+    mask_np = np.asarray(out_mask)
+    out_len = len(mask_np) // W
+    routed: List[List[Page]] = []
+    for w in range(W):
+        lo, hi = w * out_len, (w + 1) * out_len
+        m = mask_np[lo:hi]
+        if not m.any():
+            routed.append([])
+            continue
+        blocks = []
+        for c in range(ncols):
+            nm = out_np[ncols + c][lo:hi]
+            blocks.append(Block(types[c], out_np[c][lo:hi],
+                                nm if nm.any() else None, dicts[c]))
+        routed.append([Page(tuple(blocks), m)])
+    return routed

@@ -128,20 +128,31 @@ PARTIAL, FINAL, SINGLE = "partial", "final", "single"
 
 @_node
 class AggregationNode(PlanNode):
-    """plan/AggregationNode: group keys + aggregate assignments."""
+    """plan/AggregationNode: group keys + aggregate assignments.
+
+    `intermediate_symbols` (set by the exchange planner for PARTIAL/FINAL pairs)
+    names each call's state columns: a PARTIAL node OUTPUTS them, the matching
+    FINAL node READS them from its child (the reference threads the same
+    information through InternalAggregationFunction's intermediate type)."""
     source: PlanNode
     keys: List[Symbol]
     aggregations: List[Tuple[Symbol, AggregationCall]]
     step: str = SINGLE
+    intermediate_symbols: Optional[List[List[Symbol]]] = None
 
     def outputs(self):
+        if self.step == PARTIAL:
+            flat = [s for group in (self.intermediate_symbols or [])
+                    for s in group]
+            return list(self.keys) + flat
         return list(self.keys) + [s for s, _ in self.aggregations]
 
     def children(self):
         return [self.source]
 
     def with_children(self, children):
-        return AggregationNode(children[0], self.keys, self.aggregations, self.step)
+        return AggregationNode(children[0], self.keys, self.aggregations,
+                               self.step, self.intermediate_symbols)
 
 
 INNER, LEFT, RIGHT, FULL = "inner", "left", "right", "full"
@@ -270,6 +281,48 @@ class ValuesNode(PlanNode):
         return self
 
 
+# exchange kinds (SystemPartitioningHandle.java:59-65 vocabulary, TPU mapping:
+# REPARTITION = all_to_all, BROADCAST = all_gather, GATHER = all_gather + mask)
+REPARTITION, BROADCAST, GATHER = "repartition", "broadcast", "gather"
+
+
+@_node
+class ExchangeNode(PlanNode):
+    """plan/ExchangeNode (REMOTE scope): the distribution boundary the fragmenter
+    cuts at. `keys` drive hash routing for REPARTITION (empty for BROADCAST /
+    GATHER) — AddExchanges.java:132,205-253 analogue."""
+    source: PlanNode
+    kind: str                      # REPARTITION | BROADCAST | GATHER
+    keys: List[Symbol]
+
+    def outputs(self):
+        return self.source.outputs()
+
+    def children(self):
+        return [self.source]
+
+    def with_children(self, children):
+        return ExchangeNode(children[0], self.kind, self.keys)
+
+
+@_node
+class RemoteSourceNode(PlanNode):
+    """plan/RemoteSourceNode: a fragment's view of an upstream fragment's output
+    (what ExchangeOperator + ExchangeClient read over HTTP in the reference; here
+    the runner hands the collective's per-worker output pages to this node)."""
+    fragment_id: int
+    symbols: List[Symbol]
+
+    def outputs(self):
+        return list(self.symbols)
+
+    def children(self):
+        return []
+
+    def with_children(self, children):
+        return self
+
+
 @_node
 class OutputNode(PlanNode):
     """plan/OutputNode — the root: column names in user order."""
@@ -357,6 +410,11 @@ def plan_to_text(node: PlanNode, indent: int = 0) -> str:
         fk = node.filtering_key.name
         detail = f" [{sk} in {fk}{' negated' if node.negated else ''}]" + \
                  (f" filter [{node.residual}]" if node.residual else "")
+    elif isinstance(node, ExchangeNode):
+        detail = f" [{node.kind}" + \
+                 (f" keys={[k.name for k in node.keys]}" if node.keys else "") + "]"
+    elif isinstance(node, RemoteSourceNode):
+        detail = f" [fragment {node.fragment_id}]"
     elif isinstance(node, (TopNNode, SortNode)):
         o = ", ".join(f"{x.symbol.name}{' desc' if x.descending else ''}"
                       for x in node.orderings)
