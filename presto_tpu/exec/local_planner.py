@@ -81,6 +81,22 @@ class Chain:
         return [(self.symbols[idx[n]].type, self.dicts[idx[n]]) for n in names]
 
 
+class RemoteSourceSlot:
+    """Per-fragment exchange endpoint: the runner deposits each worker's routed
+    pages + shared dictionaries here after the collective runs (the consumer
+    half of the reference's OutputBuffer -> ExchangeClient pair)."""
+
+    def __init__(self, fragment_id: int):
+        self.fragment_id = fragment_id
+        self._pages_by_worker: Dict[int, List[Page]] = {}
+
+    def set_pages(self, worker: int, pages: List[Page]) -> None:
+        self._pages_by_worker[worker] = list(pages)
+
+    def pages(self, worker: int) -> List[Page]:
+        return self._pages_by_worker.get(worker, [])
+
+
 @dataclasses.dataclass
 class LocalExecutionPlan:
     pipelines: List[List[object]]   # factory chains, dependency order
@@ -88,28 +104,37 @@ class LocalExecutionPlan:
     output_names: List[str]
     output_types: List[Type] = dataclasses.field(default_factory=list)
     output_dicts: List[Optional[Dictionary]] = dataclasses.field(default_factory=list)
+    remote_slots: Dict[int, RemoteSourceSlot] = dataclasses.field(default_factory=dict)
 
-    def create_drivers(self) -> List[Driver]:
-        return [Driver([f.create_operator() for f in chain])
+    def create_drivers(self, worker: int = 0) -> List[Driver]:
+        """Instantiate one driver set for `worker`. The factory list is planned
+        ONCE per fragment and shared by every worker, so jitted kernels compile
+        once; per-worker state (splits, lookup slots, sinks) is keyed off the
+        worker index."""
+        return [Driver([f.create_operator(worker) for f in chain])
                 for chain in self.pipelines]
 
 
 class LocalExecutionPlanner:
-    """One instance per query (per worker task in distributed mode).
+    """One instance per query fragment (shared by all its worker tasks).
 
-    `worker` = (index, count) scopes table scans to this worker's splits
-    (SOURCE distribution: SqlStageExecution split assignment analogue);
-    `remote_pages` maps producer fragment id -> this worker's exchange output
-    pages, read by RemoteSourceNode (the ExchangeOperator analogue)."""
+    `n_workers` scopes table scans: worker w of n reads splits w, w+n, ...
+    (SOURCE distribution: SqlStageExecution split assignment analogue).
+    RemoteSourceNodes plan into RemoteSourceSlots exposed on the plan; the
+    distributed runner fills them per worker after each exchange collective."""
 
     def __init__(self, metadata: MetadataManager, session: Session,
-                 worker: Optional[Tuple[int, int]] = None,
-                 remote_pages: Optional[Dict[int, List[Page]]] = None):
+                 n_workers: int = 1,
+                 remote_dicts: Optional[Dict[int, List[Optional[Dictionary]]]] = None):
         self.metadata = metadata
         self.session = session
         self.page_capacity = int(session.get("page_capacity"))
-        self.worker = worker
-        self.remote_pages = remote_pages or {}
+        self.n_workers = n_workers
+        # producer fragment id -> its output dictionaries (a plan-time property:
+        # the runner plans fragments bottom-up and feeds each consumer the dicts
+        # of its already-planned producers)
+        self.remote_dicts = remote_dicts or {}
+        self.remote_slots: Dict[int, RemoteSourceSlot] = {}
         self._ids = itertools.count()
         self.pipelines: List[List[object]] = []
 
@@ -128,7 +153,7 @@ class LocalExecutionPlanner:
         self.pipelines.append(chain.factories + [sink])
         return LocalExecutionPlan(self.pipelines, sink, root.column_names,
                                   [s.type for s in chain.symbols],
-                                  list(chain.dicts))
+                                  list(chain.dicts), self.remote_slots)
 
     # ------------------------------------------------------------ dispatch
 
@@ -190,17 +215,21 @@ class LocalExecutionPlanner:
             dicts.append(meta.column(col.name).dictionary)
         return InputLayout([s.type for s, _ in node.assignments], dicts)
 
-    def _page_sources(self, node: TableScanNode) -> List[ConnectorPageSource]:
+    def _page_sources(self, node: TableScanNode):
+        """-> callable worker -> [page source]: splits dealt round-robin over
+        the fragment's workers, one concatenated source (= one driver) each."""
         conn = self.metadata.connector(node.table.connector_id)
         splits = conn.split_manager().get_splits(node.table, Constraint.all(), 8)
-        if self.worker is not None:
-            w, count = self.worker
-            splits = [s for i, s in enumerate(splits) if i % count == w]
         cols = [c for _, c in node.assignments]
         provider = conn.page_source_provider()
-        sources = [provider.create_page_source(s, cols, self.page_capacity)
-                   for s in splits]
-        return [_ConcatPageSource(sources)]
+        count = self.n_workers
+
+        def for_worker(w: int):
+            mine = [s for i, s in enumerate(splits) if i % count == w]
+            return [_ConcatPageSource(
+                provider.create_page_source(s, cols, self.page_capacity)
+                for s in mine)]
+        return for_worker
 
     def visit_TableScanNode(self, node: TableScanNode) -> Chain:
         layout = self._scan_layout(node)
@@ -213,12 +242,19 @@ class LocalExecutionPlanner:
                      processor.output_dicts)
 
     def visit_RemoteSourceNode(self, node) -> Chain:
-        """Replay this worker's exchange-output pages (ExchangeOperator.java:35
-        analogue — the collective already ran; this is the local endpoint)."""
-        pages, dicts = self.remote_pages[node.fragment_id]
+        """Replay each worker's exchange-output pages (ExchangeOperator.java:35
+        analogue — the collective already ran; this is the local endpoint). The
+        slot is filled by the runner between fragment executions."""
         from ..spi.connector import FixedPageSource
-        fac = TableScanOperatorFactory(next(self._ids), [FixedPageSource(pages)],
-                                       [s.type for s in node.symbols], None)
+        slot = self.remote_slots.get(node.fragment_id)
+        if slot is None:
+            slot = self.remote_slots[node.fragment_id] = \
+                RemoteSourceSlot(node.fragment_id)
+        fac = TableScanOperatorFactory(
+            next(self._ids), lambda w: [FixedPageSource(slot.pages(w))],
+            [s.type for s in node.symbols], None)
+        dicts = self.remote_dicts.get(node.fragment_id,
+                                      [None] * len(node.symbols))
         return Chain([fac], list(node.symbols), list(dicts))
 
     def visit_ValuesNode(self, node: ValuesNode) -> Chain:
@@ -245,8 +281,13 @@ class LocalExecutionPlanner:
         mask = np.arange(cap) < len(node.rows)
         page = Page(tuple(blocks), mask)
         from ..spi.connector import FixedPageSource
-        fac = TableScanOperatorFactory(next(self._ids), [FixedPageSource([page])],
-                                       [s.type for s in node.symbols], None)
+        # literal rows exist ONCE globally: only worker 0 materializes them
+        # (a SOURCE-partitioned fragment runs on every worker — emitting the
+        # page on each would multiply VALUES rows by the worker count)
+        fac = TableScanOperatorFactory(
+            next(self._ids),
+            lambda w: [FixedPageSource([page] if w == 0 else [])],
+            [s.type for s in node.symbols], None)
         return Chain([fac], list(node.symbols), dicts)
 
     # ------------------------------------------------------------- joins
@@ -512,16 +553,17 @@ class LocalExecutionPlanner:
             buffers.append(buf)
 
         class _ReplaySource(ConnectorPageSource):
-            def __init__(self, bufs):
+            def __init__(self, bufs, worker):
                 self.bufs = bufs
+                self.worker = worker
 
             def __iter__(self):
                 for b in self.bufs:
-                    for c in b.consumers:
-                        yield from c.pages
+                    yield from b.pages_for(self.worker)
 
-        fac = TableScanOperatorFactory(next(self._ids), [_ReplaySource(buffers)],
-                                       [s.type for s in node.symbols], None)
+        fac = TableScanOperatorFactory(
+            next(self._ids), lambda w: [_ReplaySource(buffers, w)],
+            [s.type for s in node.symbols], None)
         return Chain([fac], list(node.symbols), dicts or [])
 
     # ------------------------------------------------- sort / limit / misc

@@ -106,6 +106,5 @@ class FilterProjectOperatorFactory(OperatorFactory):
         self.processor = processor if processor is not None else \
             PageProcessor(layout, filter_expr, projections, compact_output)
 
-    def create_operator(self) -> Operator:
-        return FilterProjectOperator(OperatorContext(self.operator_id, self.name),
-                                     self.processor)
+    def create_operator(self, worker: int = 0) -> Operator:
+        return FilterProjectOperator(self.context(worker), self.processor)

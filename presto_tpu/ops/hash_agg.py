@@ -170,6 +170,12 @@ class GroupedAggregationBuilder:
         self._key_channels = tuple(key_channels)
         return self
 
+    def share_kernels(self, donor: "GroupedAggregationBuilder") -> None:
+        """Adopt a sibling builder's jitted kernel (identical static config) so
+        per-worker builder instances trace/compile once per factory, not once
+        per worker."""
+        self._page_kernel = donor._page_kernel
+
     def add_page(self, page: Page) -> None:
         cap = page.capacity
         gkeys, gstates, gvalid, _ = self._page_kernel(page, cap)
@@ -262,6 +268,9 @@ class DirectAggregationBuilder:
         self._key_channels = tuple(key_channels)
         return self
 
+    def share_kernels(self, donor: "DirectAggregationBuilder") -> None:
+        self._kernel = donor._kernel
+
     def _accumulate(self, page: Page, table, seen):
         datas = tuple(b.data for b in page.blocks)
         mask = page.mask
@@ -321,6 +330,9 @@ class GlobalAggregationBuilder:
 
     def set_channels(self, key_channels):
         return self
+
+    def share_kernels(self, donor: "GlobalAggregationBuilder") -> None:
+        self._kernel = donor._kernel
 
     def _accumulate(self, page: Page, state):
         mask = page.mask
@@ -490,13 +502,21 @@ class HashAggregationOperatorFactory(OperatorFactory):
         self.step = step
         self.page_capacity = page_capacity
         self.max_groups = max_groups
+        self._kernel_donor = None
 
-    def create_operator(self) -> Operator:
+    def create_operator(self, worker: int = 0) -> Operator:
         from_intermediate = self.step == FINAL
         builder = make_builder(self.key_types, self.key_dicts, self.key_domains,
                                self.calls, self.page_capacity, self.max_groups,
                                from_intermediate)
+        # all builders of this factory share one jitted kernel: instance state
+        # (tables, pending buffers) is per-builder, the traced computation is
+        # pure factory config — workers must not each pay the trace+compile
+        if self._kernel_donor is None:
+            self._kernel_donor = builder
+        else:
+            builder.share_kernels(self._kernel_donor)
         return HashAggregationOperator(
-            OperatorContext(self.operator_id, self.name), builder,
+            self.context(worker), builder,
             self.key_channels, self.key_types, self.key_dicts, self.calls,
             self.step, self.page_capacity)

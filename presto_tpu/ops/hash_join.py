@@ -90,21 +90,34 @@ class LookupSource:
 
 
 class LookupSourceFactory:
-    """PartitionedLookupSourceFactory analogue: a future the probes block on."""
+    """PartitionedLookupSourceFactory analogue: a future the probes block on.
+
+    One slot per worker task — each worker's build pipeline publishes its own
+    lookup source and only that worker's probe drivers consume it (the reference
+    scopes the factory to a task; here the factory is shared across workers for
+    kernel reuse, so the handoff is worker-keyed)."""
 
     def __init__(self):
-        self._event = threading.Event()
-        self._source: Optional[LookupSource] = None
+        self._lock = threading.Lock()
+        self._slots = {}
 
-    def set(self, source: LookupSource) -> None:
-        self._source = source
-        self._event.set()
+    def _slot(self, worker: int):
+        with self._lock:
+            slot = self._slots.get(worker)
+            if slot is None:
+                slot = self._slots[worker] = [threading.Event(), None]
+            return slot
 
-    def done(self) -> bool:
-        return self._event.is_set()
+    def set(self, source: LookupSource, worker: int = 0) -> None:
+        slot = self._slot(worker)
+        slot[1] = source
+        slot[0].set()
 
-    def get(self) -> LookupSource:
-        return self._source
+    def done(self, worker: int = 0) -> bool:
+        return self._slot(worker)[0].is_set()
+
+    def get(self, worker: int = 0) -> LookupSource:
+        return self._slot(worker)[1]
 
 
 # ---------------------------------------------------------------------------
@@ -142,7 +155,7 @@ class JoinBuildOperator(Operator):
         if self._finishing:
             return
         super().finish()
-        self.f.lookup_factory.set(self._build())
+        self.f.lookup_factory.set(self._build(), self.context.worker)
 
     def _build(self) -> LookupSource:
         kc = len(self.f.key_channels)
@@ -260,8 +273,8 @@ class JoinBuildOperatorFactory(OperatorFactory):
         self.dense_max = dense_max
         self.lookup_factory = LookupSourceFactory()
 
-    def create_operator(self) -> JoinBuildOperator:
-        return JoinBuildOperator(OperatorContext(self.operator_id, self.name), self)
+    def create_operator(self, worker: int = 0) -> JoinBuildOperator:
+        return JoinBuildOperator(self.context(worker), self)
 
 
 # ---------------------------------------------------------------------------
@@ -304,7 +317,6 @@ class LookupJoinOperator(Operator):
         self.f = factory
         self._outputs: List[Page] = []
         self._source: Optional[LookupSource] = None
-        self._semi_kernel = None  # lazily jitted (closes over the join filter)
 
     @property
     def output_types(self) -> List[Type]:
@@ -314,10 +326,11 @@ class LookupJoinOperator(Operator):
         if self._source is not None:
             return None
         lf = self.f.lookup_factory
-        if lf.done():
-            self._source = lf.get()
+        w = self.context.worker
+        if lf.done(w):
+            self._source = lf.get(w)
             return None
-        return lf.done
+        return lambda: lf.done(w)
 
     def needs_input(self) -> bool:
         return (not self._finishing and self._source is not None
@@ -327,8 +340,10 @@ class LookupJoinOperator(Operator):
     def add_input(self, page: Page) -> None:
         self.context.record_input(page, page.capacity)
         if self._source is None:
-            assert self.f.lookup_factory.done(), "probe received input before build finished"
-            self._source = self.f.lookup_factory.get()
+            w = self.context.worker
+            assert self.f.lookup_factory.done(w), \
+                "probe received input before build finished"
+            self._source = self.f.lookup_factory.get(w)
         src = self._source
         probe_keys = [page.blocks[c].data for c in self.f.probe_key_channels]
         probe_mask = page.mask
@@ -373,10 +388,12 @@ class LookupJoinOperator(Operator):
         cap = page.capacity
         offsets = jnp.cumsum(emit)
         any_match = jnp.zeros(cap, dtype=jnp.bool_)
-        if self._semi_kernel is None:
-            self._semi_kernel = jax.jit(self._semi_chunk)
+        if self.f._semi_kernel is None:
+            # jitted once per FACTORY (the closure reads only factory config),
+            # shared by every worker's probe operators
+            self.f._semi_kernel = jax.jit(self.f._semi_chunk)
         for c in range(max(0, -(-total // cap))):
-            any_match = self._semi_kernel(
+            any_match = self.f._semi_kernel(
                 page, tuple(probe_keys), lo, offsets, src.sorted_row,
                 tuple(src.key_arrays), tuple(src.payload),
                 tuple(src.payload_nulls), jnp.asarray(c * cap),
@@ -391,35 +408,6 @@ class LookupJoinOperator(Operator):
                     keep = jnp.zeros_like(keep)
         sel = page.select_channels(self.f.probe_output_channels)
         self._push(Page(sel.blocks, keep))
-
-    def _semi_chunk(self, page, probe_keys, lo, offsets, sorted_row, key_arrays,
-                    payload, payload_nulls, out_base, total, any_match):
-        cap = page.mask.shape[0]
-        j = jnp.arange(cap, dtype=jnp.int32) + out_base
-        live = j < total
-        pi = jnp.clip(jnp.searchsorted(offsets, j, side="right").astype(jnp.int32),
-                      0, cap - 1)
-        prev = jnp.where(pi > 0, offsets[jnp.maximum(pi - 1, 0)], 0)
-        spos = jnp.clip(lo[pi] + (j - prev), 0, sorted_row.shape[0] - 1)
-        brow = sorted_row[spos]
-        ok = live
-        for pk, bk in zip(probe_keys, key_arrays):
-            ok = ok & (bk[brow] == pk[pi])
-        if self.f.filter_fn is not None:
-            datas, nulls = [], []
-            for pc in self.f.filter_probe_channels:
-                b = page.blocks[pc]
-                datas.append(b.data[pi])
-                nulls.append(b.nulls[pi] if b.nulls is not None else None)
-            for bc in self.f.filter_build_channels:
-                datas.append(payload[bc][brow])
-                bn = payload_nulls[bc] if bc < len(payload_nulls) else None
-                nulls.append(bn[brow] if bn is not None else None)
-            fd, fnu = self.f.filter_fn(tuple(datas), tuple(nulls))
-            ok = ok & fd
-            if fnu is not None:
-                ok = ok & ~fnu
-        return any_match.at[pi].max(ok)
 
     def _emit_unique(self, page: Page, row, probe_mask) -> None:
         src = self._source
@@ -585,6 +573,7 @@ class LookupJoinOperatorFactory(OperatorFactory):
         self.filter_fn = filter_fn
         self.filter_probe_channels = filter_probe_channels or []
         self.filter_build_channels = filter_build_channels or []
+        self._semi_kernel = None  # lazily jitted, shared across workers
         self.lookup_factory = lookup_factory
         self.probe_key_channels = probe_key_channels
         self.probe_output_channels = probe_output_channels
@@ -602,5 +591,37 @@ class LookupJoinOperatorFactory(OperatorFactory):
             # mark-column mode appends the membership flag as the LAST channel
             self.output_types = [t for (t, _) in probe_output_meta] + [BOOLEAN]
 
-    def create_operator(self) -> LookupJoinOperator:
-        return LookupJoinOperator(OperatorContext(self.operator_id, self.name), self)
+    def create_operator(self, worker: int = 0) -> LookupJoinOperator:
+        return LookupJoinOperator(self.context(worker), self)
+
+    def _semi_chunk(self, page, probe_keys, lo, offsets, sorted_row, key_arrays,
+                    payload, payload_nulls, out_base, total, any_match):
+        """One output chunk of the verified semi/anti probe (a FACTORY method so
+        the shared jit captures only factory config, never an operator instance
+        and its build-side arrays)."""
+        cap = page.mask.shape[0]
+        j = jnp.arange(cap, dtype=jnp.int32) + out_base
+        live = j < total
+        pi = jnp.clip(jnp.searchsorted(offsets, j, side="right").astype(jnp.int32),
+                      0, cap - 1)
+        prev = jnp.where(pi > 0, offsets[jnp.maximum(pi - 1, 0)], 0)
+        spos = jnp.clip(lo[pi] + (j - prev), 0, sorted_row.shape[0] - 1)
+        brow = sorted_row[spos]
+        ok = live
+        for pk, bk in zip(probe_keys, key_arrays):
+            ok = ok & (bk[brow] == pk[pi])
+        if self.filter_fn is not None:
+            datas, nulls = [], []
+            for pc in self.filter_probe_channels:
+                b = page.blocks[pc]
+                datas.append(b.data[pi])
+                nulls.append(b.nulls[pi] if b.nulls is not None else None)
+            for bc in self.filter_build_channels:
+                datas.append(payload[bc][brow])
+                bn = payload_nulls[bc] if bc < len(payload_nulls) else None
+                nulls.append(bn[brow] if bn is not None else None)
+            fd, fnu = self.filter_fn(tuple(datas), tuple(nulls))
+            ok = ok & fd
+            if fnu is not None:
+                ok = ok & ~fnu
+        return any_match.at[pi].max(ok)

@@ -43,7 +43,9 @@ class OperatorStats:
 
 class OperatorContext:
     def __init__(self, operator_id: int, name: str,
-                 memory: Optional[MemoryTrackingContext] = None):
+                 memory: Optional[MemoryTrackingContext] = None,
+                 worker: int = 0):
+        self.worker = worker
         self.stats = OperatorStats(operator_id, name)
         self.memory = memory or MemoryTrackingContext(
             AggregatedMemoryContext(), AggregatedMemoryContext(), AggregatedMemoryContext())
@@ -113,15 +115,24 @@ class Operator(abc.ABC):
 
 
 class OperatorFactory(abc.ABC):
-    """operator/OperatorFactory — one per plan node, creates per-driver instances."""
+    """operator/OperatorFactory — one per plan node, creates per-driver instances.
+
+    ONE factory serves every worker task of its fragment (the reference ships the
+    factory list to each worker; here workers share the process, so sharing the
+    factory also shares its jit-compiled kernels — each kernel traces once, not
+    once per worker). `worker` selects worker-scoped state (splits, exchange
+    pages, lookup-source slots)."""
 
     def __init__(self, operator_id: int, name: str):
         self.operator_id = operator_id
         self.name = name
 
     @abc.abstractmethod
-    def create_operator(self) -> Operator:
+    def create_operator(self, worker: int = 0) -> Operator:
         ...
+
+    def context(self, worker: int = 0) -> "OperatorContext":
+        return OperatorContext(self.operator_id, self.name, worker=worker)
 
     def no_more_operators(self) -> None:
         pass

@@ -68,16 +68,27 @@ class TableScanOperator(Operator):
 
 
 class TableScanOperatorFactory(OperatorFactory):
-    def __init__(self, operator_id: int, page_sources: List[ConnectorPageSource],
-                 types: List[Type], processor: Optional[PageProcessor] = None):
+    """`page_sources` is either a list (every worker scans those sources — the
+    single-worker / replay case) or a callable worker -> source list (the
+    distributed case: worker-scoped splits or exchange-output pages). Each
+    create_operator(w) call consumes the next unclaimed source of worker w, so
+    several drivers of one worker can split a multi-source scan."""
+
+    def __init__(self, operator_id: int, page_sources, types: List[Type],
+                 processor: Optional[PageProcessor] = None):
         super().__init__(operator_id, "TableScan")
-        self._sources = list(page_sources)
+        if callable(page_sources):
+            self._sources_fn = page_sources
+        else:
+            srcs = list(page_sources)
+            self._sources_fn = lambda w: list(srcs)
         self._types = types
         self._processor = processor
-        self._next = 0
+        self._remaining = {}
 
-    def create_operator(self) -> Operator:
-        src = self._sources[self._next]
-        self._next += 1
-        return TableScanOperator(OperatorContext(self.operator_id, self.name),
-                                 src, self._types, self._processor)
+    def create_operator(self, worker: int = 0) -> Operator:
+        if worker not in self._remaining:
+            self._remaining[worker] = list(self._sources_fn(worker))
+        src = self._remaining[worker].pop(0)
+        return TableScanOperator(self.context(worker), src, self._types,
+                                 self._processor)

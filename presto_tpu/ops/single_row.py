@@ -71,6 +71,5 @@ class EnforceSingleRowOperatorFactory(OperatorFactory):
         self.types = types
         self.dicts = dicts or [None] * len(types)
 
-    def create_operator(self) -> EnforceSingleRowOperator:
-        return EnforceSingleRowOperator(
-            OperatorContext(self.operator_id, self.name), self.types, self.dicts)
+    def create_operator(self, worker: int = 0) -> EnforceSingleRowOperator:
+        return EnforceSingleRowOperator(self.context(worker), self.types, self.dicts)

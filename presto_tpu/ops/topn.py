@@ -130,8 +130,8 @@ class TopNOperatorFactory(OperatorFactory):
         self.types = types
         self.dicts = dicts or [None] * len(types)
 
-    def create_operator(self) -> TopNOperator:
-        return TopNOperator(OperatorContext(self.operator_id, self.name),
+    def create_operator(self, worker: int = 0) -> TopNOperator:
+        return TopNOperator(self.context(worker),
                             self.n, self.orders, self.types, self.dicts)
 
 
@@ -232,8 +232,8 @@ class OrderByOperatorFactory(OperatorFactory):
         self.dicts = dicts or [None] * len(types)
         self.output_channels = output_channels
 
-    def create_operator(self) -> OrderByOperator:
-        return OrderByOperator(OperatorContext(self.operator_id, self.name),
+    def create_operator(self, worker: int = 0) -> OrderByOperator:
+        return OrderByOperator(self.context(worker),
                                self.orders, self.types, self.dicts,
                                self.output_channels)
 
@@ -282,6 +282,5 @@ class LimitOperatorFactory(OperatorFactory):
         self.limit = limit
         self.types = types
 
-    def create_operator(self) -> LimitOperator:
-        return LimitOperator(OperatorContext(self.operator_id, self.name),
-                             self.limit, self.types)
+    def create_operator(self, worker: int = 0) -> LimitOperator:
+        return LimitOperator(self.context(worker), self.limit, self.types)

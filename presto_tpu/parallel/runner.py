@@ -18,6 +18,7 @@ itself always runs as one SPMD program over all workers.
 from __future__ import annotations
 
 import dataclasses
+import functools
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -28,8 +29,8 @@ from ..metadata import CatalogManager, Session
 from ..runner import LocalQueryRunner, QueryResult
 from ..sql import tree as t
 from ..sql.planner.add_exchanges import add_exchanges
-from ..sql.planner.fragmenter import (Fragment, SINGLE_PART, SOURCE_PART,
-                                      SubPlan, fragment_plan)
+from ..sql.planner.fragmenter import (Fragment, SINGLE_PART, SubPlan,
+                                      fragment_plan)
 from ..sql.planner.optimizer import optimize
 from ..sql.planner.plan import (BROADCAST, GATHER, OutputNode, REPARTITION,
                                 RemoteSourceNode, plan_to_text)
@@ -97,9 +98,8 @@ class DistributedQueryRunner:
 
     def _execute_subplan(self, sub: SubPlan) -> QueryResult:
         W = self.mesh.n_workers
-        # fid -> (per-worker routed pages, column dictionaries)
-        routed_inputs: Dict[int, Tuple[List[List[Page]],
-                                       List[Optional[Dictionary]]]] = {}
+        frag_dicts: Dict[int, List[Optional[Dictionary]]] = {}
+        routed: Dict[int, List[List[Page]]] = {}  # fid -> per-worker pages
         for frag in sub.fragments:
             is_root = frag is sub.root_fragment
             if is_root:
@@ -109,30 +109,29 @@ class DistributedQueryRunner:
                 syms = frag.root.outputs()
                 root = OutputNode(frag.root, [s.name for s in syms], syms)
             workers = [0] if frag.partitioning == SINGLE_PART else list(range(W))
-            per_worker: List[List[Page]] = [[] for _ in range(W)]
-            out_types: List[Type] = []
-            out_dicts: List[Optional[Dictionary]] = []
+            # plan ONCE per fragment: every worker shares the factories (and so
+            # the jit-compiled kernels); only splits/exchange pages differ
+            lp = LocalExecutionPlanner(self.metadata, self.session,
+                                       n_workers=W, remote_dicts=frag_dicts)
+            ep = lp.plan(root)
+            for fid, slot in ep.remote_slots.items():
+                for w in range(W):
+                    slot.set_pages(w, routed[fid][w])
             for w in workers:
-                remote = {fid: (pages[w], dicts)
-                          for fid, (pages, dicts) in routed_inputs.items()}
-                lp = LocalExecutionPlanner(
-                    self.metadata, self.session,
-                    worker=(w, W) if frag.partitioning == SOURCE_PART else None,
-                    remote_pages=remote)
-                ep = lp.plan(root)
-                for d in ep.create_drivers():
+                for d in ep.create_drivers(w):
                     d.run_to_completion()
-                out_types, out_dicts = ep.output_types, ep.output_dicts
-                if is_root:
-                    return QueryResult(ep.sink.rows(), sub.column_names)
-                per_worker[w] = [p for c in ep.sink.consumers for p in c.pages]
+            if is_root:
+                return QueryResult(ep.sink.rows(), sub.column_names)
+            per_worker = [ep.sink.pages_for(w) for w in range(W)]
             key_idx = None
             if frag.output_kind == REPARTITION:
                 names = [s.name for s in frag.root.outputs()]
                 key_idx = [names.index(k.name) for k in frag.output_keys]
-            routed = run_exchange(self.mesh, frag.output_kind, key_idx,
-                                  per_worker, out_types, out_dicts)
-            routed_inputs[frag.id] = (routed, out_dicts)
+            routed[frag.id] = run_exchange(
+                self.mesh, frag.output_kind, key_idx, per_worker,
+                ep.output_types, ep.output_dicts,
+                page_capacity=int(self.session.get("page_capacity")))
+            frag_dicts[frag.id] = ep.output_dicts
         raise AssertionError("root fragment must terminate execution")
 
 
@@ -141,64 +140,51 @@ class DistributedQueryRunner:
 # page lists (the engine's entire shuffle data plane)
 # ---------------------------------------------------------------------------
 
-def _flatten_worker(pages: List[Page], types: Sequence[Type],
-                    length: int) -> Tuple[List[np.ndarray], List[np.ndarray],
-                                          np.ndarray]:
-    """Concat + pad this worker's pages to `length` rows per column."""
+def _compact_worker(pages: List[Page], types: Sequence[Type]
+                    ) -> Tuple[List[np.ndarray], List[np.ndarray], int]:
+    """Concat this worker's pages and drop masked-off rows (host side).
+
+    Compaction is what keeps exchange shapes bounded by LIVE row counts: an
+    exchange's receive buffer is W x cap, so forwarding padding would multiply
+    page capacity by W at every exchange hop."""
     ncols = len(types)
+    mparts = [np.asarray(p.mask) for p in pages]
+    mask = np.concatenate(mparts) if mparts else np.zeros(0, dtype=bool)
+    keep = np.flatnonzero(mask)
     datas: List[np.ndarray] = []
     nulls: List[np.ndarray] = []
     for c in range(ncols):
         dt = np.dtype(types[c].np_dtype)
         parts = [np.asarray(p.blocks[c].data) for p in pages]
         col = np.concatenate(parts) if parts else np.zeros(0, dtype=dt)
-        col = col.astype(dt, copy=False)
+        datas.append(col.astype(dt, copy=False)[keep])
         nparts = [np.asarray(p.blocks[c].nulls) if p.blocks[c].nulls is not None
                   else np.zeros(p.capacity, dtype=bool) for p in pages]
         nm = np.concatenate(nparts) if nparts else np.zeros(0, dtype=bool)
-        pad = length - len(col)
-        if pad:
-            col = np.concatenate([col, np.zeros(pad, dtype=dt)])
-            nm = np.concatenate([nm, np.zeros(pad, dtype=bool)])
-        datas.append(col)
-        nulls.append(nm)
-    mparts = [np.asarray(p.mask) for p in pages]
-    mask = np.concatenate(mparts) if mparts else np.zeros(0, dtype=bool)
-    if length - len(mask):
-        mask = np.concatenate([mask, np.zeros(length - len(mask), dtype=bool)])
-    return datas, nulls, mask
+        nulls.append(nm[keep])
+    return datas, nulls, len(keep)
 
 
-def run_exchange(mesh: MeshContext, kind: str, key_idx: Optional[List[int]],
-                 per_worker_pages: List[List[Page]], types: Sequence[Type],
-                 dicts: Sequence[Optional[Dictionary]]) -> List[List[Page]]:
-    """Route every worker's output pages to their consumers with ONE shard_map
-    collective over the mesh (REPARTITION=all_to_all, BROADCAST=all_gather,
-    GATHER=all_gather masked to worker 0)."""
+def _pad_to(arr: np.ndarray, length: int) -> np.ndarray:
+    pad = length - len(arr)
+    if pad <= 0:
+        return arr
+    return np.concatenate([arr, np.zeros(pad, dtype=arr.dtype)])
+
+
+@functools.lru_cache(maxsize=256)
+def _exchange_program(mesh, kind: str, key_idx: Optional[Tuple[int, ...]],
+                      ncols: int, W: int, L: int):
+    """Build + jit the exchange collective ONCE per (mesh, kind, keys, shape)
+    signature — repeated exchanges of the same shape reuse the compiled XLA
+    program (the reference reuses its HTTP buffer machinery similarly)."""
     import jax
     import jax.numpy as jnp
-    from jax import lax
-    from jax.sharding import NamedSharding, PartitionSpec as P
+    from jax.sharding import PartitionSpec as P
     from jax import shard_map
 
     from ..ops.hash_join import combined_key
     from .exchange import broadcast_gather, gather_to_single, repartition
-
-    W = mesh.n_workers
-    ncols = len(types)
-    L = max([sum(p.capacity for p in pages) for pages in per_worker_pages] + [1])
-
-    # stack to (W*L,) global arrays, leading axis sharded over workers
-    g_datas, g_nulls, g_mask = [], [], []
-    flat = [_flatten_worker(pages, types, L) for pages in per_worker_pages]
-    for c in range(ncols):
-        g_datas.append(np.concatenate([f[0][c] for f in flat]))
-        g_nulls.append(np.concatenate([f[1][c] for f in flat]))
-    g_mask = np.concatenate([f[2] for f in flat])
-
-    sharding = NamedSharding(mesh.mesh, P(WORKER_AXIS))
-    dev_arrays = [jax.device_put(a, sharding) for a in g_datas + g_nulls]
-    dev_mask = jax.device_put(g_mask, sharding)
 
     def stage(arrays, mask):
         if kind == REPARTITION:
@@ -215,27 +201,73 @@ def run_exchange(mesh: MeshContext, kind: str, key_idx: Optional[List[int]],
             return tuple(out), m
         raise AssertionError(kind)
 
+    n_arrays = 2 * ncols
     smapped = shard_map(
-        stage, mesh=mesh.mesh,
-        in_specs=(tuple(P(WORKER_AXIS) for _ in dev_arrays), P(WORKER_AXIS)),
-        out_specs=(tuple(P(WORKER_AXIS) for _ in dev_arrays), P(WORKER_AXIS)))
-    out_arrays, out_mask = jax.jit(smapped)(tuple(dev_arrays), dev_mask)
+        stage, mesh=mesh,
+        in_specs=(tuple(P(WORKER_AXIS) for _ in range(n_arrays)), P(WORKER_AXIS)),
+        out_specs=(tuple(P(WORKER_AXIS) for _ in range(n_arrays)), P(WORKER_AXIS)))
+    return jax.jit(smapped)
 
-    # split back into one page per worker
+
+def run_exchange(mesh: MeshContext, kind: str, key_idx: Optional[List[int]],
+                 per_worker_pages: List[List[Page]], types: Sequence[Type],
+                 dicts: Sequence[Optional[Dictionary]],
+                 page_capacity: int = 1 << 14) -> List[List[Page]]:
+    """Route every worker's output pages to their consumers with ONE shard_map
+    collective over the mesh (REPARTITION=all_to_all, BROADCAST=all_gather,
+    GATHER=all_gather masked to worker 0)."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    W = mesh.n_workers
+    ncols = len(types)
+    flat = [_compact_worker(pages, types) for pages in per_worker_pages]
+    # bucket L (live rows of the fullest worker) to powers of two so repeated
+    # exchanges of similar volume reuse one compiled collective
+    L = max(max(f[2] for f in flat), 1)
+    L = 1 << (L - 1).bit_length()
+
+    # stack to (W*L,) global arrays, leading axis sharded over workers
+    g_datas = [np.concatenate([_pad_to(f[0][c], L) for f in flat])
+               for c in range(ncols)]
+    g_nulls = [np.concatenate([_pad_to(f[1][c], L) for f in flat])
+               for c in range(ncols)]
+    g_mask = np.concatenate(
+        [_pad_to(np.ones(f[2], dtype=bool), L) for f in flat])
+
+    sharding = NamedSharding(mesh.mesh, P(WORKER_AXIS))
+    dev_arrays = [jax.device_put(a, sharding) for a in g_datas + g_nulls]
+    dev_mask = jax.device_put(g_mask, sharding)
+
+    # jax.sharding.Mesh is hashable and value-equal: safe as the cache key
+    program = _exchange_program(
+        mesh.mesh, kind, tuple(key_idx) if key_idx is not None else None,
+        ncols, W, L)
+    out_arrays, out_mask = program(tuple(dev_arrays), dev_mask)
+
+    # split back per worker, compact, and re-page at the standard page capacity
+    # (standard-shaped pages let every downstream operator reuse the kernels it
+    # already compiled for scan pages)
     out_np = [np.asarray(a) for a in out_arrays]
     mask_np = np.asarray(out_mask)
     out_len = len(mask_np) // W
     routed: List[List[Page]] = []
     for w in range(W):
         lo, hi = w * out_len, (w + 1) * out_len
-        m = mask_np[lo:hi]
-        if not m.any():
+        keep = np.flatnonzero(mask_np[lo:hi]) + lo
+        if len(keep) == 0:
             routed.append([])
             continue
-        blocks = []
-        for c in range(ncols):
-            nm = out_np[ncols + c][lo:hi]
-            blocks.append(Block(types[c], out_np[c][lo:hi],
-                                nm if nm.any() else None, dicts[c]))
-        routed.append([Page(tuple(blocks), m)])
+        cap = min(page_capacity, 1 << (max(len(keep), 1) - 1).bit_length())
+        pages_out: List[Page] = []
+        for p0 in range(0, len(keep), cap):
+            sel = keep[p0:p0 + cap]
+            blocks = []
+            for c in range(ncols):
+                nm = _pad_to(out_np[ncols + c][sel], cap)
+                blocks.append(Block(types[c], _pad_to(out_np[c][sel], cap),
+                                    nm if nm.any() else None, dicts[c]))
+            pages_out.append(Page(tuple(blocks),
+                                  _pad_to(np.ones(len(sel), dtype=bool), cap)))
+        routed.append(pages_out)
     return routed

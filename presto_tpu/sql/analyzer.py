@@ -38,6 +38,21 @@ class SemanticError(Exception):
     pass
 
 
+class AmbiguousColumnError(SemanticError):
+    pass
+
+
+class UnresolvedColumnError(SemanticError):
+    """Structured resolution failure: carries the identifier so callers (the
+    subquery planner's correlation check) need not parse the message."""
+
+    def __init__(self, name: str, qualifier: Optional[str] = None):
+        q = f"{qualifier}." if qualifier else ""
+        super().__init__(f"column '{q}{name}' cannot be resolved")
+        self.name = name
+        self.qualifier = qualifier
+
+
 @dataclasses.dataclass(frozen=True)
 class Field:
     """analyzer/Field: a named output column of a relation, bound to a symbol."""
@@ -63,18 +78,17 @@ class Scope:
         if len(matches) == 1:
             return matches[0]
         if len(matches) > 1:
-            raise SemanticError(f"column '{name}' is ambiguous")
+            raise AmbiguousColumnError(f"column '{name}' is ambiguous")
         if self.parent is not None:
             return self.parent.resolve(name, qualifier)
-        q = f"{qualifier}." if qualifier else ""
-        raise SemanticError(f"column '{q}{name}' cannot be resolved")
+        raise UnresolvedColumnError(name, qualifier)
 
     def try_resolve(self, name: str, qualifier: Optional[str] = None) -> Optional[Field]:
         try:
             return self.resolve(name, qualifier)
-        except SemanticError as e:
-            if "ambiguous" in str(e):
-                raise
+        except AmbiguousColumnError:
+            raise
+        except UnresolvedColumnError:
             return None
 
     def with_parent(self, parent: "Scope") -> "Scope":

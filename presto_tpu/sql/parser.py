@@ -323,10 +323,16 @@ class _Parser:
             body = self.parse_query_body()
             # allow (SELECT ...) with trailing order/limit inside parens
             order_by, limit = self.parse_order_limit()
-            if isinstance(body, t.QuerySpecification) and (order_by or limit is not None):
-                body = t.QuerySpecification(
-                    body.select_items, body.distinct, body.from_, body.where,
-                    body.group_by, body.having, order_by, limit)
+            if order_by or limit is not None:
+                if isinstance(body, t.QuerySpecification):
+                    body = t.QuerySpecification(
+                        body.select_items, body.distinct, body.from_, body.where,
+                        body.group_by, body.having, order_by, limit)
+                else:
+                    # ordered/limited set operation or VALUES as a term: wrap as
+                    # a subquery so the ordering binds to the whole parenthesized
+                    # body instead of being dropped
+                    body = t.TableSubquery(t.Query(body, None, order_by, limit))
             self.expect_op(")")
             return body
         if self.at_kw("values"):
@@ -370,9 +376,12 @@ class _Parser:
             group_by = tuple(gb)
 
         having = self.parse_expr() if self.accept_kw("having") else None
-        order_by, limit = self.parse_order_limit()
+        # ORDER BY / LIMIT are NOT part of a query term: in
+        # `select a union all select b order by 1` the ordering binds to the
+        # whole set operation (parse_query / the parenthesized-term branch
+        # attach them at the right level)
         return t.QuerySpecification(tuple(items), distinct, from_, where, group_by,
-                                    having, order_by, limit)
+                                    having, (), None)
 
     def parse_select_item(self) -> t.SelectItem:
         if self.at_op("*"):

@@ -15,7 +15,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 from ...metadata import MetadataManager, Session
 from ...ops.expressions import (Call, Constant, RowExpression, SpecialForm,
                                 SymbolRef, special, symbol_ref)
-from ...types import BIGINT, BOOLEAN, Type, UNKNOWN
+from ...types import BIGINT, BOOLEAN, DecimalType, Type, UNKNOWN
 from .. import tree as t
 from ..analyzer import (AGGREGATE_NAMES, ExpressionTranslator, Field, Scope,
                         SemanticError, aggregate_output_type, cast_to, common_type,
@@ -150,7 +150,15 @@ class LogicalPlanner:
             for v, tt in zip(vals, types):
                 if not isinstance(v, Constant):
                     raise SemanticError("VALUES entries must be literals")
-                out.append(v.value)
+                val = v.value
+                # unscaled decimal ints must be rescaled to the COMMON scale:
+                # VALUES (1.5),(1.25) has common decimal(18,2); storing 15 raw
+                # for the first row would decode as 0.15 instead of 1.50
+                if isinstance(tt, DecimalType) and val is not None:
+                    from_scale = (v.type.scale if isinstance(v.type, DecimalType)
+                                  else 0)
+                    val = val * 10 ** (tt.scale - from_scale)
+                out.append(val)
             pyrows.append(out)
         syms = [self.symbols.new_symbol(f"col{i}", tt) for i, tt in enumerate(types)]
         fields = [Field(f"_col{i}", s, None) for i, s in enumerate(syms)]
@@ -531,10 +539,14 @@ class LogicalPlanner:
 
     @staticmethod
     def _is_correlated_error(e: SemanticError, outer: Scope) -> bool:
-        """Did a standalone subquery plan fail on a column the OUTER scope knows?"""
-        import re
-        m = re.search(r"column '(?:\w+\.)?(\w+)' cannot be resolved", str(e))
-        return m is not None and outer.try_resolve(m.group(1)) is not None
+        """Did a standalone subquery plan fail on a column the OUTER scope knows?
+
+        Structural: UnresolvedColumnError carries the identifier (SQL semantics
+        make any inner-unresolved name that the outer scope CAN resolve a
+        correlated reference)."""
+        from ..analyzer import UnresolvedColumnError
+        return (isinstance(e, UnresolvedColumnError)
+                and outer.try_resolve(e.name, e.qualifier) is not None)
 
     def _split_correlated_eq(self, conj: t.Expression, outer: Scope,
                              inner: Scope) -> Optional[Tuple[RowExpression, Symbol]]:

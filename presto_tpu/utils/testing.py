@@ -49,10 +49,13 @@ class PageConsumerFactory(OperatorFactory):
         super().__init__(operator_id, "PageConsumer")
         self.types = types or []
         self.consumers: List[PageConsumerOperator] = []
+        self.consumers_by_worker: dict = {}
 
-    def create_operator(self) -> PageConsumerOperator:
-        op = PageConsumerOperator(OperatorContext(self.operator_id, self.name), self.types)
+    def create_operator(self, worker: int = 0) -> PageConsumerOperator:
+        op = PageConsumerOperator(
+            OperatorContext(self.operator_id, self.name, worker=worker), self.types)
         self.consumers.append(op)
+        self.consumers_by_worker.setdefault(worker, []).append(op)
         return op
 
     def rows(self) -> List[list]:
@@ -60,6 +63,10 @@ class PageConsumerFactory(OperatorFactory):
         for c in self.consumers:
             out.extend(c.rows())
         return out
+
+    def pages_for(self, worker: int) -> List[Page]:
+        return [p for c in self.consumers_by_worker.get(worker, [])
+                for p in c.pages]
 
 
 def drive_operators(operators: List[Operator]) -> None:
