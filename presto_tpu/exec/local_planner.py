@@ -561,9 +561,17 @@ class LocalExecutionPlanner:
                 for b in self.bufs:
                     yield from b.pages_for(self.worker)
 
+        def ready(w):
+            def all_children_done():
+                return all(len(b.consumers_by_worker.get(w, [])) > 0 and
+                           all(c.is_finished()
+                               for c in b.consumers_by_worker[w])
+                           for b in buffers)
+            return all_children_done
+
         fac = TableScanOperatorFactory(
             next(self._ids), lambda w: [_ReplaySource(buffers, w)],
-            [s.type for s in node.symbols], None)
+            [s.type for s in node.symbols], None, ready=ready)
         return Chain([fac], list(node.symbols), dicts or [])
 
     # ------------------------------------------------- sort / limit / misc

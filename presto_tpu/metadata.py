@@ -27,8 +27,12 @@ class Session:
     # engine defaults (the SystemSessionProperties subset that matters here)
     DEFAULTS = {
         "page_capacity": 1 << 16,
-        "task_concurrency": 1,
+        "task_concurrency": 4,
         "join_distribution_type": "AUTOMATIC",   # BROADCAST | PARTITIONED | AUTOMATIC
+        # AUTOMATIC broadcasts a build side whose estimated row count is below
+        # this (join-distribution CBO; the reference bounds replicated size via
+        # join_max_broadcast_table_size)
+        "broadcast_join_threshold_rows": 1 << 15,
         "join_reordering_strategy": "AUTOMATIC",  # NONE | AUTOMATIC
         "max_groups": 1 << 20,
     }

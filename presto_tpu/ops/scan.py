@@ -24,14 +24,26 @@ from .operator import Operator, OperatorContext, OperatorFactory, timed
 class TableScanOperator(Operator):
     def __init__(self, context: OperatorContext, source: ConnectorPageSource,
                  types: List[Type], processor: Optional[PageProcessor] = None,
-                 device=None):
+                 device=None, ready=None):
         super().__init__(context)
         self.source = source
         self._iter: Optional[Iterator[Page]] = None
         self._types = types
         self.processor = processor
         self.device = device
+        self._ready = ready  # None = always ready; else poll before reading
         self._done = False
+
+    def is_blocked(self):
+        """A replay scan (union buffer) blocks until its producers finish —
+        under the task executor, pipeline order no longer implies completion
+        order, so the dependency must be an explicit blocked state."""
+        if self._ready is None:
+            return None
+        if self._ready():
+            self._ready = None
+            return None
+        return self._ready
 
     @property
     def output_types(self) -> List[Type]:
@@ -75,7 +87,7 @@ class TableScanOperatorFactory(OperatorFactory):
     several drivers of one worker can split a multi-source scan."""
 
     def __init__(self, operator_id: int, page_sources, types: List[Type],
-                 processor: Optional[PageProcessor] = None):
+                 processor: Optional[PageProcessor] = None, ready=None):
         super().__init__(operator_id, "TableScan")
         if callable(page_sources):
             self._sources_fn = page_sources
@@ -84,6 +96,7 @@ class TableScanOperatorFactory(OperatorFactory):
             self._sources_fn = lambda w: list(srcs)
         self._types = types
         self._processor = processor
+        self._ready = ready  # worker -> poll-able "producers finished?"
         self._remaining = {}
 
     def create_operator(self, worker: int = 0) -> Operator:
@@ -91,4 +104,5 @@ class TableScanOperatorFactory(OperatorFactory):
             self._remaining[worker] = list(self._sources_fn(worker))
         src = self._remaining[worker].pop(0)
         return TableScanOperator(self.context(worker), src, self._types,
-                                 self._processor)
+                                 self._processor,
+                                 ready=self._ready(worker) if self._ready else None)

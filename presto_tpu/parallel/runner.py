@@ -25,6 +25,7 @@ import numpy as np
 
 from ..block import Block, Dictionary, Page
 from ..exec.local_planner import LocalExecutionPlanner
+from ..exec.task_executor import TaskExecutor
 from ..metadata import CatalogManager, Session
 from ..runner import LocalQueryRunner, QueryResult
 from ..sql import tree as t
@@ -72,7 +73,7 @@ class DistributedQueryRunner:
         planner = LogicalPlanner(self.metadata, self.session)
         plan = planner.plan(stmt)
         plan = optimize(plan, self.metadata, self.session)
-        plan = add_exchanges(plan, planner.symbols)
+        plan = add_exchanges(plan, planner.symbols, self.metadata, self.session)
         return fragment_plan(plan)
 
     def explain(self, sql: str) -> str:
@@ -117,9 +118,11 @@ class DistributedQueryRunner:
             for fid, slot in ep.remote_slots.items():
                 for w in range(W):
                     slot.set_pages(w, routed[fid][w])
-            for w in workers:
-                for d in ep.create_drivers(w):
-                    d.run_to_completion()
+            # all workers' drivers share one executor: worker tasks and their
+            # build/probe pipelines time-slice across runner threads
+            drivers = [d for w in workers for d in ep.create_drivers(w)]
+            TaskExecutor(
+                int(self.session.get("task_concurrency"))).execute(drivers)
             if is_root:
                 return QueryResult(ep.sink.rows(), sub.column_names)
             per_worker = [ep.sink.pages_for(w) for w in range(W)]

@@ -12,6 +12,7 @@ from typing import Dict, List, Optional
 
 from .connectors.tpch.connector import TpchConnector
 from .exec.local_planner import LocalExecutionPlanner
+from .exec.task_executor import TaskExecutor
 from .metadata import CatalogManager, MetadataManager, Session
 from .sql import tree as t
 from .sql.parser import SqlParser
@@ -91,6 +92,7 @@ class LocalQueryRunner:
         local = LocalExecutionPlanner(self.metadata, self.session)
         exec_plan = local.plan(plan)
         drivers = exec_plan.create_drivers()
-        for d in drivers:  # dependency order: build pipelines first
-            d.run_to_completion()
+        # task executor: build/probe pipelines overlap on runner threads
+        # (blocked probes park until their lookup slot resolves)
+        TaskExecutor(int(self.session.get("task_concurrency"))).execute(drivers)
         return QueryResult(exec_plan.sink.rows(), exec_plan.output_names)

@@ -42,8 +42,34 @@ def _hash_dist(keys) -> Tuple[str, Tuple[str, ...]]:
 class ExchangePlanner:
     """One instance per query (shares the logical planner's symbol allocator)."""
 
-    def __init__(self, symbols: SymbolAllocator):
+    def __init__(self, symbols: SymbolAllocator, metadata=None, session=None):
         self.symbols = symbols
+        self.metadata = metadata
+        self.session = session
+
+    # ------------------------------------------------ join distribution CBO
+
+    def _distribution_type(self) -> str:
+        if self.session is None:
+            return "PARTITIONED"
+        return str(self.session.get("join_distribution_type", "AUTOMATIC")).upper()
+
+    def _should_broadcast(self, build: PlanNode) -> bool:
+        """DetermineJoinDistributionType analogue: replicate the build side when
+        it is estimated small enough that shipping it to every worker is cheaper
+        than repartitioning the (large) probe side. PARTITIONED forces hash
+        repartition; BROADCAST forces replication; AUTOMATIC decides from
+        connector stats."""
+        dist = self._distribution_type()
+        if dist == "PARTITIONED":
+            return False
+        if dist == "BROADCAST":
+            return True
+        if self.metadata is None or self.session is None:
+            return False
+        from .optimizer import estimate_rows
+        threshold = int(self.session.get("broadcast_join_threshold_rows"))
+        return estimate_rows(build, self.metadata) <= threshold
 
     def run(self, root: OutputNode) -> OutputNode:
         node, dist = self.visit(root.source)
@@ -144,8 +170,10 @@ class ExchangePlanner:
     def visit_JoinNode(self, node: JoinNode):
         left, ldist = self.visit(node.left)
         right, rdist = self.visit(node.right)
-        if not node.criteria:
-            # cross join (scalar subqueries): replicate the build side
+        # replicated build — probe rows never move, every worker holds the full
+        # build table (BroadcastOutputBuffer / REPLICATED join). Mandatory for
+        # cross joins (scalar subqueries); otherwise the CBO's call.
+        if not node.criteria or self._should_broadcast(node.right):
             right = ExchangeNode(right, BROADCAST, [])
             return (JoinNode(node.type, left, right, node.criteria,
                              node.residual, node.output_symbols), ldist)
@@ -161,9 +189,11 @@ class ExchangePlanner:
     def visit_SemiJoinNode(self, node: SemiJoinNode):
         src, sdist = self.visit(node.source)
         filt, fdist = self.visit(node.filtering_source)
-        if node.negated and node.null_aware:
-            # NOT IN: any NULL build key anywhere empties the result globally —
-            # replicate the filtering side so every worker sees the null bit
+        # NOT IN must replicate the filtering side (any NULL build key anywhere
+        # empties the result globally, so every worker needs the null bit);
+        # otherwise broadcast is the CBO's call for small filtering sides.
+        if (node.negated and node.null_aware) or \
+                self._should_broadcast(node.filtering_source):
             filt = ExchangeNode(filt, BROADCAST, [])
             return (SemiJoinNode(src, filt, node.source_key, node.filtering_key,
                                  node.mark, node.negated, node.null_aware,
@@ -221,5 +251,6 @@ class ExchangePlanner:
                 SOURCE_DIST)
 
 
-def add_exchanges(root: OutputNode, symbols: SymbolAllocator) -> OutputNode:
-    return ExchangePlanner(symbols).run(root)
+def add_exchanges(root: OutputNode, symbols: SymbolAllocator,
+                  metadata=None, session=None) -> OutputNode:
+    return ExchangePlanner(symbols, metadata, session).run(root)

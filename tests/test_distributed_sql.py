@@ -98,3 +98,34 @@ def test_dist_union_with_values(dist, local):
 @pytest.mark.parametrize("q", [1, 3, 5, 9])
 def test_dist_tpch(dist, local, q):
     check(dist, local, QUERIES[q])
+
+
+def test_cbo_broadcasts_small_builds(dist):
+    # DetermineJoinDistributionType: Q5's dimension builds (nation/region/...)
+    # are under the broadcast threshold -> replicated, so the lineitem probe
+    # never repartitions for the joins
+    plan = dist.explain(QUERIES[5])
+    assert "output=broadcast" in plan
+    frags = plan.split("Fragment")
+    lineitem_frag = next(f for f in frags if "tiny.lineitem" in f)
+    assert "RemoteSource" in lineitem_frag  # joins happen at the probe
+
+
+def test_forced_partitioned_matches_broadcast(local):
+    from presto_tpu.metadata import Session
+    from presto_tpu.parallel.runner import DistributedQueryRunner
+
+    part = DistributedQueryRunner(
+        session=Session(catalog="tpch", schema="tiny",
+                        properties={"join_distribution_type": "PARTITIONED"}))
+    plan = part.explain(QUERIES[5])
+    assert "output=broadcast" not in plan
+    check(part, local, QUERIES[5])
+
+
+def test_skewed_join_key(dist, local):
+    # hot-key stress: ~90% of orders land on one custkey partition via the
+    # modulo classes; exchange capacity scales to the live rows, no drops
+    sql = ("select o_custkey % 3, count(*), sum(o_totalprice) from orders "
+           "where o_custkey % 10 < 9 group by 1 order by 1")
+    check(dist, local, sql)
