@@ -140,6 +140,13 @@ class LocalExecutionPlanner:
 
     # ------------------------------------------------------------------ api
 
+    def attach_memory(self, memory, revoke_check=None) -> None:
+        """Wire a query-level MemoryTrackingContext (+ pressure probe) into
+        every planned factory — operators then account bytes into the query's
+        pool and self-revoke under pressure."""
+        self._memory_ctx = memory
+        self._revoke_check = revoke_check
+
     def plan(self, root: OutputNode) -> LocalExecutionPlan:
         chain = self.visit(root.source)
         # final projection into the user's column order
@@ -151,6 +158,13 @@ class LocalExecutionPlanner:
         sink = PageConsumerFactory(next(self._ids),
                                    [s.type for s in chain.symbols])
         self.pipelines.append(chain.factories + [sink])
+        mem = getattr(self, "_memory_ctx", None)
+        if mem is not None:
+            check = getattr(self, "_revoke_check", None)
+            for pipeline in self.pipelines:
+                for fac in pipeline:
+                    fac.memory_ctx = mem
+                    fac.revoke_check = check
         return LocalExecutionPlan(self.pipelines, sink, root.column_names,
                                   [s.type for s in chain.symbols],
                                   list(chain.dicts), self.remote_slots)

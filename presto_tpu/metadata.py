@@ -35,6 +35,11 @@ class Session:
         "broadcast_join_threshold_rows": 1 << 15,
         "join_reordering_strategy": "AUTOMATIC",  # NONE | AUTOMATIC
         "max_groups": 1 << 20,
+        # memory/spill (advisory accounting over XLA's allocator; "spill" moves
+        # device state to host RAM — the TPU's disk analogue)
+        "memory_pool_bytes": 8 << 30,
+        "query_max_memory_bytes": 4 << 30,
+        "revoke_target_fraction": 0.9,
     }
 
     def get(self, name: str, default=None):

@@ -149,7 +149,11 @@ class GroupedAggregationBuilder:
         self._pending: List = []    # list of (keys, states, mask) partials
         self._pending_rows = 0
         self._page_kernel = jax.jit(self._page_partial, static_argnames=("out_groups",))
-        self._overflowed = False
+        # spilled partial tables on HOST RAM (numpy) — the TPU analogue of the
+        # reference's disk spill (SpillableHashAggregationBuilder): device HBM
+        # holds at most max_groups live groups; overflow and revocation move
+        # compacted partials to host, merged exactly at finish()
+        self._spilled: List = []    # list of (np keys tuple, np states tuple, np valid)
         # adaptive compact-table size: starts at the first fold's true group count
         # (rounded up to a power of two) and grows on demand — the rehash analogue
         # of MultiChannelGroupByHash.java:363-409, but table growth here re-runs one
@@ -187,12 +191,15 @@ class GroupedAggregationBuilder:
     # --- combine ----------------------------------------------------------
 
     def _fold(self) -> None:
-        """Merge pending partials (+ current table) into a fresh compact table."""
+        """Merge pending partials (+ current table) into a fresh compact table.
+        If the live group count exceeds max_groups, the inputs are SPILLED to
+        host RAM instead (merged exactly at finish) — never silently dropped."""
         parts = list(self._pending)
         self._pending = []
         self._pending_rows = 0
         if self._acc is not None:
             parts.append(self._acc)
+            self._acc = None
         keys = tuple(jnp.concatenate([p[0][i] for p in parts])
                      for i in range(len(self.key_types)))
         states = tuple(jnp.concatenate([p[1][i] for p in parts])
@@ -207,7 +214,14 @@ class GroupedAggregationBuilder:
                 break
             size = min(_pow2(n), self.max_groups)  # grow and refold
         if n > self.max_groups:
-            self._overflowed = True
+            # more live groups than the device table can hold: move the (still
+            # complete) input rows to host and keep accumulating fresh
+            self._spilled.append((
+                tuple(np.asarray(k) for k in keys),
+                tuple(np.asarray(s) for s in states),
+                np.asarray(valid)))
+            self._table_size = None
+            return
         # shrink the table to the true group count's bucket: gvalid is a prefix,
         # so slicing keeps every live group and future folds sort less
         tight = min(_pow2(max(n, 1)), self.max_groups)
@@ -218,19 +232,85 @@ class GroupedAggregationBuilder:
         self._table_size = tight
         self._acc = (gkeys, gstates, gvalid)
 
+    # --- spill (HBM -> host RAM; FileSingleStreamSpiller analogue) ---------
+
+    def memory_bytes(self) -> int:
+        """Device-resident bytes (pending partials + compact table)."""
+        per_row = sum(np.dtype(t.np_dtype).itemsize for t in self.key_types) + \
+            sum(np.dtype(col.dtype).itemsize
+                for c in self.calls for col in c.function.state) + 1
+        rows = self._pending_rows
+        if self._acc is not None:
+            rows += int(self._acc[2].shape[0])
+        return rows * per_row
+
+    def spill(self) -> None:
+        """Move ALL device state to host (start_memory_revoke path)."""
+        parts = list(self._pending)
+        self._pending = []
+        self._pending_rows = 0
+        if self._acc is not None:
+            parts.append(self._acc)
+            self._acc = None
+            self._table_size = None
+        for p in parts:
+            self._spilled.append((
+                tuple(np.asarray(k) for k in p[0]),
+                tuple(np.asarray(s) for s in p[1]),
+                np.asarray(p[2])))
+
+    def _merge_spilled(self):
+        """Exact host-side merge of spilled partials + device table: sort rows
+        by key tuple, segment boundaries, per-kind reduceat. Unbounded group
+        counts are fine here — host RAM is the spill medium."""
+        parts = list(self._spilled)
+        self._spilled = []
+        if self._acc is not None:
+            parts.append((tuple(np.asarray(k) for k in self._acc[0]),
+                          tuple(np.asarray(s) for s in self._acc[1]),
+                          np.asarray(self._acc[2])))
+            self._acc = None
+        nk = len(self.key_types)
+        keys = [np.concatenate([p[0][i] for p in parts]) for i in range(nk)]
+        states = [np.concatenate([p[1][i] for p in parts])
+                  for i in range(len(self.kinds))]
+        valid = np.concatenate([p[2] for p in parts])
+        keys = [k[valid] for k in keys]
+        states = [s[valid] for s in states]
+        if len(keys[0]) == 0:
+            z = tuple(jnp.zeros(0, dtype=t.np_dtype) for t in self.key_types)
+            s = tuple(jnp.zeros(0, dtype=np.dtype(np.float64)) for _ in self.kinds)
+            return z, s, jnp.zeros(0, dtype=jnp.bool_)
+        order = np.lexsort(tuple(reversed(keys)))
+        keys = [k[order] for k in keys]
+        states = [s[order] for s in states]
+        boundary = np.zeros(len(keys[0]), dtype=bool)
+        boundary[0] = True
+        for k in keys:
+            boundary[1:] |= k[1:] != k[:-1]
+        starts = np.flatnonzero(boundary)
+        # stay on HOST: the merged table can exceed device capacity (that is
+        # why it spilled); _build_result pages it out page-capacity at a time
+        out_keys = tuple(k[starts] for k in keys)
+        out_states = []
+        for s, kind in zip(states, self.kinds):
+            red = {SUM: np.add, MIN: np.minimum, MAX: np.maximum}[kind]
+            out_states.append(red.reduceat(s, starts))
+        n = len(starts)
+        return out_keys, tuple(out_states), np.ones(n, dtype=bool)
+
     def finish(self):
         """-> (keys, states, valid) on device, compact."""
         if self._pending or self._acc is None:
-            if not self._pending and self._acc is None:
+            if not self._pending and self._acc is None and not self._spilled:
                 # empty input: zero groups
                 z = tuple(jnp.zeros(0, dtype=t.np_dtype) for t in self.key_types)
                 s = tuple(jnp.zeros(0, dtype=np.dtype(np.float64)) for _ in self.kinds)
                 return z, s, jnp.zeros(0, dtype=jnp.bool_)
-            self._fold()
-        if self._overflowed:
-            raise RuntimeError(
-                f"aggregation exceeded max_groups={self.max_groups}; "
-                "raise session property max_groups or enable spill")
+            if self._pending:
+                self._fold()
+        if self._spilled:
+            return self._merge_spilled()
         return self._acc
 
 
@@ -404,6 +484,21 @@ class HashAggregationOperator(Operator):
     def add_input(self, page: Page) -> None:
         self.context.record_input(page, page.capacity)
         self.builder.add_page(page)
+        b = getattr(self.builder, "memory_bytes", None)
+        if b is not None:
+            self.context.update_revocable(b(), self.start_memory_revoke)
+
+    # spill protocol: the revoker asks; the builder moves its device table to
+    # host RAM (operator/Operator.java:68 startMemoryRevoke analogue)
+    def revocable_bytes(self) -> int:
+        b = getattr(self.builder, "memory_bytes", None)
+        return b() if b is not None else 0
+
+    def start_memory_revoke(self) -> None:
+        spill = getattr(self.builder, "spill", None)
+        if spill is not None:
+            spill()
+            self.context.revocable_memory.set_bytes(0)
 
     @timed("get_output_ns")
     def get_output(self) -> Optional[Page]:
@@ -423,6 +518,7 @@ class HashAggregationOperator(Operator):
 
     def _build_result(self) -> None:
         keys, states, valid = self.builder.finish()
+        self.context.revocable_memory.set_bytes(0)  # builder state consumed
         pages: List[Page] = []
         # sort-builder tables are compact (valid is a prefix): trim to live groups.
         # direct-builder tables are domain-indexed with holes: keep the full (small)

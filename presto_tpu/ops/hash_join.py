@@ -130,7 +130,8 @@ class JoinBuildOperator(Operator):
     def __init__(self, context: OperatorContext, factory: "JoinBuildOperatorFactory"):
         super().__init__(context)
         self.f = factory
-        self._pages: List[Page] = []
+        self._pages: List[Page] = []       # device-resident
+        self._host_pages: List[Page] = []  # spilled to host RAM (numpy)
         self._saw_null_key = None  # device bool accumulator, synced once at build
 
     @property
@@ -147,6 +148,28 @@ class JoinBuildOperator(Operator):
                     else (self._saw_null_key | seen)
         self._pages.append(_compact_for_build(page, tuple(self.f.key_channels),
                                               tuple(self.f.payload_channels)))
+        self.context.update_revocable(self.revocable_bytes(),
+                                      self.start_memory_revoke)
+
+    # spill protocol: accumulated build pages offload to host RAM; _build's
+    # jnp.concatenate re-uploads them (HashBuilderOperator spill states
+    # :155-180 analogue — here "disk" is host memory). Only device-resident
+    # pages count as revocable — spilled pages are already host RAM.
+    def revocable_bytes(self) -> int:
+        total = 0
+        for p in self._pages:
+            rows = p.capacity
+            total += rows  # mask
+            for b in p.blocks:
+                total += rows * np.dtype(b.data.dtype).itemsize
+                if b.nulls is not None:
+                    total += rows
+        return total
+
+    def start_memory_revoke(self) -> None:
+        self._host_pages.extend(jax.device_get(p) for p in self._pages)
+        self._pages = []
+        self.context.revocable_memory.set_bytes(0)
 
     def get_output(self) -> Optional[Page]:
         return None
@@ -156,9 +179,14 @@ class JoinBuildOperator(Operator):
             return
         super().finish()
         self.f.lookup_factory.set(self._build(), self.context.worker)
+        self._pages = []  # consumed into the lookup source
+        self.context.revocable_memory.set_bytes(0)
 
     def _build(self) -> LookupSource:
         kc = len(self.f.key_channels)
+        if self._host_pages:  # re-admit spilled pages (host -> device upload)
+            self._pages = self._host_pages + self._pages
+            self._host_pages = []
         if not self._pages:
             empty = tuple(jnp.zeros(1, dtype=jnp.int64) for _ in range(kc))
             empty_payload = tuple(jnp.zeros(1, dtype=t.np_dtype)

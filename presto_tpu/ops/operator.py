@@ -44,13 +44,33 @@ class OperatorStats:
 class OperatorContext:
     def __init__(self, operator_id: int, name: str,
                  memory: Optional[MemoryTrackingContext] = None,
-                 worker: int = 0):
+                 worker: int = 0,
+                 revoke_check: Optional[Callable[[], bool]] = None):
         self.worker = worker
         self.stats = OperatorStats(operator_id, name)
         self.memory = memory or MemoryTrackingContext(
             AggregatedMemoryContext(), AggregatedMemoryContext(), AggregatedMemoryContext())
+        # memory-pressure probe: operators self-revoke (spill device state to
+        # host) from their own thread when this fires — thread-safe where an
+        # external revoker thread mutating operator state would not be
+        self._revoke_check = revoke_check
         self.user_memory = self.memory.user.new_local_memory_context(name)
         self.revocable_memory = self.memory.revocable.new_local_memory_context(name)
+
+    def should_revoke(self) -> bool:
+        return self._revoke_check is not None and self._revoke_check()
+
+    def update_revocable(self, used: int, on_revoke: Callable[[], None]) -> None:
+        """Account the operator's revocable device bytes; spill (on the calling
+        thread) when the pool is over the revoke target."""
+        self.revocable_memory.set_bytes(used)
+        self.stats.peak_memory_bytes = max(self.stats.peak_memory_bytes, used)
+        if used and self.should_revoke():
+            on_revoke()
+
+    def release_memory(self) -> None:
+        self.user_memory.close()
+        self.revocable_memory.close()
 
     def record_input(self, page: Page, rows: int) -> None:
         self.stats.add_input_calls += 1
@@ -101,7 +121,10 @@ class Operator(abc.ABC):
         return None
 
     def close(self) -> None:
-        pass
+        # drop this operator's reservations so pool pressure subsides as
+        # operators retire (otherwise should_revoke stays latched and every
+        # later operator spills on every page)
+        self.context.release_memory()
 
     # spill protocol (operator/Operator.java:68 startMemoryRevoke/finishMemoryRevoke)
     def revocable_bytes(self) -> int:
@@ -126,13 +149,18 @@ class OperatorFactory(abc.ABC):
     def __init__(self, operator_id: int, name: str):
         self.operator_id = operator_id
         self.name = name
+        # wired by the local planner when the query has a memory context:
+        self.memory_ctx = None        # MemoryTrackingContext (query-level)
+        self.revoke_check = None      # () -> bool: pool over revoke target?
 
     @abc.abstractmethod
     def create_operator(self, worker: int = 0) -> Operator:
         ...
 
     def context(self, worker: int = 0) -> "OperatorContext":
-        return OperatorContext(self.operator_id, self.name, worker=worker)
+        mem = self.memory_ctx.fork() if self.memory_ctx is not None else None
+        return OperatorContext(self.operator_id, self.name, memory=mem,
+                               worker=worker, revoke_check=self.revoke_check)
 
     def no_more_operators(self) -> None:
         pass

@@ -101,6 +101,9 @@ class DistributedQueryRunner:
         W = self.mesh.n_workers
         frag_dicts: Dict[int, List[Optional[Dictionary]]] = {}
         routed: Dict[int, List[List[Page]]] = {}  # fid -> per-worker pages
+        # ONE memory pool + query context for the whole query: every
+        # fragment's operators draw on the same budget
+        query_memory = self.local._query_memory()
         for frag in sub.fragments:
             is_root = frag is sub.root_fragment
             if is_root:
@@ -114,6 +117,7 @@ class DistributedQueryRunner:
             # the jit-compiled kernels); only splits/exchange pages differ
             lp = LocalExecutionPlanner(self.metadata, self.session,
                                        n_workers=W, remote_dicts=frag_dicts)
+            lp.attach_memory(*query_memory)
             ep = lp.plan(root)
             for fid, slot in ep.remote_slots.items():
                 for w in range(W):

@@ -90,9 +90,26 @@ class LocalQueryRunner:
 
         plan = self.plan_statement(stmt)
         local = LocalExecutionPlanner(self.metadata, self.session)
+        local.attach_memory(*self._query_memory())
         exec_plan = local.plan(plan)
         drivers = exec_plan.create_drivers()
         # task executor: build/probe pipelines overlap on runner threads
         # (blocked probes park until their lookup slot resolves)
         TaskExecutor(int(self.session.get("task_concurrency"))).execute(drivers)
         return QueryResult(exec_plan.sink.rows(), exec_plan.output_names)
+
+    def _query_memory(self):
+        """Per-query memory root drawing on a GENERAL pool; the returned probe
+        fires when the pool crosses the revoke target (MemoryRevokingScheduler
+        trigger condition) so operators spill device state to host."""
+        from .memory import GENERAL_POOL, MemoryPool, QueryContextMemory
+
+        pool = MemoryPool(GENERAL_POOL, int(self.session.get("memory_pool_bytes")))
+        qmem = QueryContextMemory(
+            f"query-{id(self)}", pool,
+            int(self.session.get("query_max_memory_bytes")))
+        target = float(self.session.get("revoke_target_fraction"))
+
+        def over_target() -> bool:
+            return pool.reserved_bytes() > pool.max_bytes * target
+        return qmem.memory, over_target
