@@ -128,7 +128,8 @@ class DistributedQueryRunner:
             TaskExecutor(
                 int(self.session.get("task_concurrency"))).execute(drivers)
             if is_root:
-                return QueryResult(ep.sink.rows(), sub.column_names)
+                return QueryResult(ep.sink.rows(), sub.column_names,
+                                   ep.output_types)
             per_worker = [ep.sink.pages_for(w) for w in range(W)]
             key_idx = None
             if frag.output_kind == REPARTITION:

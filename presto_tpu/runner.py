@@ -25,6 +25,7 @@ from .sql.planner.planner import LogicalPlanner
 class QueryResult:
     rows: List[list]
     column_names: List[str]
+    types: Optional[List] = None  # output Type objects when the engine knows them
 
 
 class LocalQueryRunner:
@@ -96,7 +97,8 @@ class LocalQueryRunner:
         # task executor: build/probe pipelines overlap on runner threads
         # (blocked probes park until their lookup slot resolves)
         TaskExecutor(int(self.session.get("task_concurrency"))).execute(drivers)
-        return QueryResult(exec_plan.sink.rows(), exec_plan.output_names)
+        return QueryResult(exec_plan.sink.rows(), exec_plan.output_names,
+                           exec_plan.output_types)
 
     def _query_memory(self):
         """Per-query memory root drawing on a GENERAL pool; the returned probe

@@ -1,0 +1,9 @@
+"""Server layer: REST protocol + query manager (PrestoServer.java analogue).
+
+`python -m presto_tpu.server` boots the HTTP coordinator; see http_server.py
+for endpoints and protocol.py for the /v1/statement wire contract.
+"""
+from .http_server import PrestoTpuServer, main
+from .protocol import QueryManager
+
+__all__ = ["PrestoTpuServer", "QueryManager", "main"]

@@ -1,0 +1,170 @@
+"""REST server: the engine's network boundary.
+
+Analogue of the reference's PrestoServer.java bootstrap + StatementResource
+HTTP endpoints. stdlib http.server (ThreadingHTTPServer) — the engine has no
+web-framework dependency; request handling is thin JSON marshalling over
+QueryManager, exactly as StatementResource is thin over SqlQueryManager.
+
+Endpoints:
+  POST   /v1/statement            body = SQL text -> QueryResults JSON
+  GET    /v1/statement/{id}/{tok} page `tok` (follow nextUri)
+  DELETE /v1/statement/{id}/{tok} cancel
+  GET    /v1/info                 server info (ServerInfoResource analogue)
+  GET    /v1/query                all queries (QueryResource analogue)
+  GET    /v1/query/{id}           one query's info
+
+Run: python -m presto_tpu.server [--port 8080] [--distributed] [--schema sf1]
+"""
+from __future__ import annotations
+
+import json
+import re
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from .protocol import QueryManager
+
+_START_TIME = time.time()
+_VERSION = "presto-tpu 0.1"
+
+
+class _Handler(BaseHTTPRequestHandler):
+    manager: QueryManager = None  # set by serve()
+    protocol_version = "HTTP/1.1"
+
+    # silence per-request stderr logging (the engine logs through its own path)
+    def log_message(self, fmt, *args):  # noqa: A003
+        pass
+
+    # ------------------------------------------------------------- plumbing
+
+    def _base_uri(self) -> str:
+        host = self.headers.get("Host", "localhost")
+        return f"http://{host}"
+
+    def _send_json(self, payload, status: int = 200) -> None:
+        body = json.dumps(payload).encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _not_found(self) -> None:
+        self._send_json({"error": {"message": f"no such resource {self.path}"}},
+                        status=404)
+
+    # ------------------------------------------------------------ endpoints
+
+    def do_POST(self) -> None:  # noqa: N802 - http.server naming
+        if self.path.rstrip("/") != "/v1/statement":
+            return self._not_found()
+        length = int(self.headers.get("Content-Length", 0))
+        sql = self.rfile.read(length).decode().strip()
+        if not sql:
+            return self._send_json(
+                {"error": {"message": "empty statement"}}, status=400)
+        info = self.manager.submit(sql)
+        self._send_json(self.manager.results_payload(info, 0, self._base_uri()))
+
+    def do_GET(self) -> None:  # noqa: N802
+        m = re.fullmatch(r"/v1/statement/([^/]+)/(\d+)", self.path)
+        if m:
+            info = self.manager.get(m.group(1))
+            if info is None:
+                return self._not_found()
+            return self._send_json(self.manager.results_payload(
+                info, int(m.group(2)), self._base_uri()))
+        if self.path.rstrip("/") == "/v1/info":
+            return self._send_json({
+                "nodeVersion": {"version": _VERSION},
+                "uptime": round(time.time() - _START_TIME, 1),
+                "coordinator": True,
+            })
+        if self.path.rstrip("/") == "/v1/query":
+            return self._send_json([self._query_json(q)
+                                    for q in self.manager.list_queries()])
+        m = re.fullmatch(r"/v1/query/([^/]+)", self.path)
+        if m:
+            info = self.manager.get(m.group(1))
+            if info is None:
+                return self._not_found()
+            return self._send_json(self._query_json(info))
+        self._not_found()
+
+    def do_DELETE(self) -> None:  # noqa: N802
+        m = re.fullmatch(r"/v1/statement/([^/]+)/(\d+)", self.path)
+        if m and self.manager.cancel(m.group(1)):
+            self.send_response(204)
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+            return
+        self._not_found()
+
+    @staticmethod
+    def _query_json(info) -> dict:
+        return {
+            "queryId": info.query_id,
+            "state": info.state,
+            "query": info.sql,
+            "rowCount": info.row_count,
+            "elapsedMillis": int(
+                ((info.end_time or time.time()) - info.create_time) * 1000),
+            "error": info.error,
+        }
+
+
+class PrestoTpuServer:
+    """Server handle: serve() blocks, start() runs on a daemon thread."""
+
+    def __init__(self, runner=None, port: int = 8080, page_rows: int = 1000):
+        if runner is None:
+            from ..runner import LocalQueryRunner
+            runner = LocalQueryRunner()
+        self.manager = QueryManager(runner, page_rows=page_rows)
+        handler = type("BoundHandler", (_Handler,), {"manager": self.manager})
+        self.httpd = ThreadingHTTPServer(("0.0.0.0", port), handler)
+        self.port = self.httpd.server_address[1]
+
+    def serve(self) -> None:
+        self.httpd.serve_forever()
+
+    def start(self) -> threading.Thread:
+        t = threading.Thread(target=self.serve, daemon=True)
+        t.start()
+        return t
+
+    def stop(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+def main(argv=None) -> None:
+    import argparse
+
+    ap = argparse.ArgumentParser(prog="presto-tpu-server")
+    ap.add_argument("--port", type=int, default=8080)
+    ap.add_argument("--schema", default="tiny")
+    ap.add_argument("--distributed", action="store_true",
+                    help="serve queries through the mesh-distributed engine")
+    args = ap.parse_args(argv)
+
+    from ..metadata import Session
+    session = Session(catalog="tpch", schema=args.schema)
+    if args.distributed:
+        from ..parallel.runner import DistributedQueryRunner
+        runner = DistributedQueryRunner(session=session)
+    else:
+        from ..runner import LocalQueryRunner
+        runner = LocalQueryRunner(session=session)
+    server = PrestoTpuServer(runner, port=args.port)
+    print(f"presto-tpu server listening on :{server.port} "
+          f"({'distributed' if args.distributed else 'local'}, "
+          f"schema={args.schema})")
+    server.serve()
+
+
+if __name__ == "__main__":
+    main()

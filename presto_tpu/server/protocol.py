@@ -1,0 +1,195 @@
+"""Client protocol types + query manager: the /v1/statement contract.
+
+Analogues: server/protocol/StatementResource.java:88,134 (POST creates a
+query, GET pages results via nextUri, DELETE cancels),
+execution/SqlQueryManager.java:300 + QueryStateMachine (state transitions),
+client/QueryResults.java (the wire shape: id/columns/data/nextUri/error/stats).
+
+The wire format is JSON with the reference's field names so a reference-style
+client maps 1:1: {"id", "infoUri", "nextUri", "columns":[{"name","type"}],
+"data":[[...]], "stats":{"state", ...}, "error":{...}}.
+"""
+from __future__ import annotations
+
+import dataclasses
+import datetime
+import decimal
+import itertools
+import threading
+import time
+import traceback
+from typing import Dict, List, Optional
+
+import numpy as np
+
+# QueryState.java vocabulary (narrowed to the states this engine reaches)
+QUEUED = "QUEUED"
+RUNNING = "RUNNING"
+FINISHED = "FINISHED"
+FAILED = "FAILED"
+CANCELED = "CANCELED"
+
+_DONE = {FINISHED, FAILED, CANCELED}
+
+
+@dataclasses.dataclass
+class QueryInfo:
+    query_id: str
+    sql: str
+    state: str = QUEUED
+    rows: Optional[List[list]] = None
+    columns: Optional[List[Dict[str, str]]] = None
+    error: Optional[Dict] = None
+    create_time: float = dataclasses.field(default_factory=time.time)
+    end_time: Optional[float] = None
+    row_count: int = 0
+
+    def done(self) -> bool:
+        return self.state in _DONE
+
+
+class QueryManager:
+    """Owns query lifecycle: submit -> background execute -> paged fetch.
+
+    One engine (LocalQueryRunner or DistributedQueryRunner) serves every query;
+    queries run on daemon threads (the HTTP layer must never block on the
+    engine — StatementResource's async pattern)."""
+
+    def __init__(self, runner, page_rows: int = 1000,
+                 max_done_queries: int = 100):
+        self.runner = runner
+        self.page_rows = page_rows
+        # completed-query history is bounded (SqlQueryManager's expiration):
+        # oldest done queries are evicted, their materialized rows with them
+        self.max_done_queries = max_done_queries
+        self._queries: Dict[str, QueryInfo] = {}
+        self._ids = itertools.count(1)
+        self._lock = threading.Lock()
+
+    # ----------------------------------------------------------------- api
+
+    def submit(self, sql: str) -> QueryInfo:
+        with self._lock:
+            qid = f"q{next(self._ids)}_{int(time.time())}"
+            info = QueryInfo(qid, sql)
+            self._queries[qid] = info
+            self._expire_locked()
+        threading.Thread(target=self._run, args=(info,), daemon=True).start()
+        return info
+
+    def _expire_locked(self) -> None:
+        done = [q for q in self._queries.values() if q.done()]
+        if len(done) <= self.max_done_queries:
+            return
+        done.sort(key=lambda q: q.end_time or 0)
+        for q in done[:len(done) - self.max_done_queries]:
+            self._queries.pop(q.query_id, None)
+
+    def get(self, query_id: str) -> Optional[QueryInfo]:
+        return self._queries.get(query_id)
+
+    def cancel(self, query_id: str) -> bool:
+        info = self._queries.get(query_id)
+        if info is None:
+            return False
+        with self._lock:
+            if not info.done():
+                # engine slices are not interruptible mid-kernel; the query is
+                # marked canceled and its results are dropped on completion
+                info.state = CANCELED
+                info.end_time = time.time()
+        return True
+
+    def list_queries(self) -> List[QueryInfo]:
+        return list(self._queries.values())
+
+    # ------------------------------------------------------------- execute
+
+    def _run(self, info: QueryInfo) -> None:
+        with self._lock:
+            if info.state != QUEUED:  # canceled before the thread started
+                return
+            info.state = RUNNING
+        try:
+            result = self.runner.execute(info.sql)
+            rows = [self._to_json_row(r) for r in result.rows]
+            with self._lock:
+                if info.state == CANCELED:
+                    return
+                info.rows = rows
+                info.row_count = len(rows)
+                info.columns = [{"name": n, "type": self._type_name(result, i)}
+                                for i, n in enumerate(result.column_names)]
+                info.state = FINISHED
+                info.end_time = time.time()
+        except Exception as e:  # noqa: BLE001 - reported through the protocol
+            with self._lock:
+                info.error = {
+                    "message": str(e),
+                    "errorType": type(e).__name__,
+                    "stack": traceback.format_exc()[-2000:],
+                }
+                info.state = FAILED
+                info.end_time = time.time()
+
+    @staticmethod
+    def _type_name(result, i: int) -> str:
+        types = getattr(result, "types", None)
+        if types and i < len(types):
+            return getattr(types[i], "name", "unknown")
+        return "unknown"
+
+    @staticmethod
+    def _to_json_row(row) -> list:
+        out = []
+        for v in row:
+            if isinstance(v, decimal.Decimal):
+                out.append(str(v))
+            elif isinstance(v, datetime.date):
+                out.append(v.isoformat())
+            elif isinstance(v, np.generic):
+                out.append(v.item())
+            else:
+                out.append(v)
+        return out
+
+    # ------------------------------------------------------------ protocol
+
+    def results_payload(self, info: QueryInfo, token: int,
+                        base_uri: str) -> Dict:
+        """QueryResults wire shape for page `token` (nextUri paging:
+        StatementClientV1.java:86 advances until nextUri is absent)."""
+        payload: Dict = {
+            "id": info.query_id,
+            "infoUri": f"{base_uri}/v1/query/{info.query_id}",
+            "stats": {
+                "state": info.state,
+                "elapsedTimeMillis": int(
+                    ((info.end_time or time.time()) - info.create_time) * 1000),
+                "processedRows": info.row_count,
+            },
+        }
+        if info.state == FAILED:
+            payload["error"] = info.error
+            return payload
+        if info.state in (QUEUED, RUNNING):
+            # not ready: client polls the same token
+            payload["nextUri"] = \
+                f"{base_uri}/v1/statement/{info.query_id}/{token}"
+            return payload
+        if info.state == CANCELED:
+            # surface cancellation as an error: a client mid-pagination must
+            # raise, not mistake the truncated rows for a complete result
+            payload["error"] = {"message": "Query was canceled",
+                                "errorType": "QueryCanceled"}
+            return payload
+        # FINISHED: serve page `token`, advance nextUri while rows remain
+        lo = token * self.page_rows
+        hi = lo + self.page_rows
+        payload["columns"] = info.columns
+        if lo < info.row_count:
+            payload["data"] = info.rows[lo:hi]
+        if hi < info.row_count:
+            payload["nextUri"] = \
+                f"{base_uri}/v1/statement/{info.query_id}/{token + 1}"
+        return payload
