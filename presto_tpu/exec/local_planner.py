@@ -30,7 +30,7 @@ from ..ops.expressions import (Constant, InputLayout, RowExpression, SymbolRef,
                                input_ref, resolve_symbols, symbol_ref)
 from ..ops.filter_project import FilterProjectOperatorFactory, PageProcessor
 from ..ops.hash_agg import SINGLE, HashAggregationOperatorFactory
-from ..ops.hash_join import (ANTI, INNER, LEFT, SEMI, JoinBuildOperatorFactory,
+from ..ops.hash_join import (ANTI, FULL, INNER, LEFT, SEMI, JoinBuildOperatorFactory,
                              LookupJoinOperatorFactory)
 from ..ops.scan import TableScanOperatorFactory
 from ..ops.single_row import EnforceSingleRowOperatorFactory
@@ -329,7 +329,8 @@ class LocalExecutionPlanner:
         unique = self._keys_unique(node.right, right_keys)
         build_fac = JoinBuildOperatorFactory(
             next(self._ids), build_key_ch, payload_ch, payload_meta,
-            strategy="sorted", unique=unique)
+            strategy="sorted", unique=unique,
+            track_unmatched=node.type == "full")
         self.pipelines.append(build_chain.factories + [build_fac])
 
         probe_out_ch = [probe_chain.channel(s.name) for s in probe_out]
@@ -441,8 +442,9 @@ class LocalExecutionPlanner:
             return INNER
         if node.type == "left":  # RIGHT was flipped to LEFT by the planner
             return LEFT
-        raise NotImplementedError(
-            f"{node.type} join needs build-side visited tracking (planned rev)")
+        if node.type == "full":
+            return FULL
+        raise NotImplementedError(f"{node.type} join")
 
     def _keys_unique(self, node: PlanNode, keys: List[Symbol]) -> bool:
         """Conservative uniqueness proof for the build keys."""
@@ -541,6 +543,37 @@ class LocalExecutionPlanner:
             next(self._ids), key_ch, key_types, key_dicts, key_domains, calls,
             op_step, self.page_capacity,
             max_groups=int(self.session.get("max_groups")))
+        return Chain(src.factories + [fac], out_syms, out_dicts)
+
+    def visit_WindowNode(self, node) -> Chain:
+        from ..ops.window import WindowOperatorFactory
+        from ..types import DecimalType
+
+        src = self.visit(node.source)
+        part_ch = [src.channel(k.name) for k in node.partition_keys]
+        orders = self._orders(src, node.orderings)
+        call_channels = []
+        call_meta = []
+        for sym, call in node.calls:
+            arg_chs = [src.channel(a.name) for a in call.args]
+            scale_div = 1
+            if call.name == "avg" and arg_chs:
+                at = src.symbols[arg_chs[0]].type
+                if isinstance(at, DecimalType):
+                    scale_div = 10 ** at.scale
+            out_dict = None
+            if call.name in ("min", "max", "lag", "lead", "first_value",
+                             "last_value") and arg_chs and \
+                    src.dicts[arg_chs[0]] is not None:
+                out_dict = src.dicts[arg_chs[0]]
+            call_channels.append((call.name, arg_chs, call.frame_mode,
+                                  scale_div))
+            call_meta.append((sym.type, out_dict))
+        fac = WindowOperatorFactory(
+            next(self._ids), part_ch, orders, call_channels, call_meta,
+            [s.type for s in src.symbols])
+        out_syms = src.symbols + [s for s, _ in node.calls]
+        out_dicts = list(src.dicts) + [d for _, d in call_meta]
         return Chain(src.factories + [fac], out_syms, out_dicts)
 
     def visit_UnionNode(self, node: UnionNode) -> Chain:

@@ -80,6 +80,12 @@ class LookupSource:
     payload_nulls: Tuple = ()
     # whether any live build row had a NULL key (drives null-aware NOT IN semantics)
     has_null_key: bool = False
+    # FULL-join side buffer: build rows whose key was NULL never match but must
+    # still appear unmatched in the output (tracked separately because matching
+    # structures exclude them)
+    null_key_payload: Optional[Tuple] = None
+    null_key_nulls: Tuple = ()
+    null_key_count: int = 0
 
     @property
     def exact_keys(self) -> bool:
@@ -132,6 +138,7 @@ class JoinBuildOperator(Operator):
         self.f = factory
         self._pages: List[Page] = []       # device-resident
         self._host_pages: List[Page] = []  # spilled to host RAM (numpy)
+        self._null_key_pages: List[Page] = []  # FULL join: unmatched-by-construction
         self._saw_null_key = None  # device bool accumulator, synced once at build
 
     @property
@@ -148,6 +155,19 @@ class JoinBuildOperator(Operator):
                     else (self._saw_null_key | seen)
         self._pages.append(_compact_for_build(page, tuple(self.f.key_channels),
                                               tuple(self.f.payload_channels)))
+        if self.f.track_unmatched and \
+                any(page.blocks[c].nulls is not None
+                    for c in self.f.key_channels):
+            # FULL join: keep NULL-key build rows aside — they never match but
+            # must surface as unmatched rows in the output. No device sync
+            # here: the rows are filtered by mask once at _build.
+            nk = jnp.zeros_like(page.mask)
+            for c in self.f.key_channels:
+                if page.blocks[c].nulls is not None:
+                    nk = nk | page.blocks[c].nulls
+            nk = nk & page.mask
+            sel = page.select_channels(list(self.f.payload_channels))
+            self._null_key_pages.append(sel.with_mask(nk))
         self.context.update_revocable(self.revocable_bytes(),
                                       self.start_memory_revoke)
 
@@ -222,6 +242,25 @@ class JoinBuildOperator(Operator):
                                 self.f.payload_meta, self.f.unique)
         src.payload_nulls = tuple(payload_nulls)
         src.has_null_key = bool(self._saw_null_key) if self._saw_null_key is not None else False
+        if self._null_key_pages:
+            nmask = np.concatenate([np.asarray(p.mask)
+                                    for p in self._null_key_pages])
+            keep = np.flatnonzero(nmask)
+            cols, nils = [], []
+            for i in range(len(self.f.payload_channels)):
+                col = np.concatenate([np.asarray(p.blocks[i].data)
+                                      for p in self._null_key_pages])
+                cols.append(col[keep])
+                if any(p.blocks[i].nulls is not None
+                       for p in self._null_key_pages):
+                    nm = np.concatenate([np.asarray(p.blocks[i].null_mask())
+                                         for p in self._null_key_pages])
+                    nils.append(nm[keep])
+                else:
+                    nils.append(None)
+            src.null_key_payload = tuple(cols)
+            src.null_key_nulls = tuple(nils)
+            src.null_key_count = len(keep)
         return src
 
     def is_finished(self) -> bool:
@@ -283,8 +322,11 @@ class JoinBuildOperatorFactory(OperatorFactory):
                  payload_channels: List[int],
                  payload_meta: List[Tuple[Type, Optional[Dictionary]]],
                  strategy: str = "sorted", unique: bool = False,
-                 dense_min: int = 0, dense_max: int = 0):
+                 dense_min: int = 0, dense_max: int = 0,
+                 track_unmatched: bool = False):
         super().__init__(operator_id, "JoinBuild")
+        # FULL joins need the NULL-key build rows preserved for unmatched output
+        self.track_unmatched = track_unmatched
         if strategy == "dense" and not unique:
             # the dense table stores ONE row index per key slot — a duplicate build
             # key would silently keep only the last row; refuse at plan time
@@ -345,6 +387,8 @@ class LookupJoinOperator(Operator):
         self.f = factory
         self._outputs: List[Page] = []
         self._source: Optional[LookupSource] = None
+        self._visited = None  # FULL: device bool per build row, OR-accumulated
+        self._unmatched_emitted = False
 
     @property
     def output_types(self) -> List[Type]:
@@ -378,10 +422,13 @@ class LookupJoinOperator(Operator):
         for c in self.f.probe_key_channels:
             if page.blocks[c].nulls is not None:
                 probe_mask = probe_mask & ~page.blocks[c].nulls
-        if self.f.join_type in (RIGHT, FULL):
+        if self.f.join_type == RIGHT:
             raise NotImplementedError(
-                "RIGHT/FULL joins need build-side visited tracking (planned rev); "
-                "the planner must not route them here yet")
+                "RIGHT joins are planned as flipped LEFT; the planner must not "
+                "route them here")
+        if self.f.join_type == FULL and self._visited is None:
+            self._visited = jnp.zeros(src.key_arrays[0].shape[0],
+                                      dtype=jnp.bool_)
         # unique fast path requires exact key equality through sorted_key/dense table;
         # multi-key hashes must range-scan + verify via the expansion path
         if self.f.join_type in (SEMI, ANTI):
@@ -441,6 +488,8 @@ class LookupJoinOperator(Operator):
         src = self._source
         jt = self.f.join_type
         matched = row >= 0
+        if jt == FULL:
+            self._visited = _mark_rows(self._visited, row, page.mask)
         if jt == SEMI or jt == ANTI:
             if self.f.semi_output_channel is not None:
                 # mark column output (SemiJoinOperator semantics): keep all rows,
@@ -480,11 +529,9 @@ class LookupJoinOperator(Operator):
     def _emit_expanded(self, page: Page, probe_keys, probe_mask) -> None:
         src = self._source
         jt = self.f.join_type
-        if jt not in (INNER, LEFT):
-            raise NotImplementedError(
-                "RIGHT/FULL joins on non-unique build sides need build-side "
-                "visited tracking (planned rev)")
-        left = jt == LEFT
+        if jt not in (INNER, LEFT, FULL):
+            raise NotImplementedError(f"{jt} join via expansion")
+        left = jt in (LEFT, FULL)
         if left and not src.exact_keys:
             # a mixed-hash collision would mask a probe row's only match slots and
             # silently drop the row; LEFT semantics need exact combined keys
@@ -494,6 +541,12 @@ class LookupJoinOperator(Operator):
         ck = combined_key(probe_keys)
         lo, emit, match_counts, total = _range_kernel(
             src.sorted_key, ck, probe_mask, page.mask, left)
+        if jt == FULL:
+            # exact single-key ranges (guaranteed above): every build row in a
+            # live probe row's [lo, lo+match) range is a true match
+            self._visited = _mark_ranges(self._visited, src.sorted_row, lo,
+                                         lo + match_counts,
+                                         probe_mask & page.mask)
         total = int(total)  # host sync: output cardinality for this page
         cap = page.capacity
         n_chunks = max(1, -(-total // cap)) if total > 0 else 0
@@ -521,12 +574,108 @@ class LookupJoinOperator(Operator):
             return out
         return None
 
+    def finish(self) -> None:
+        if self.f.join_type == FULL and not self._unmatched_emitted:
+            self._unmatched_emitted = True
+            self._emit_unmatched_build()
+        super().finish()
+
+    def _emit_unmatched_build(self) -> None:
+        """FULL join epilogue: build rows no probe row visited (plus NULL-key
+        build rows, unmatched by construction) emit with null probe columns."""
+        lf = self.f.lookup_factory
+        w = self.context.worker
+        if self._source is None:
+            if not lf.done(w):
+                return  # no probe input ever arrived and build never finished
+            self._source = lf.get(w)
+        src = self._source
+        total_build = int(src.build_count)
+        rows = np.zeros(0, dtype=np.int64)
+        if total_build > 0:
+            # live build rows are NOT a prefix of the concatenated page arrays
+            # (pages are capacity-padded); the sort kernel puts the n live rows
+            # first in sorted order, so sorted_row[:n] IS the live-row index set
+            live = np.asarray(src.sorted_row)[:total_build]
+            if self._visited is not None:
+                vis = np.asarray(self._visited)
+                rows = live[~vis[live]]
+            else:
+                rows = live
+        n_un = len(rows)
+        n_null = src.null_key_count
+        if n_un + n_null == 0:
+            return
+        cap = max(1 << 10, 1 << (n_un + n_null - 1).bit_length()) \
+            if n_un + n_null else 1 << 10
+        cap = min(cap, 1 << 16)
+        payload_np = [np.asarray(a) for a in src.payload]
+        nulls_np = [np.asarray(x) if x is not None else None
+                    for x in src.payload_nulls]
+        # assemble [unvisited live rows] + [null-key side buffer] per column
+        cols = []
+        for bi, (t, d) in zip(self.f.build_output_channels,
+                              _payload_meta_selected(src, self.f)):
+            parts = [payload_np[bi][rows]] if n_un else []
+            nparts = []
+            bn = nulls_np[bi] if bi < len(nulls_np) else None
+            nparts.append((bn[rows] if bn is not None else
+                           np.zeros(n_un, dtype=bool)) if n_un else
+                          np.zeros(0, dtype=bool))
+            if n_null:
+                parts.append(src.null_key_payload[bi])
+                nk_n = src.null_key_nulls[bi]
+                nparts.append(nk_n if nk_n is not None
+                              else np.zeros(n_null, dtype=bool))
+            data = np.concatenate(parts) if parts else np.zeros(0)
+            nul = np.concatenate(nparts)
+            cols.append((t, d, data, nul))
+        total = n_un + n_null
+        for lo in range(0, total, cap):
+            hi = min(lo + cap, total)
+            pad = cap - (hi - lo)
+            blocks = []
+            # probe columns: all NULL
+            for (t, d) in self.f.probe_output_meta:
+                z = np.zeros(cap, dtype=t.np_dtype)
+                blocks.append(Block(t, z, np.ones(cap, dtype=bool), d))
+            for (t, d, data, nul) in cols:
+                seg = np.concatenate([data[lo:hi],
+                                      np.zeros(pad, dtype=data.dtype)]) \
+                    if pad else data[lo:hi]
+                nseg = np.concatenate([nul[lo:hi], np.zeros(pad, dtype=bool)]) \
+                    if pad else nul[lo:hi]
+                blocks.append(Block(t, seg.astype(t.np_dtype, copy=False),
+                                    nseg if nseg.any() else None, d))
+            mask = np.arange(cap) < (hi - lo)
+            self._push(Page(tuple(blocks), mask))
+
     def is_finished(self) -> bool:
         return self._finishing and not self._outputs
 
 
 def _payload_meta_selected(src: LookupSource, f) -> List[Tuple[Type, Optional[Dictionary]]]:
     return [src.payload_meta[i] for i in f.build_output_channels]
+
+
+@jax.jit
+def _mark_rows(visited, row, mask):
+    """OR build rows matched by this probe page into the visited set."""
+    idx = jnp.where((row >= 0) & mask, row, visited.shape[0])
+    return visited.at[idx].set(True, mode="drop")
+
+
+@jax.jit
+def _mark_ranges(visited, sorted_row, lo, hi, probe_mask):
+    """Visited-marking for range matches: coverage via a difference array —
+    O(n) regardless of match multiplicity."""
+    n = sorted_row.shape[0]
+    add = jnp.where(probe_mask, 1, 0).astype(jnp.int32)
+    delta = jnp.zeros(n + 1, dtype=jnp.int32)
+    delta = delta.at[jnp.where(probe_mask, lo, n)].add(add, mode="drop")
+    delta = delta.at[jnp.where(probe_mask, hi, n)].add(-add, mode="drop")
+    covered = jnp.cumsum(delta[:-1]) > 0
+    return visited.at[sorted_row].max(covered)
 
 
 @functools.partial(jax.jit, static_argnames=("left",))
@@ -605,6 +754,7 @@ class LookupJoinOperatorFactory(OperatorFactory):
         self.lookup_factory = lookup_factory
         self.probe_key_channels = probe_key_channels
         self.probe_output_channels = probe_output_channels
+        self.probe_output_meta = list(probe_output_meta)
         self.build_output_channels = build_output_channels
         self.join_type = join_type
         self.semi_output_channel = semi_output_channel

@@ -66,7 +66,10 @@ class LocalQueryRunner:
             inner = stmt.statement
             if not isinstance(inner, t.Query):
                 raise ValueError("EXPLAIN requires a query")
-            text = plan_to_text(self.plan_statement(inner))
+            if stmt.analyze:
+                text = self._explain_analyze(inner)
+            else:
+                text = plan_to_text(self.plan_statement(inner))
             return QueryResult([[line] for line in text.split("\n")],
                                ["Query Plan"])
         if isinstance(stmt, t.ShowTables):
@@ -90,15 +93,48 @@ class LocalQueryRunner:
             raise ValueError(f"unsupported statement {type(stmt).__name__}")
 
         plan = self.plan_statement(stmt)
+        exec_plan, _drivers, _wall = self._run_plan(plan)
+        return QueryResult(exec_plan.sink.rows(), exec_plan.output_names,
+                           exec_plan.output_types)
+
+    def _run_plan(self, plan: OutputNode):
+        """Shared execution recipe: local planning + memory wiring + task
+        executor. Both execute() and EXPLAIN ANALYZE go through here so the
+        profile always measures the pipeline the query actually runs."""
+        import time as _time
+
         local = LocalExecutionPlanner(self.metadata, self.session)
         local.attach_memory(*self._query_memory())
         exec_plan = local.plan(plan)
         drivers = exec_plan.create_drivers()
+        t0 = _time.time()
         # task executor: build/probe pipelines overlap on runner threads
         # (blocked probes park until their lookup slot resolves)
         TaskExecutor(int(self.session.get("task_concurrency"))).execute(drivers)
-        return QueryResult(exec_plan.sink.rows(), exec_plan.output_names,
-                           exec_plan.output_types)
+        return exec_plan, drivers, _time.time() - t0
+
+    def _explain_analyze(self, stmt: t.Query) -> str:
+        """EXPLAIN ANALYZE: execute, then render the plan with per-operator
+        rows/time/memory (ExplainAnalyzeOperator.java analogue — here the
+        stats roll up from each driver's OperatorContext after the run)."""
+        plan = self.plan_statement(stmt)
+        _exec_plan, drivers, wall = self._run_plan(plan)
+        lines = [f"Query: {wall * 1000:.0f}ms wall, "
+                 f"{len(drivers)} drivers, "
+                 f"{sum(len(d.operators) for d in drivers)} operators", ""]
+        lines += [f"{'Operator':<28}{'In rows':>10}{'Out rows':>10}"
+                  f"{'Wall ms':>9}{'Peak MB':>9}"]
+        lines += ["-" * 66]
+        for di, d in enumerate(drivers):
+            lines.append(f"pipeline {di}:")
+            for op in d.operators:
+                s = op.context.stats
+                lines.append(
+                    f"  {s.name:<26}{s.input_rows:>10}{s.output_rows:>10}"
+                    f"{s.total_ns() / 1e6:>9.1f}"
+                    f"{s.peak_memory_bytes / 1e6:>9.2f}")
+        lines += ["", plan_to_text(plan)]
+        return "\n".join(lines)
 
     def _query_memory(self):
         """Per-query memory root drawing on a GENERAL pool; the returned probe

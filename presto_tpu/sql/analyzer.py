@@ -196,13 +196,32 @@ def _ast_children(node):
 
 
 def extract_aggregates(expr: t.Expression) -> List[t.FunctionCall]:
-    """All aggregate FunctionCalls in the tree (not descending into subqueries)."""
+    """All aggregate FunctionCalls in the tree (not descending into subqueries
+    or window expressions — `sum(x) OVER (...)` is a window, not an aggregate)."""
     out = []
 
     def walk(node):
+        if isinstance(node, t.WindowExpression):
+            return
         if isinstance(node, t.FunctionCall) and node.name.lower() in AGGREGATE_NAMES:
             out.append(node)
             return  # no nested aggregates
+        if isinstance(node, t.SubqueryExpression):
+            return
+        for c in _ast_children(node):
+            walk(c)
+    walk(expr)
+    return out
+
+
+def extract_windows(expr: t.Expression) -> List["t.WindowExpression"]:
+    """All window expressions in the tree (not descending into subqueries)."""
+    out = []
+
+    def walk(node):
+        if isinstance(node, t.WindowExpression):
+            out.append(node)
+            return
         if isinstance(node, t.SubqueryExpression):
             return
         for c in _ast_children(node):

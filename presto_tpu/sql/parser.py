@@ -40,6 +40,8 @@ KEYWORDS = {
     "last", "explain", "analyze", "show", "tables", "schemas", "columns", "session",
     "set", "create", "table", "row", "unnest", "ordinality", "coalesce", "filter",
     "substring", "for", "count", "exists",
+    "over", "partition", "rows", "range", "unbounded", "preceding", "current",
+    "following",
 }
 
 _TOKEN_RE = re.compile(r"""
@@ -756,7 +758,38 @@ class _Parser:
             self.expect_op(")")
             assert isinstance(call, t.FunctionCall)
             call = t.FunctionCall(call.name, call.args, call.distinct, cond)
+        if self.at_kw("over"):
+            assert isinstance(call, t.FunctionCall)
+            return t.WindowExpression(call, self.parse_window_spec())
         return call
+
+    def parse_window_spec(self) -> t.WindowSpec:
+        self.expect_kw("over")
+        self.expect_op("(")
+        partition: List[t.Expression] = []
+        if self.accept_kw("partition"):
+            self.expect_kw("by")
+            partition.append(self.parse_expr())
+            while self.accept_op(","):
+                partition.append(self.parse_expr())
+        order_by, limit = (), None
+        if self.at_kw("order"):
+            order_by, limit = self.parse_order_limit()
+            if limit is not None:
+                self.error("LIMIT not allowed in window specification")
+        frame_mode = "range"
+        if self.at_kw("rows", "range"):
+            frame_mode = self.next().text.lower()
+            # only the default frame shape executes:
+            #   [ROWS|RANGE] BETWEEN UNBOUNDED PRECEDING AND CURRENT ROW
+            self.expect_kw("between")
+            self.expect_kw("unbounded")
+            self.expect_kw("preceding")
+            self.expect_kw("and")
+            self.expect_kw("current")
+            self.expect_kw("row")
+        self.expect_op(")")
+        return t.WindowSpec(tuple(partition), tuple(order_by), frame_mode)
 
     def parse_type_name(self) -> t.TypeName:
         name = self.expect_ident().lower()

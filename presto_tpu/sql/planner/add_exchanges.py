@@ -173,7 +173,11 @@ class ExchangePlanner:
         # replicated build — probe rows never move, every worker holds the full
         # build table (BroadcastOutputBuffer / REPLICATED join). Mandatory for
         # cross joins (scalar subqueries); otherwise the CBO's call.
-        if not node.criteria or self._should_broadcast(node.right):
+        # FULL joins can never broadcast: every worker would re-emit the whole
+        # replicated build side as unmatched rows
+        can_broadcast = node.type != "full"
+        if not node.criteria or (can_broadcast and
+                                 self._should_broadcast(node.right)):
             right = ExchangeNode(right, BROADCAST, [])
             return (JoinNode(node.type, left, right, node.criteria,
                              node.residual, node.output_symbols), ldist)
@@ -244,6 +248,22 @@ class ExchangePlanner:
         if dist != SINGLE_DIST:
             child = ExchangeNode(child, GATHER, [])
         return EnforceSingleRowNode(child), SINGLE_DIST
+
+    def visit_WindowNode(self, node):
+        from .plan import WindowNode
+        child, dist = self.visit(node.source)
+        if node.partition_keys:
+            # partition-wise independent: co-partition then evaluate locally
+            if not self._partitioned_on(dist, node.partition_keys):
+                child = ExchangeNode(child, REPARTITION,
+                                     list(node.partition_keys))
+            return (WindowNode(child, node.partition_keys, node.orderings,
+                               node.calls), _hash_dist(node.partition_keys))
+        # no PARTITION BY: the frame spans everything -> single worker
+        if dist != SINGLE_DIST:
+            child = ExchangeNode(child, GATHER, [])
+        return (WindowNode(child, node.partition_keys, node.orderings,
+                           node.calls), SINGLE_DIST)
 
     def visit_UnionNode(self, node: UnionNode):
         children = [self.visit(c)[0] for c in node.sources]

@@ -235,6 +235,36 @@ class SortNode(PlanNode):
         return SortNode(children[0], self.orderings)
 
 
+@dataclasses.dataclass(frozen=True)
+class WindowCall:
+    """One windowed function: rank()/row_number()/agg(x) OVER the node's spec.
+
+    Reference: sql/planner/plan/WindowNode.java Function."""
+    name: str
+    args: List[Symbol]
+    frame_mode: str = "range"  # range (peer groups share values) | rows
+
+
+@_node
+class WindowNode(PlanNode):
+    """WindowNode.java analogue: partition/order spec + function list; outputs
+    = source outputs + one symbol per window call (row order preserved)."""
+    source: PlanNode
+    partition_keys: List[Symbol]
+    orderings: List[Ordering]
+    calls: List  # [(Symbol, WindowCall)]
+
+    def outputs(self):
+        return self.source.outputs() + [s for s, _ in self.calls]
+
+    def children(self):
+        return [self.source]
+
+    def with_children(self, children):
+        return WindowNode(children[0], self.partition_keys, self.orderings,
+                          self.calls)
+
+
 @_node
 class TopNNode(PlanNode):
     source: PlanNode
@@ -422,6 +452,13 @@ def plan_to_text(node: PlanNode, indent: int = 0) -> str:
         detail = f" [{o}{n}]"
     elif isinstance(node, LimitNode):
         detail = f" [{node.count}]"
+    elif isinstance(node, WindowNode):
+        fns = ", ".join(f"{s.name} := {c.name}({', '.join(a.name for a in c.args)})"
+                        for s, c in node.calls)
+        o = ", ".join(f"{x.symbol.name}{' desc' if x.descending else ''}"
+                      for x in node.orderings)
+        detail = (f" [partition={[k.name for k in node.partition_keys]}"
+                  f" order=[{o}] {fns}]")
     elif isinstance(node, OutputNode):
         detail = f" [{', '.join(node.column_names)}]"
     lines = [f"{pad}- {name}{detail}"]

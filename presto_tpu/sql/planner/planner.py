@@ -19,7 +19,8 @@ from ...types import BIGINT, BOOLEAN, DecimalType, Type, UNKNOWN
 from .. import tree as t
 from ..analyzer import (AGGREGATE_NAMES, ExpressionTranslator, Field, Scope,
                         SemanticError, aggregate_output_type, cast_to, common_type,
-                        contains_aggregates, extract_aggregates, rewrite_ast)
+                        contains_aggregates, extract_aggregates,
+                        extract_windows, rewrite_ast)
 from .plan import (AggregationCall, AggregationNode, EnforceSingleRowNode,
                    FilterNode, JoinNode, LimitNode, Ordering, OutputNode, PlanNode,
                    ProjectNode, SemiJoinNode, SortNode, Symbol, SymbolAllocator,
@@ -251,9 +252,92 @@ class LogicalPlanner:
             any(contains_aggregates(i.expression) for i in select_items) or \
             (spec.having is not None and contains_aggregates(spec.having))
 
+        has_window = any(extract_windows(i.expression) for i in select_items)
+        if has_window:
+            if grouped:
+                raise SemanticError(
+                    "window functions over aggregated queries are not "
+                    "supported yet — wrap the aggregation in a subquery")
+            node, scope, select_items = self._plan_windows(node, scope,
+                                                           select_items)
+
         if grouped:
             return self._plan_grouped(node, scope, spec, select_items)
         return self._plan_ungrouped(node, scope, spec, select_items)
+
+    def _plan_windows(self, node: PlanNode, scope: Scope,
+                      select_items: List[t.SelectItem]):
+        """Plan SELECT-item window expressions into WindowNodes; each window
+        expression is replaced by an identifier over its output symbol
+        (sql/planner/WindowPlanner + QueryPlanner.window analogue)."""
+        from .plan import WindowCall, WindowNode
+        from ...types import BIGINT, DOUBLE
+
+        wins: List[t.WindowExpression] = []
+        for item in select_items:
+            for w in extract_windows(item.expression):
+                if w not in wins:
+                    wins.append(w)
+
+        tr = ExpressionTranslator(scope)
+        pre_assigns: List[Tuple[Symbol, RowExpression]] = []
+        pre_seen: Dict[str, Symbol] = {}
+        for f in scope.fields:
+            if f.symbol.name not in pre_seen:
+                pre_seen[f.symbol.name] = f.symbol
+                pre_assigns.append(
+                    (f.symbol, symbol_ref(f.symbol.name, f.symbol.type)))
+
+        def as_sym(ast: t.Expression, hint: str) -> Symbol:
+            e = tr.translate(ast)
+            if isinstance(e, SymbolRef):
+                return Symbol(e.name, e.type)
+            sym = self.symbols.new_symbol(hint, e.type)
+            pre_assigns.append((sym, e))
+            return sym
+
+        spec_map: Dict[tuple, List] = {}
+        mapping: Dict[t.Node, t.Node] = {}
+        extra_fields: List[Field] = []
+        for i, w in enumerate(wins):
+            psyms = tuple(as_sym(p, "wpart") for p in w.window.partition_by)
+            ords = tuple(Ordering(as_sym(s.sort_key, "word"), s.descending,
+                                  bool(s.nulls_first))
+                         for s in w.window.order_by)
+            fname = w.call.name.lower()
+            if fname in ("row_number", "rank", "dense_rank", "count"):
+                out_type = BIGINT
+            elif fname == "avg":
+                out_type = DOUBLE
+            elif fname in ("sum", "min", "max", "lag", "lead",
+                           "first_value", "last_value"):
+                if not w.call.args:
+                    raise SemanticError(f"{fname}() needs an argument")
+                out_type = tr.translate(w.call.args[0]).type
+            else:
+                raise SemanticError(f"unknown window function {fname}")
+            args = [as_sym(a, "warg") for a in w.call.args]
+            if fname in ("rank", "dense_rank") and not ords:
+                raise SemanticError(f"{fname}() requires ORDER BY in its "
+                                    "window specification")
+            wsym = self.symbols.new_symbol(fname, out_type)
+            key = (psyms, ords, w.window.frame_mode)
+            spec_map.setdefault(key, []).append(
+                (wsym, WindowCall(fname, args, w.window.frame_mode)))
+            placeholder = f"$win{i}"
+            mapping[w] = t.Identifier(placeholder)
+            extra_fields.append(Field(placeholder, wsym, None))
+
+        node = ProjectNode(node, pre_assigns)
+        for (psyms, ords, fm), calls in spec_map.items():
+            node = WindowNode(node, list(psyms), list(ords), calls)
+        new_scope = Scope(scope.fields + extra_fields)
+        new_items = []
+        for i, item in enumerate(select_items):
+            alias = item.alias or _name_of(item.expression, i)
+            new_items.append(t.SelectItem(
+                rewrite_ast(item.expression, mapping), alias))
+        return node, new_scope, new_items
 
     def _expand_select(self, items: Sequence[t.SelectItem],
                        scope: Scope) -> List[t.SelectItem]:

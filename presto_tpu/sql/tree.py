@@ -212,6 +212,21 @@ class FunctionCall(Expression):
 
 
 @_dc
+class WindowSpec(Node):
+    """OVER (...) clause (tree/Window.java analogue, frames narrowed to the
+    two the engine executes: RANGE/ROWS UNBOUNDED PRECEDING..CURRENT ROW)."""
+    partition_by: Tuple[Expression, ...] = ()
+    order_by: Tuple["SortItem", ...] = ()
+    frame_mode: str = "range"  # range | rows
+
+
+@_dc
+class WindowExpression(Expression):
+    call: FunctionCall
+    window: WindowSpec
+
+
+@_dc
 class Extract(Expression):
     field: str  # YEAR | MONTH | DAY | ...
     expression: Expression

@@ -123,6 +123,16 @@ def test_forced_partitioned_matches_broadcast(local):
     check(part, local, QUERIES[5])
 
 
+def test_dist_full_join(dist, local):
+    # FULL joins repartition both sides (broadcast would duplicate unmatched
+    # build rows); per-worker unmatched emission composes to the global result
+    check(dist, local,
+          "select c_name, o_orderkey from "
+          "(select * from customer where c_custkey < 30) c full join "
+          "(select * from orders where o_orderkey < 7) o "
+          "on c_custkey = o_custkey order by 1, 2")
+
+
 def test_skewed_join_key(dist, local):
     # hot-key stress: ~90% of orders land on one custkey partition via the
     # modulo classes; exchange capacity scales to the live rows, no drops

@@ -134,6 +134,30 @@ def test_left_join(runner, oracle):
           "where c.c_custkey < 50")
 
 
+def test_right_join(runner, oracle):
+    check(runner, oracle,
+          "select n_name, c_name from customer "
+          "right join nation on c_nationkey = n_nationkey "
+          "and c_acctbal > 9000")
+
+
+def test_full_join(runner, oracle):
+    # orders 1..6 vs a filtered customer set: unmatched rows on both sides
+    check(runner, oracle,
+          "select c_name, o_orderkey from "
+          "(select * from customer where c_custkey < 30) c full join "
+          "(select * from orders where o_orderkey < 7) o "
+          "on c_custkey = o_custkey")
+
+
+def test_full_join_duplicates(runner, oracle):
+    # non-unique build keys exercise the range-expansion + visited marking path
+    check(runner, oracle,
+          "select n.n_regionkey, r_name from "
+          "(select * from nation where n_nationkey < 12) n full join region "
+          "on n.n_regionkey = r_regionkey")
+
+
 def test_in_subquery_semijoin(runner, oracle):
     check(runner, oracle,
           "select count(*) from orders where o_custkey in "
