@@ -1,0 +1,4 @@
+"""TPC-DS connector (presto-tpcds analogue): generator + SPI implementation."""
+from .connector import TpcdsConnector, TpcdsConnectorFactory
+
+__all__ = ["TpcdsConnector", "TpcdsConnectorFactory"]

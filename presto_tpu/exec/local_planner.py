@@ -567,7 +567,7 @@ class LocalExecutionPlanner:
                     src.dicts[arg_chs[0]] is not None:
                 out_dict = src.dicts[arg_chs[0]]
             call_channels.append((call.name, arg_chs, call.frame_mode,
-                                  scale_div))
+                                  scale_div, call.offset))
             call_meta.append((sym.type, out_dict))
         fac = WindowOperatorFactory(
             next(self._ids), part_ch, orders, call_channels, call_meta,

@@ -76,6 +76,12 @@ class LookupSource:
     # sorted:
     sorted_key: Optional[jnp.ndarray] = None  # (n,) int64 combined keys, invalid rows +inf
     sorted_row: Optional[jnp.ndarray] = None  # (n,) int32 original row index
+    # exact multi-key packing (offsets/shifts/widths per key column): when the
+    # build key ranges fit 63 bits, the combined key is a bijective pack — no
+    # mixed-hash collisions, so every multi-key path gets the exact fast paths
+    pack_offsets: Optional[jnp.ndarray] = None
+    pack_shifts: Optional[jnp.ndarray] = None
+    pack_widths: Optional[jnp.ndarray] = None
     # per-payload-column null masks (None entries = column has no nulls):
     payload_nulls: Tuple = ()
     # whether any live build row had a NULL key (drives null-aware NOT IN semantics)
@@ -89,10 +95,18 @@ class LookupSource:
 
     @property
     def exact_keys(self) -> bool:
-        """True when sorted_key equality implies true key equality (single int key).
-        Multi-key 64-bit mixes can collide, so those probes must go through the
-        range-scan path which verifies every candidate."""
-        return len(self.key_arrays) <= 1
+        """True when sorted_key equality implies true key equality: single int
+        key, or a bijectively packed multi-key. Only un-packable multi-key
+        mixes (ranges beyond 63 bits) must range-scan + verify candidates."""
+        return len(self.key_arrays) <= 1 or self.pack_offsets is not None
+
+    def combine_probe(self, probe_keys) -> jnp.ndarray:
+        """Probe keys -> the build's combined-key space (packed when exact;
+        out-of-range probes map to a negative sentinel that matches nothing)."""
+        if self.pack_offsets is None:
+            return combined_key(probe_keys)
+        return _pack_key(tuple(probe_keys), self.pack_offsets,
+                         self.pack_shifts, self.pack_widths)
 
 
 class LookupSourceFactory:
@@ -177,7 +191,9 @@ class JoinBuildOperator(Operator):
     # pages count as revocable — spilled pages are already host RAM.
     def revocable_bytes(self) -> int:
         total = 0
-        for p in self._pages:
+        for p in self._pages + self._null_key_pages:
+            if isinstance(p.mask, np.ndarray):
+                continue  # already host-resident (revoked earlier)
             rows = p.capacity
             total += rows  # mask
             for b in p.blocks:
@@ -189,6 +205,9 @@ class JoinBuildOperator(Operator):
     def start_memory_revoke(self) -> None:
         self._host_pages.extend(jax.device_get(p) for p in self._pages)
         self._pages = []
+        self._null_key_pages = [p if isinstance(p.mask, np.ndarray)
+                                else jax.device_get(p)
+                                for p in self._null_key_pages]
         self.context.revocable_memory.set_bytes(0)
 
     def get_output(self) -> Optional[Page]:
@@ -301,20 +320,64 @@ def _build_dense(key, payload, mask, n, kmin, kmax, payload_meta, unique) -> Loo
 
 
 @jax.jit
-def _sorted_kernel(keys, mask):
-    ck = combined_key(keys)
+def _sorted_kernel_ck(ck, mask):
     big = jnp.int64(np.iinfo(np.int64).max)
     ck = jnp.where(mask, ck, big)
     order = jnp.argsort(ck)
     return ck[order], order.astype(jnp.int32)
 
 
+@jax.jit
+def _pack_key(keys, offsets, shifts, widths):
+    """Bijective multi-key pack; out-of-range values map to a negative
+    sentinel (never equal to any packed build key, which is >= 0)."""
+    acc = jnp.zeros(keys[0].shape[0], dtype=jnp.int64)
+    oob = jnp.zeros(keys[0].shape[0], dtype=jnp.bool_)
+    for i, k in enumerate(keys):
+        v = k.astype(jnp.int64) - offsets[i]
+        oob = oob | (v < 0) | (v >= (jnp.int64(1) << widths[i]))
+        acc = acc | (jnp.clip(v, 0, None) << shifts[i])
+    sentinel = jnp.int64(np.iinfo(np.int64).min // 2)
+    return jnp.where(oob, sentinel, acc)
+
+
+def _plan_packing(keys, mask):
+    """Host-side packing plan: per-key offsets/shifts/widths, or None when the
+    combined ranges exceed 62 bits. One device sync per build (the build
+    already syncs its row count)."""
+    offsets, widths = [], []
+    lo64 = np.iinfo(np.int64)
+    for k in keys:
+        mn = int(jnp.min(jnp.where(mask, k, jnp.int64(lo64.max))))
+        mx = int(jnp.max(jnp.where(mask, k, jnp.int64(lo64.min))))
+        if mx < mn:  # no live rows
+            mn, mx = 0, 0
+        offsets.append(mn)
+        widths.append(max((mx - mn).bit_length(), 1))
+    if sum(widths) > 62:
+        return None
+    shifts, acc = [], 0
+    for w in reversed(widths):
+        shifts.append(acc)
+        acc += w
+    shifts = list(reversed(shifts))
+    return (jnp.asarray(offsets, dtype=jnp.int64),
+            jnp.asarray(shifts, dtype=jnp.int64),
+            jnp.asarray(widths, dtype=jnp.int64))
+
+
 def _build_sorted(keys, payload, mask, n, payload_meta, unique) -> LookupSource:
-    sorted_key, sorted_row = _sorted_kernel(keys, mask)
+    pack = _plan_packing(keys, mask) if len(keys) > 1 else None
+    ck = _pack_key(tuple(keys), *pack) if pack is not None \
+        else combined_key(keys)
+    sorted_key, sorted_row = _sorted_kernel_ck(ck, mask)
     return LookupSource(kind="sorted", key_arrays=keys, payload=payload,
                         payload_meta=payload_meta,
                         build_count=jnp.asarray(n, jnp.int32), unique=unique,
-                        sorted_key=sorted_key, sorted_row=sorted_row)
+                        sorted_key=sorted_key, sorted_row=sorted_row,
+                        pack_offsets=pack[0] if pack else None,
+                        pack_shifts=pack[1] if pack else None,
+                        pack_widths=pack[2] if pack else None)
 
 
 class JoinBuildOperatorFactory(OperatorFactory):
@@ -363,10 +426,10 @@ def _probe_match_unique(source_table, base, probe_keys, probe_mask):
 
 
 @jax.jit
-def _probe_match_sorted_unique(sorted_key, sorted_row, probe_keys_list,
+def _probe_match_sorted_unique(sorted_key, sorted_row, ck, probe_keys_list,
                                probe_mask, key_arrays):
-    """SORTED unique build: binary search + verify."""
-    ck = combined_key(probe_keys_list)
+    """SORTED unique build: binary search + verify (ck = the build's
+    combined-key space, packed when exact)."""
     pos = jnp.searchsorted(sorted_key, ck)
     pos = jnp.clip(pos, 0, sorted_key.shape[0] - 1)
     hit = (sorted_key[pos] == ck) & probe_mask
@@ -447,6 +510,7 @@ class LookupJoinOperator(Operator):
         if src.kind == "dense":
             return _probe_match_unique(src.table, src.base, probe_keys[0], probe_mask)
         return _probe_match_sorted_unique(src.sorted_key, src.sorted_row,
+                                          src.combine_probe(tuple(probe_keys)),
                                           tuple(probe_keys), probe_mask,
                                           src.key_arrays)
 
@@ -456,7 +520,7 @@ class LookupJoinOperator(Operator):
         OR-reduce per probe row. The SemiJoinOperator-with-filter analogue
         (reference: LookupJoinOperator + JoinFilterFunctionCompiler)."""
         src = self._source
-        ck = combined_key(probe_keys)
+        ck = src.combine_probe(tuple(probe_keys))
         lo, emit, _match, total_dev = _range_kernel(
             src.sorted_key, ck, probe_mask, page.mask, False)
         total = int(total_dev)
@@ -538,7 +602,7 @@ class LookupJoinOperator(Operator):
             raise NotImplementedError(
                 "multi-key LEFT join on a non-unique build needs exact-key "
                 "verification with null-row fallback (single-key LEFT is exact)")
-        ck = combined_key(probe_keys)
+        ck = src.combine_probe(tuple(probe_keys))
         lo, emit, match_counts, total = _range_kernel(
             src.sorted_key, ck, probe_mask, page.mask, left)
         if jt == FULL:

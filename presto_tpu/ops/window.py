@@ -91,7 +91,7 @@ def _window_kernel(keys, args_and_nulls, mask, calls, n_keys, n_ord):
 
     outs = []
     ai = 0
-    for (name, n_args, frame_mode, scale_div) in calls:
+    for (name, n_args, frame_mode, scale_div, offset) in calls:
         cargs = args_and_nulls[ai: ai + 2 * n_args]
         ai += 2 * n_args
         if name == "row_number":
@@ -115,7 +115,7 @@ def _window_kernel(keys, args_and_nulls, mask, calls, n_keys, n_ord):
                 src = peer_last if frame_mode == "range" else pos
                 oob = jnp.zeros(n, dtype=jnp.bool_)
             else:
-                shift = jnp.int64(1 if name == "lag" else -1)
+                shift = jnp.int64(offset if name == "lag" else -offset)
                 src = pos - shift
                 clipped = jnp.clip(src, 0, n - 1)
                 oob = (src < 0) | (src > n - 1) | \
@@ -272,7 +272,7 @@ class WindowOperator(Operator):
         # min/max over a dict-encoded varchar must order by dictionary RANK,
         # not code; compute in rank space and map the result back to codes
         unrank: List[Optional[jnp.ndarray]] = []
-        for (name, arg_chs, _fm, _sd) in f.call_channels:
+        for (name, arg_chs, _fm, _sd, _off) in f.call_channels:
             post = None
             for i, ch in enumerate(arg_chs):
                 b = page.blocks[ch]
@@ -333,20 +333,20 @@ class WindowOperator(Operator):
 class WindowOperatorFactory(OperatorFactory):
     def __init__(self, operator_id: int, partition_channels: List[int],
                  orderings: List,
-                 call_channels: List[Tuple[str, List[int], str, int]],
+                 call_channels: List[Tuple[str, List[int], str, int, int]],
                  call_meta: List[Tuple[Type, Optional[Dictionary]]],
                  input_types: List[Type]):
         super().__init__(operator_id, "Window")
         self.partition_channels = partition_channels
         self.orderings = orderings      # [SortOrder(channel, desc, nulls_first)]
-        # [(fn name, arg channels, frame mode, decimal scale divisor)]
+        # [(fn name, arg channels, frame mode, decimal scale divisor, offset)]
         self.call_channels = call_channels
         self.call_meta = call_meta
         self.output_types = list(input_types) + [t for t, _ in call_meta]
 
     def call_channels_static(self):
-        return [(name, len(chs), fm, sd)
-                for (name, chs, fm, sd) in self.call_channels]
+        return [(name, len(chs), fm, sd, off)
+                for (name, chs, fm, sd, off) in self.call_channels]
 
     def create_operator(self, worker: int = 0) -> WindowOperator:
         return WindowOperator(self.context(worker), self)

@@ -37,6 +37,8 @@ class LocalQueryRunner:
         if catalogs is None:
             catalogs = CatalogManager()
             catalogs.register("tpch", TpchConnector("tpch"))
+            from .connectors.tpcds import TpcdsConnector
+            catalogs.register("tpcds", TpcdsConnector("tpcds"))
         self.catalogs = catalogs
         self.metadata = MetadataManager(catalogs)
         self.session = session or Session(catalog="tpch", schema="tiny")

@@ -243,6 +243,7 @@ class WindowCall:
     name: str
     args: List[Symbol]
     frame_mode: str = "range"  # range (peer groups share values) | rows
+    offset: int = 1            # lag/lead distance (literal second argument)
 
 
 @_node

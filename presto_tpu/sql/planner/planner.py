@@ -301,10 +301,19 @@ class LogicalPlanner:
         extra_fields: List[Field] = []
         for i, w in enumerate(wins):
             psyms = tuple(as_sym(p, "wpart") for p in w.window.partition_by)
+            # same null-placement default as top-level ORDER BY: NULLs are
+            # largest (nulls last ASC, nulls first DESC)
             ords = tuple(Ordering(as_sym(s.sort_key, "word"), s.descending,
-                                  bool(s.nulls_first))
+                                  s.nulls_first if s.nulls_first is not None
+                                  else s.descending)
                          for s in w.window.order_by)
             fname = w.call.name.lower()
+            if w.call.distinct:
+                raise SemanticError(
+                    f"DISTINCT in window function {fname} is not supported")
+            if w.call.filter is not None:
+                raise SemanticError(
+                    f"FILTER on window function {fname} is not supported")
             if fname in ("row_number", "rank", "dense_rank", "count"):
                 out_type = BIGINT
             elif fname == "avg":
@@ -316,14 +325,29 @@ class LogicalPlanner:
                 out_type = tr.translate(w.call.args[0]).type
             else:
                 raise SemanticError(f"unknown window function {fname}")
-            args = [as_sym(a, "warg") for a in w.call.args]
+            offset = 1
+            value_args = list(w.call.args)
+            if fname in ("lag", "lead"):
+                if len(value_args) > 3:
+                    raise SemanticError(f"{fname} takes at most 3 arguments")
+                if len(value_args) == 3:
+                    raise SemanticError(
+                        f"{fname} default-value argument is not supported")
+                if len(value_args) == 2:
+                    off = tr.translate(value_args[1])
+                    if not isinstance(off, Constant) or off.value is None:
+                        raise SemanticError(
+                            f"{fname} offset must be a literal")
+                    offset = int(off.value)
+                    value_args = value_args[:1]
+            args = [as_sym(a, "warg") for a in value_args]
             if fname in ("rank", "dense_rank") and not ords:
                 raise SemanticError(f"{fname}() requires ORDER BY in its "
                                     "window specification")
             wsym = self.symbols.new_symbol(fname, out_type)
             key = (psyms, ords, w.window.frame_mode)
             spec_map.setdefault(key, []).append(
-                (wsym, WindowCall(fname, args, w.window.frame_mode)))
+                (wsym, WindowCall(fname, args, w.window.frame_mode, offset)))
             placeholder = f"$win{i}"
             mapping[w] = t.Identifier(placeholder)
             extra_fields.append(Field(placeholder, wsym, None))

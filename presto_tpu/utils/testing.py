@@ -94,35 +94,54 @@ class SqliteOracle:
                   max_rows: Optional[int] = None) -> None:
         from ..connectors.tpch import generator as g
 
-        cur = self.conn.cursor()
         for t in tables:
-            cols = (g.LINEITEM_COLUMNS if t == "lineitem"
-                    else [(c.name, c.type, c.dictionary) for c in g.TPCH_TABLES[t].columns])
-            names = [c[0] for c in cols]
-            cur.execute(f"CREATE TABLE IF NOT EXISTS {t} ({', '.join(names)})")
             if t == "lineitem":
+                cols = list(g.LINEITEM_COLUMNS)
                 n_orders = g.TPCH_TABLES["orders"].row_count(schema_sf)
-                data = g.lineitem_for_orders(0, n_orders, schema_sf, names)
+                data = g.lineitem_for_orders(0, n_orders, schema_sf,
+                                             [c[0] for c in cols])
             else:
+                cols = [(c.name, c.type, c.dictionary)
+                        for c in g.TPCH_TABLES[t].columns]
                 n = g.table_row_count(t, schema_sf)
                 if max_rows:
                     n = min(n, max_rows)
-                data = g.generate_rows(t, 0, n, schema_sf, names)
-            pycols = []
-            for (cname, ctype, cdict) in cols:
-                arr = data[cname]
-                if cdict is not None:
-                    pycols.append(cdict.lookup(arr.astype(np.int64)))
-                elif ctype.name == "decimal":
-                    pycols.append(arr.astype(np.float64) / (10 ** ctype.scale))
-                else:
-                    pycols.append(arr)
-            rows = list(zip(*[list(c) for c in pycols]))
-            rows = [tuple(x.item() if isinstance(x, np.generic) else x for x in r)
-                    for r in rows]
-            cur.executemany(
-                f"INSERT INTO {t} VALUES ({', '.join('?' * len(names))})", rows)
+                data = g.generate_rows(t, 0, n, schema_sf,
+                                       [c[0] for c in cols])
+            self._load_table(t, cols, data)
         self.conn.commit()
+
+    def load_tpcds(self, schema_sf: float, tables: Sequence[str]) -> None:
+        from ..connectors.tpcds import generator as g
+
+        for t in tables:
+            cols = [(c.name, c.type, c.dictionary)
+                    for c in g.TPCDS_TABLES[t].columns]
+            n = g.table_row_count(t, schema_sf)
+            data = g.generate_rows(t, 0, n, schema_sf, [c[0] for c in cols])
+            self._load_table(t, cols, data)
+        self.conn.commit()
+
+    def _load_table(self, table: str, cols, data) -> None:
+        """Decode dictionary codes / rescale decimals and bulk-insert."""
+        cur = self.conn.cursor()
+        names = [c[0] for c in cols]
+        cur.execute(f"CREATE TABLE IF NOT EXISTS {table} ({', '.join(names)})")
+        pycols = []
+        for (cname, ctype, cdict) in cols:
+            arr = data[cname]
+            if cdict is not None:
+                pycols.append(cdict.lookup(arr.astype(np.int64)))
+            elif ctype.name == "decimal":
+                pycols.append(arr.astype(np.float64) / (10 ** ctype.scale))
+            else:
+                pycols.append(arr)
+        rows = list(zip(*[list(c) for c in pycols]))
+        rows = [tuple(x.item() if isinstance(x, np.generic) else x for x in r)
+                for r in rows]
+        cur.executemany(
+            f"INSERT INTO {table} VALUES ({', '.join('?' * len(names))})",
+            rows)
 
     def query(self, sql: str) -> List[tuple]:
         return self.conn.execute(sql).fetchall()
