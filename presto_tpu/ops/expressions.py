@@ -490,11 +490,162 @@ class ExpressionCompiler:
             jfn = {"sqrt": jnp.sqrt, "ln": jnp.log, "log10": jnp.log10, "exp": jnp.exp,
                    "floor": jnp.floor, "ceil": jnp.ceil, "ceiling": jnp.ceil,
                    "round": jnp.round}[name]
+            at = expr.args[0].type
+            if isinstance(at, DecimalType) and name != "sqrt" and \
+                    name not in ("ln", "log10", "exp"):
+                # decimal substrate is scaled int64: round/floor/ceil operate
+                # on whole units of 10^scale, exactly (no float round-trip).
+                # round is half-AWAY-from-zero (Presto semantics)
+                mul = 10 ** at.scale
+
+                def fn(datas, nulls, _name=name, _m=mul):
+                    d, n = f(datas, nulls)
+                    a = jnp.abs(d)
+                    if _name == "round":
+                        q = jnp.sign(d) * ((a + _m // 2) // _m)
+                    elif _name == "floor":
+                        q = jnp.where(d >= 0, a // _m, -((a + _m - 1) // _m))
+                    else:  # ceil
+                        q = jnp.where(d >= 0, (a + _m - 1) // _m, -(a // _m))
+                    return (q * _m).astype(jnp.int64), n
+                return fn, None
+            if name == "round":
+                # Presto round(double): half away from zero, not half-to-even
+                return (lambda datas, nulls: ((lambda d, n: (
+                    jnp.sign(d) * jnp.floor(jnp.abs(d) + 0.5), n))(
+                        *f(datas, nulls)))), None
             return (lambda datas, nulls: ((lambda d, n: (jfn(d), n))(*f(datas, nulls)))), None
         if name == "hash_code":  # engine-internal
             f = self._compile(expr.args[0])[0]
             return (lambda datas, nulls: ((lambda d, n: (
                 _hash64(d.astype(jnp.int64)), n))(*f(datas, nulls)))), None
+        if name in ("log2", "cbrt", "truncate"):
+            f = self._compile(expr.args[0])[0]
+            jfn = {"log2": jnp.log2, "cbrt": jnp.cbrt,
+                   "truncate": jnp.trunc}[name]
+            at = expr.args[0].type
+            if name == "truncate" and isinstance(at, DecimalType):
+                mul = 10 ** at.scale
+
+                def fn(datas, nulls, _m=mul):
+                    d, n = f(datas, nulls)
+                    # toward-zero on the scaled int substrate
+                    q = jnp.sign(d) * (jnp.abs(d) // _m) * _m
+                    return q.astype(jnp.int64), n
+                return fn, None
+            return (lambda datas, nulls: (
+                (lambda d, n: (jfn(d), n))(*f(datas, nulls)))), None
+        if name == "round2":  # round(x, digits) with literal digits
+            f = self._compile(expr.args[0])[0]
+            dig = expr.args[1]
+            if not isinstance(dig, Constant):
+                raise NotImplementedError("round() digits must be a literal")
+            at = expr.args[0].type
+            digits = int(dig.value)
+            scale = at.scale if isinstance(at, DecimalType) else 0
+            if is_integral(at) or isinstance(at, DecimalType):
+                # exact on the (scaled-)integer substrate, half away from zero
+                shift = scale - digits
+                if shift <= 0:
+                    return f, None  # already finer than requested digits
+                m = 10 ** shift
+
+                def fn(datas, nulls, _m=m):
+                    d, n = f(datas, nulls)
+                    q = jnp.sign(d) * ((jnp.abs(d) + _m // 2) // _m) * _m
+                    return q.astype(jnp.int64), n
+                return fn, None
+            mul = 10.0 ** digits
+            return (lambda datas, nulls: ((lambda d, n: (
+                jnp.sign(d) * jnp.floor(jnp.abs(d) * mul + 0.5) / mul, n))(
+                    *f(datas, nulls)))), None
+        if name == "power":
+            fa = self._compile(expr.args[0])[0]
+            fb = self._compile(expr.args[1])[0]
+
+            def fn(datas, nulls):
+                a, na = fa(datas, nulls)
+                b, nb = fb(datas, nulls)
+                n = na if nb is None else (nb if na is None else (na | nb))
+                return jnp.power(a, b), n
+            return fn, None
+        if name == "sign":
+            f = self._compile(expr.args[0])[0]
+            return (lambda datas, nulls: ((lambda d, n: (
+                jnp.sign(d).astype(jnp.int64), n))(*f(datas, nulls)))), None
+        if name in ("greatest", "least"):
+            fns = [self._compile(a)[0] for a in expr.args]
+            pick = jnp.maximum if name == "greatest" else jnp.minimum
+
+            def fn(datas, nulls):
+                d, n = fns[0](datas, nulls)
+                for g in fns[1:]:
+                    d2, n2 = g(datas, nulls)
+                    d = pick(d, d2)
+                    # SQL: greatest/least is NULL if ANY argument is NULL
+                    n = n2 if n is None else (n if n2 is None else (n | n2))
+                return d, n
+            return fn, None
+        if name in ("quarter", "week", "day_of_week", "dow", "day_of_year",
+                    "doy"):
+            f = self._compile(expr.args[0])[0]
+            part = name
+
+            def fn(datas, nulls):
+                d, n = f(datas, nulls)
+                days = d.astype(jnp.int32)
+                if part == "quarter":
+                    _, m, _ = _civil_from_days(days)
+                    out = (m - 1) // 3 + 1
+                elif part in ("day_of_week", "dow"):
+                    out = (days.astype(jnp.int64) + 3) % 7 + 1  # 1=Monday
+                elif part in ("day_of_year", "doy"):
+                    y, _, _ = _civil_from_days(days)
+                    jan1 = _days_from_civil_vec(y, 1, 1)
+                    out = days.astype(jnp.int64) - jan1 + 1
+                else:  # ISO 8601 week-of-year
+                    y, _, _ = _civil_from_days(days)
+                    jan1 = _days_from_civil_vec(y, 1, 1)
+                    doy = days.astype(jnp.int64) - jan1 + 1
+                    dow = (days.astype(jnp.int64) + 3) % 7 + 1  # 1=Monday
+                    w = (doy - dow + 10) // 7
+
+                    def weeks_in(yy):
+                        p = (yy + yy // 4 - yy // 100 + yy // 400) % 7
+                        pm = ((yy - 1) + (yy - 1) // 4 - (yy - 1) // 100 +
+                              (yy - 1) // 400) % 7
+                        return 52 + ((p == 4) | (pm == 3)).astype(jnp.int64)
+                    y64 = y.astype(jnp.int64)
+                    out = jnp.where(w < 1, weeks_in(y64 - 1),
+                                    jnp.where(w > weeks_in(y64), 1, w))
+                return out.astype(jnp.int64), n
+            return fn, None
+        if name in ("length", "upper", "lower"):
+            d = self._dictionary_of(expr.args[0])
+            if d is None or not hasattr(d, "values"):
+                raise NotImplementedError(
+                    f"{name}() needs a materialized dictionary column")
+            f = self._compile(expr.args[0])[0]
+            if name == "length":
+                lens = jnp.asarray([len(v) for v in d.values],
+                                   dtype=jnp.int64)
+                return (lambda datas, nulls: ((lambda c, n: (
+                    lens[jnp.clip(c.astype(jnp.int32), 0, len(d.values) - 1)],
+                    n))(*f(datas, nulls)))), None
+            # upper/lower: transformed values can COLLIDE ('abc' and 'ABC'
+            # both upper to 'ABC'), so codes re-encode through a deduplicated
+            # dictionary — code-based equality then matches all colliding rows
+            xform = str.upper if name == "upper" else str.lower
+            transformed = [xform(v) for v in d.values]
+            uniq = sorted(set(transformed))
+            pos = {v: i for i, v in enumerate(uniq)}
+            remap = jnp.asarray([pos[v] for v in transformed], dtype=jnp.int32)
+            new_dict = Dictionary(uniq)
+
+            def fn(datas, nulls, _remap=remap, _hi=len(transformed) - 1):
+                c, n = f(datas, nulls)
+                return _remap[jnp.clip(c.astype(jnp.int32), 0, _hi)], n
+            return fn, new_dict
         raise NotImplementedError(f"function {name}")
 
     def _dictionary_of(self, expr: RowExpression) -> Optional[Dictionary]:
@@ -887,6 +1038,16 @@ def _civil_from_days(days: Array):
     m = jnp.where(mp < 10, mp + 3, mp - 9)
     y = jnp.where(m <= 2, y + 1, y)
     return y, m, d
+
+
+def _days_from_civil_vec(y: Array, m: int, d: int) -> Array:
+    """Vectorized inverse of _civil_from_days for a fixed month/day."""
+    y = y.astype(jnp.int64) - (1 if m <= 2 else 0)
+    era = jnp.where(y >= 0, y, y - 399) // 400
+    yoe = y - era * 400
+    doy = (153 * (m + (-3 if m > 2 else 9)) + 2) // 5 + d - 1
+    doe = yoe * 365 + yoe // 4 - yoe // 100 + doy
+    return era * 146097 + doe - 719468
 
 
 def days_from_civil(y: int, m: int, d: int) -> int:

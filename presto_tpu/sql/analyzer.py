@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import dataclasses
 import datetime
+import math
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from ..ops.expressions import (Call, Constant, RowExpression, SpecialForm, SymbolRef,
@@ -463,12 +464,52 @@ class ExpressionTranslator:
             return Call(args[0].type, "abs", args)
         if name in ("year", "month", "day"):
             return Call(BIGINT, name, args)
-        if name in ("sqrt", "ln", "log10", "exp"):
+        if name in ("sqrt", "ln", "log10", "log2", "exp", "cbrt"):
             return Call(DOUBLE, name, tuple(cast_to(a, DOUBLE) for a in args))
-        if name in ("floor", "ceil", "ceiling", "round"):
+        if name in ("floor", "ceil", "ceiling", "round", "truncate"):
+            if name == "round" and len(args) == 2:
+                # negative digits round integral columns too (round(1234,-2))
+                return Call(args[0].type, "round2", args)
             if is_integral(args[0].type):
                 return args[0]
             return Call(args[0].type, name, args)
+        if name in ("power", "pow"):
+            return Call(DOUBLE, "power", tuple(cast_to(a, DOUBLE) for a in args))
+        if name == "mod":
+            return Call(common_type(args[0].type, args[1].type), "modulus", args)
+        if name == "sign":
+            return Call(BIGINT, "sign", args)
+        if name == "pi":
+            return Constant(DOUBLE, math.pi)
+        if name in ("greatest", "least"):
+            for a in args:
+                if is_string(a.type):
+                    # varchar would compare dictionary CODES across unrelated
+                    # dictionaries — meaningless; reject until re-encode lands
+                    raise SemanticError(
+                        f"{name}() over varchar is not supported")
+            out_t = args[0].type
+            for a in args[1:]:
+                out_t = common_type(out_t, a.type)
+            return Call(out_t, name, tuple(cast_to(a, out_t) for a in args))
+        if name == "length":
+            if not is_string(args[0].type):
+                raise SemanticError("length() expects a varchar argument")
+            return Call(BIGINT, "length", args)
+        if name in ("upper", "lower"):
+            if not is_string(args[0].type):
+                raise SemanticError(f"{name}() expects a varchar argument")
+            return Call(args[0].type, name, args)
+        if name in ("quarter", "week", "day_of_week", "dow", "day_of_year",
+                    "doy"):
+            return Call(BIGINT, name, args)
+        if name == "date_add":
+            # date_add(unit, value, date) — day unit only (int date substrate)
+            unit = args[0]
+            if not isinstance(unit, Constant) or unit.value not in ("day",):
+                raise SemanticError("date_add supports the 'day' unit")
+            return Call(args[2].type, "add",
+                        (args[2], cast_to(args[1], BIGINT)))
         if name == "if":
             cond, then = args[0], args[1]
             els = args[2] if len(args) > 2 else Constant(UNKNOWN, None)

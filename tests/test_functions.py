@@ -1,0 +1,158 @@
+"""Scalar + aggregate function breadth vs the sqlite oracle / exact values.
+
+Reference analogues: operator/scalar/TestMathFunctions etc. + the aggregate
+suite under operator/aggregation/."""
+import math
+
+import pytest
+
+from presto_tpu.runner import LocalQueryRunner
+from presto_tpu.utils.testing import SqliteOracle, assert_rows_equal
+
+
+@pytest.fixture(scope="module")
+def runner():
+    return LocalQueryRunner()
+
+
+@pytest.fixture(scope="module")
+def oracle():
+    o = SqliteOracle()
+    o.load_tpch(0.01, ["nation", "orders", "customer"])
+    return o
+
+
+def check(runner, oracle, sql, ordered=False):
+    assert_rows_equal(runner.execute(sql).rows, oracle.query(sql),
+                      ordered=ordered)
+
+
+# ------------------------------------------------------------------ scalars
+
+def test_math_scalars(runner):
+    rows = runner.execute(
+        "select power(2, 10), mod(17, 5), sign(-3), sign(0), sign(42), "
+        "cbrt(27.0), log2(8.0), truncate(3.9), round(2.567, 2), pi() "
+        "from nation limit 1").rows[0]
+    assert rows[0] == 1024.0
+    assert rows[1] == 2
+    assert (rows[2], rows[3], rows[4]) == (-1, 0, 1)
+    assert abs(rows[5] - 3.0) < 1e-9
+    assert rows[6] == 3.0
+    assert float(rows[7]) == 3.0
+    assert abs(float(rows[8]) - 2.57) < 1e-9
+    assert abs(rows[9] - math.pi) < 1e-12
+
+
+def test_greatest_least(runner, oracle):
+    check(runner, oracle,
+          "select max(n_nationkey), min(n_regionkey) from nation")
+    # regionkeys of nations 0..2 are 0, 1, 1 -> 4*r = 0, 4, 4
+    rows = runner.execute(
+        "select greatest(n_nationkey, n_regionkey * 4, 7), "
+        "least(n_nationkey, n_regionkey * 4, 7) from nation "
+        "where n_nationkey < 3 order by n_nationkey").rows
+    assert rows == [[7, 0], [7, 1], [7, 2]]
+
+
+def test_string_scalars(runner, oracle):
+    check(runner, oracle,
+          "select n_name, length(n_name), upper(n_name), lower(n_name) "
+          "from nation order by n_nationkey limit 5", ordered=True)
+
+
+def test_date_parts(runner):
+    rows = runner.execute(
+        "select quarter(o_orderdate), day_of_week(o_orderdate), "
+        "day_of_year(o_orderdate), week(o_orderdate) "
+        "from orders where o_orderkey = 1").rows[0]
+    assert 1 <= rows[0] <= 4
+    assert 1 <= rows[1] <= 7
+    assert 1 <= rows[2] <= 366
+    assert 1 <= rows[3] <= 53
+
+
+def test_date_add(runner):
+    # date_add('day', 30, jun-1) == jul-1 (internal consistency)
+    a = runner.execute(
+        "select count(*) from orders "
+        "where o_orderdate < date_add('day', 30, date '1995-06-01')").rows
+    b = runner.execute(
+        "select count(*) from orders "
+        "where o_orderdate < date '1995-07-01'").rows
+    assert a == b and a[0][0] > 0
+
+
+# --------------------------------------------------------------- aggregates
+
+def test_count_if(runner, oracle):
+    # sqlite has no count_if; compare to the equivalent sum(case...)
+    got = runner.execute(
+        "select count_if(o_totalprice > 100000) from orders").rows
+    exp = oracle.query(
+        "select sum(case when o_totalprice > 100000 then 1 else 0 end) "
+        "from orders")
+    assert got[0][0] == exp[0][0]
+
+
+def test_bool_aggregates(runner):
+    rows = runner.execute(
+        "select bool_and(n_regionkey < 5), bool_or(n_regionkey = 4), "
+        "every(n_nationkey >= 0) from nation").rows[0]
+    assert rows == [True, True, True]
+
+
+def test_arbitrary(runner):
+    rows = runner.execute(
+        "select n_regionkey, arbitrary(n_name), any_value(n_nationkey) "
+        "from nation group by n_regionkey order by n_regionkey").rows
+    assert len(rows) == 5
+    assert all(isinstance(r[1], str) for r in rows)
+
+
+def test_variance_family(runner, oracle):
+    # sqlite lacks stddev; compute expected from raw data
+    vals = [r[0] for r in oracle.query("select o_totalprice from orders")]
+    n = len(vals)
+    mean = sum(vals) / n
+    var_pop = sum((v - mean) ** 2 for v in vals) / n
+    var_samp = var_pop * n / (n - 1)
+    got = runner.execute(
+        "select var_pop(o_totalprice), var_samp(o_totalprice), "
+        "stddev_pop(o_totalprice), stddev(o_totalprice) from orders").rows[0]
+    assert abs(got[0] - var_pop) / var_pop < 1e-9
+    assert abs(got[1] - var_samp) / var_samp < 1e-9
+    assert abs(got[2] - math.sqrt(var_pop)) / math.sqrt(var_pop) < 1e-9
+    assert abs(got[3] - math.sqrt(var_samp)) / math.sqrt(var_samp) < 1e-9
+
+
+def test_corr_covar(runner, oracle):
+    xs = [(r[0], r[1]) for r in oracle.query(
+        "select o_custkey, o_totalprice from orders")]
+    n = len(xs)
+    mx = sum(x for x, _ in xs) / n
+    my = sum(y for _, y in xs) / n
+    cov_pop = sum((x - mx) * (y - my) for x, y in xs) / n
+    got = runner.execute(
+        "select covar_pop(o_custkey, o_totalprice), "
+        "covar_samp(o_custkey, o_totalprice), "
+        "corr(o_custkey, o_totalprice) from orders").rows[0]
+    assert abs(got[0] - cov_pop) / max(abs(cov_pop), 1) < 1e-6
+    assert abs(got[1] - cov_pop * n / (n - 1)) / max(abs(cov_pop), 1) < 1e-6
+    assert -1.0 <= got[2] <= 1.0
+
+
+def test_approx_distinct(runner, oracle):
+    exact = oracle.query("select count(distinct o_custkey) from orders")[0][0]
+    got = runner.execute(
+        "select approx_distinct(o_custkey) from orders").rows[0][0]
+    assert abs(got - exact) / exact < 0.25, (got, exact)
+    # grouped sketch merge
+    rows = runner.execute(
+        "select o_orderpriority, approx_distinct(o_custkey) from orders "
+        "group by o_orderpriority").rows
+    exp = {r[0]: r[1] for r in oracle.query(
+        "select o_orderpriority, count(distinct o_custkey) from orders "
+        "group by o_orderpriority")}
+    for prio, est in rows:
+        assert abs(est - exp[prio]) / exp[prio] < 0.3, (prio, est, exp[prio])
