@@ -1,0 +1,175 @@
+"""Memory connector: writable in-process tables (presto-memory analogue).
+
+The reference's memory connector keeps table data as pages on the workers;
+here tables are host-resident page lists per (schema, table) in the connector
+instance. Supports CREATE TABLE AS / INSERT (page sink), full scans (range
+splits over the stored page list), and DROP. The engine's writer tests and
+the blackhole connector (see blackhole.py) mirror the reference's test
+connector duo.
+"""
+from __future__ import annotations
+
+import math
+import threading
+from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ...block import Page
+from ...spi.connector import (ColumnHandle, ColumnMetadata, ColumnStatistics,
+                              Connector, ConnectorMetadata,
+                              ConnectorPageSink, ConnectorPageSinkProvider,
+                              ConnectorPageSource, ConnectorPageSourceProvider,
+                              ConnectorSplitManager, Constraint,
+                              SchemaTableName, Split, TableHandle,
+                              TableMetadata, TableStatistics)
+
+
+class _TableData:
+    def __init__(self, metadata: TableMetadata):
+        self.metadata = metadata
+        self.pages: List[Page] = []
+        self.row_count = 0
+
+
+class MemoryMetadata(ConnectorMetadata):
+    def __init__(self, connector_id: str):
+        self.connector_id = connector_id
+        self._tables: Dict[SchemaTableName, _TableData] = {}
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------- reads
+
+    def list_schemas(self) -> List[str]:
+        return sorted({n.schema for n in self._tables} | {"default"})
+
+    def list_tables(self, schema: Optional[str] = None) -> List[SchemaTableName]:
+        return [n for n in self._tables
+                if schema is None or n.schema == schema]
+
+    def get_table_handle(self, name: SchemaTableName) -> Optional[TableHandle]:
+        if name in self._tables:
+            return TableHandle(self.connector_id, name)
+        return None
+
+    def get_table_metadata(self, table: TableHandle) -> TableMetadata:
+        return self._tables[table.schema_table].metadata
+
+    def get_table_statistics(self, table: TableHandle,
+                             constraint: Constraint) -> TableStatistics:
+        data = self._tables.get(table.schema_table)
+        return TableStatistics(row_count=float(data.row_count) if data else 0.0)
+
+    # ------------------------------------------------------------- writes
+
+    def create_table(self, metadata: TableMetadata) -> None:
+        with self._lock:
+            if metadata.name in self._tables:
+                raise ValueError(f"table {metadata.name} already exists")
+            self._tables[metadata.name] = _TableData(metadata)
+
+    def begin_insert(self, table: TableHandle):
+        return table
+
+    def finish_insert(self, handle, fragments) -> None:
+        data = self._tables[handle.schema_table]
+        with self._lock:
+            for page in fragments:
+                data.pages.append(page)
+                data.row_count += int(np.asarray(page.mask).sum())
+
+    def drop_table(self, table: TableHandle) -> None:
+        with self._lock:
+            self._tables.pop(table.schema_table, None)
+
+    def table_data(self, table: TableHandle) -> _TableData:
+        return self._tables[table.schema_table]
+
+
+class MemorySplitManager(ConnectorSplitManager):
+    def __init__(self, connector_id: str, metadata: MemoryMetadata):
+        self.connector_id = connector_id
+        self._metadata = metadata
+
+    def get_splits(self, table: TableHandle, constraint: Constraint,
+                   desired_splits: int) -> List[Split]:
+        n_pages = len(self._metadata.table_data(table).pages)
+        n_splits = max(1, min(desired_splits or 1, n_pages or 1))
+        step = math.ceil(max(n_pages, 1) / n_splits)
+        return [Split(self.connector_id,
+                      payload=(table.schema_table, lo,
+                               min(lo + step, n_pages)), bucket=b)
+                for b, lo in enumerate(range(0, max(n_pages, 1), step))]
+
+
+class MemoryPageSource(ConnectorPageSource):
+    def __init__(self, pages: List[Page], columns: Sequence[ColumnHandle],
+                 all_columns: List[str]):
+        self._pages = pages
+        self._select = [all_columns.index(c.name) for c in columns]
+
+    def __iter__(self) -> Iterator[Page]:
+        for p in self._pages:
+            yield Page(tuple(p.blocks[i] for i in self._select), p.mask)
+
+
+class MemoryPageSourceProvider(ConnectorPageSourceProvider):
+    def __init__(self, metadata: MemoryMetadata):
+        self._metadata = metadata
+
+    def create_page_source(self, split: Split, columns: Sequence[ColumnHandle],
+                           page_capacity: int,
+                           constraint: Constraint = Constraint.all()
+                           ) -> ConnectorPageSource:
+        name, lo, hi = split.payload
+        data = self._metadata._tables[name]
+        all_cols = [c.name for c in data.metadata.columns]
+        return MemoryPageSource(data.pages[lo:hi], columns, all_cols)
+
+
+class MemoryPageSink(ConnectorPageSink):
+    """Buffers written pages host-side; finish() returns them as the insert
+    fragments the metadata commit appends (ConnectorPageSink.finish ->
+    finishInsert fragment flow of the reference)."""
+
+    def __init__(self):
+        self._pages: List[Page] = []
+        self.rows_written = 0
+
+    def append_page(self, page: Page) -> None:
+        import jax
+
+        host = jax.device_get(page)
+        self._pages.append(host)
+        self.rows_written += int(np.asarray(host.mask).sum())
+
+    def finish(self) -> List[Page]:
+        return self._pages
+
+    def abort(self) -> None:
+        self._pages = []
+
+
+class MemoryPageSinkProvider(ConnectorPageSinkProvider):
+    def create_page_sink(self, insert_handle) -> ConnectorPageSink:
+        return MemoryPageSink()
+
+
+class MemoryConnector(Connector):
+    def __init__(self, connector_id: str):
+        self._metadata = MemoryMetadata(connector_id)
+        self._splits = MemorySplitManager(connector_id, self._metadata)
+        self._sources = MemoryPageSourceProvider(self._metadata)
+        self._sinks = MemoryPageSinkProvider()
+
+    def metadata(self) -> ConnectorMetadata:
+        return self._metadata
+
+    def split_manager(self) -> ConnectorSplitManager:
+        return self._splits
+
+    def page_source_provider(self) -> ConnectorPageSourceProvider:
+        return self._sources
+
+    def page_sink_provider(self) -> Optional[ConnectorPageSinkProvider]:
+        return self._sinks

@@ -301,9 +301,8 @@ def resolve_aggregate(name: str, arg_types: Sequence[Type],
 
 
 def _sketch_mix(x):
-    x = (x ^ (x >> jnp.uint64(33))) * jnp.uint64(0xFF51AFD7ED558CCD)
-    x = (x ^ (x >> jnp.uint64(33))) * jnp.uint64(0xC4CEB9FE1A85EC53)
-    return x ^ (x >> jnp.uint64(33))
+    from .hash_join import _mix64
+    return _mix64(x)
 
 
 @dataclasses.dataclass

@@ -571,6 +571,10 @@ class ExpressionCompiler:
             return fn, None
         if name == "sign":
             f = self._compile(expr.args[0])[0]
+            if is_floating(expr.args[0].type):
+                # Presto: sign(double) -> double (NaN propagates)
+                return (lambda datas, nulls: ((lambda d, n: (
+                    jnp.sign(d), n))(*f(datas, nulls)))), None
             return (lambda datas, nulls: ((lambda d, n: (
                 jnp.sign(d).astype(jnp.int64), n))(*f(datas, nulls)))), None
         if name in ("greatest", "least"):
@@ -789,13 +793,15 @@ class ExpressionCompiler:
                 if name == "subtract":
                     return a - b, n
                 if name == "modulus":
-                    return a % b, n
+                    # SQL mod: sign of the DIVIDEND (truncate toward zero)
+                    return jnp.sign(a) * (jnp.abs(a) % jnp.abs(b)), n
                 raise AssertionError(name)
             if out is DOUBLE or out is REAL:
                 a = ld.astype(jnp.float64) / (10 ** lscale) if lscale else ld.astype(jnp.float64)
                 b = rd.astype(jnp.float64) / (10 ** rscale) if rscale else rd.astype(jnp.float64)
                 d = {"add": a + b, "subtract": a - b, "multiply": a * b,
-                     "divide": a / b, "modulus": a % b}[name]
+                     "divide": a / b,
+                     "modulus": jnp.sign(a) * (jnp.abs(a) % jnp.abs(b))}[name]
                 return d, n
             # integral
             a, b = ld, rd
@@ -804,8 +810,10 @@ class ExpressionCompiler:
                 # SQL semantics: truncate toward zero (python // floors)
                 d = jnp.where((a % b != 0) & ((a < 0) ^ (b < 0)), d + 1, d)
                 return d.astype(out.np_dtype), n
-            d = {"add": a + b, "subtract": a - b, "multiply": a * b,
-                 "modulus": lambda: a % b}[name] if name != "modulus" else a % b
+            if name == "modulus":
+                d = jnp.sign(a) * (jnp.abs(a) % jnp.abs(jnp.where(b == 0, 1, b)))
+            else:
+                d = {"add": a + b, "subtract": a - b, "multiply": a * b}[name]
             return jnp.asarray(d, dtype=out.np_dtype), n
         return fn
 

@@ -1,0 +1,86 @@
+"""Table writer operator: pipeline sink feeding a ConnectorPageSink.
+
+Analogue of operator/TableWriterOperator.java (+ TableFinishOperator's commit
+step, which here happens in the runner after all writer drivers finish):
+pages stream into the connector sink; at finish the operator emits ONE row —
+the written-row count — exactly the wire shape INSERT/CTAS return."""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from ..block import Block, Page
+from ..spi.connector import ConnectorPageSink
+from ..types import BIGINT, Type
+from .operator import Operator, OperatorContext, OperatorFactory, timed
+
+
+class TableWriterOperator(Operator):
+    def __init__(self, context: OperatorContext, sink: ConnectorPageSink,
+                 remaps=None, column_dicts=None):
+        super().__init__(context)
+        self.sink = sink
+        # per-column dictionary-code remap arrays (None = pass through) and
+        # the TABLE's dictionaries to rebind blocks to — written pages must
+        # reference the table's (possibly extended) private dictionaries
+        self.remaps = remaps
+        self.column_dicts = column_dicts
+        self._rows = 0
+        self._emitted = False
+
+    @property
+    def output_types(self) -> List[Type]:
+        return [BIGINT]
+
+    @timed("add_input_ns")
+    def add_input(self, page: Page) -> None:
+        self.context.record_input(page, page.capacity)
+        self._rows += int(np.asarray(page.mask).sum())
+        if self.remaps is not None or self.column_dicts is not None:
+            blocks = []
+            for i, b in enumerate(page.blocks):
+                data = b.data
+                remap = self.remaps[i] if self.remaps else None
+                if remap is not None:
+                    codes = np.clip(np.asarray(data).astype(np.int64), 0,
+                                    len(remap) - 1)
+                    data = remap[codes]
+                d = self.column_dicts[i] if self.column_dicts else b.dictionary
+                blocks.append(Block(b.type, data, b.nulls, d))
+            page = Page(tuple(blocks), page.mask)
+        self.sink.append_page(page)
+
+    @timed("get_output_ns")
+    def get_output(self) -> Optional[Page]:
+        if self._finishing and not self._emitted:
+            self._emitted = True
+            out = Page((Block(BIGINT, np.asarray([self._rows],
+                                                 dtype=np.int64)),),
+                       np.ones(1, dtype=bool))
+            self.context.record_output(out, 1)
+            return out
+        return None
+
+    def is_finished(self) -> bool:
+        return self._finishing and self._emitted
+
+
+class TableWriterOperatorFactory(OperatorFactory):
+    """One sink per worker (the runner collects every sink's fragments for the
+    metadata commit — TableFinishOperator's role)."""
+
+    def __init__(self, operator_id: int, sink_provider, insert_handle,
+                 remaps=None, column_dicts=None):
+        super().__init__(operator_id, "TableWriter")
+        self._provider = sink_provider
+        self._handle = insert_handle
+        self._remaps = remaps
+        self._column_dicts = column_dicts
+        self.sinks: List[ConnectorPageSink] = []
+
+    def create_operator(self, worker: int = 0) -> TableWriterOperator:
+        sink = self._provider.create_page_sink(self._handle)
+        self.sinks.append(sink)
+        return TableWriterOperator(self.context(worker), sink,
+                                   self._remaps, self._column_dicts)

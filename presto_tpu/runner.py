@@ -18,6 +18,7 @@ from .sql import tree as t
 from .sql.parser import SqlParser
 from .sql.planner.optimizer import optimize
 from .sql.planner.plan import OutputNode, plan_to_text
+from .types import BIGINT
 from .sql.planner.planner import LogicalPlanner
 
 
@@ -39,6 +40,10 @@ class LocalQueryRunner:
             catalogs.register("tpch", TpchConnector("tpch"))
             from .connectors.tpcds import TpcdsConnector
             catalogs.register("tpcds", TpcdsConnector("tpcds"))
+            from .connectors.memory import MemoryConnector
+            catalogs.register("memory", MemoryConnector("memory"))
+            from .connectors.blackhole import BlackholeConnector
+            catalogs.register("blackhole", BlackholeConnector("blackhole"))
         self.catalogs = catalogs
         self.metadata = MetadataManager(catalogs)
         self.session = session or Session(catalog="tpch", schema="tiny")
@@ -91,6 +96,8 @@ class LocalQueryRunner:
             meta = self.metadata.get_table_metadata(handle)
             return QueryResult([[c.name, c.type.name] for c in meta.columns],
                                ["Column", "Type"])
+        if isinstance(stmt, (t.CreateTableAsSelect, t.Insert, t.DropTable)):
+            return self._execute_write(stmt)
         if not isinstance(stmt, t.Query):
             raise ValueError(f"unsupported statement {type(stmt).__name__}")
 
@@ -98,6 +105,134 @@ class LocalQueryRunner:
         exec_plan, _drivers, _wall = self._run_plan(plan)
         return QueryResult(exec_plan.sink.rows(), exec_plan.output_names,
                            exec_plan.output_types)
+
+    def _execute_write(self, stmt) -> QueryResult:
+        """CTAS / INSERT / DROP: plan the source query, swap the result sink
+        for TableWriter operators feeding the connector's page sink, commit
+        the written fragments (TableWriterOperator + TableFinishOperator
+        flow, with the commit in the coordinator as the reference does)."""
+        from .ops.writer import TableWriterOperatorFactory
+        from .spi.connector import ColumnMetadata, SchemaTableName, TableMetadata
+        from .utils.testing import PageConsumerFactory
+
+        qname = self.metadata.resolve_table_name(
+            self.session, tuple(p.lower() for p in stmt.name))
+        conn = self.metadata.connector(qname.catalog)
+        meta = conn.metadata()
+        name = SchemaTableName(qname.schema, qname.table)
+        handle = meta.get_table_handle(name)
+
+        if isinstance(stmt, t.DropTable):
+            if handle is None:
+                if stmt.exists_ok:
+                    return QueryResult([[0]], ["rows"], [BIGINT])
+                raise ValueError(f"table {qname} does not exist")
+            meta.drop_table(handle)
+            return QueryResult([[0]], ["rows"], [BIGINT])
+
+        # source plan first: its physical output schema defines/validates the
+        # target columns
+        plan = self.plan_statement(stmt.query)
+        local = LocalExecutionPlanner(self.metadata, self.session)
+        local.attach_memory(*self._query_memory())
+        exec_plan = local.plan(plan)
+
+        created = False
+        if isinstance(stmt, t.CreateTableAsSelect):
+            if handle is not None:
+                if stmt.not_exists:
+                    return QueryResult([[0]], ["rows"], [BIGINT])
+                raise ValueError(f"table {qname} already exists")
+            if len(set(exec_plan.output_names)) != len(exec_plan.output_names):
+                raise ValueError(
+                    f"CTAS output has duplicate column names: "
+                    f"{exec_plan.output_names}")
+            # materialized dictionaries are COPIED so the table owns them:
+            # later INSERTs can extend a private dictionary but must never
+            # mutate one shared with a source connector
+            from .block import Dictionary as _Dict
+            cols = tuple(
+                ColumnMetadata(n, tt, dictionary=(
+                    _Dict(list(d.values)) if d is not None and
+                    hasattr(d, "values") else d))
+                for n, tt, d in zip(exec_plan.output_names,
+                                    exec_plan.output_types,
+                                    exec_plan.output_dicts))
+            meta.create_table(TableMetadata(name, cols))
+            handle = meta.get_table_handle(name)
+            created = True
+        else:  # INSERT
+            if handle is None:
+                raise ValueError(f"table {qname} does not exist")
+            target = meta.get_table_metadata(handle)
+            tcols = [c for c in target.columns]
+            if stmt.columns and list(stmt.columns) != [c.name for c in tcols]:
+                raise ValueError("INSERT column list must match the table "
+                                 "schema (partial inserts not supported)")
+            if len(tcols) != len(exec_plan.output_types):
+                raise ValueError(
+                    f"INSERT has {len(exec_plan.output_types)} columns, "
+                    f"table {qname} has {len(tcols)}")
+            remaps: List[Optional[object]] = []
+            for c, st, sd in zip(tcols, exec_plan.output_types,
+                                 exec_plan.output_dicts):
+                if c.type.name != st.name:
+                    raise ValueError(
+                        f"INSERT type mismatch on {c.name}: {st.name} vs "
+                        f"{c.type.name}")
+                if c.dictionary is None or sd is c.dictionary:
+                    remaps.append(None)
+                    continue
+                # re-encode source codes into the table's private dictionary,
+                # extending it for values it has not seen
+                if sd is None or not hasattr(sd, "values") or \
+                        not hasattr(c.dictionary, "values"):
+                    raise ValueError(
+                        f"INSERT into dictionary column {c.name} requires "
+                        "materialized dictionaries on both sides")
+                import numpy as _np
+                tgt = c.dictionary
+                pos = {v: i for i, v in enumerate(tgt.values)}
+                new_vals = list(tgt.values)
+                mapping = []
+                for v in sd.values:
+                    if v not in pos:
+                        pos[v] = len(new_vals)
+                        new_vals.append(v)
+                    mapping.append(pos[v])
+                if len(new_vals) != len(tgt.values):
+                    tgt.values = _np.asarray(new_vals, dtype=object)
+                    tgt._index = None  # invalidate the cached reverse index
+                remaps.append(_np.asarray(mapping, dtype=_np.int32))
+
+        sink_provider = conn.page_sink_provider()
+        if sink_provider is None:
+            raise ValueError(f"catalog {qname.catalog} is not writable")
+        insert_handle = meta.begin_insert(handle)
+        target_meta = meta.get_table_metadata(handle)
+        column_dicts = [c.dictionary for c in target_meta.columns]
+        writer_fac = TableWriterOperatorFactory(
+            9000, sink_provider, insert_handle,
+            remaps=remaps if isinstance(stmt, t.Insert) else None,
+            column_dicts=column_dicts)
+        count_sink = PageConsumerFactory(9001, [BIGINT])
+        # swap the result consumer for writer -> row-count consumer
+        exec_plan.pipelines[-1] = exec_plan.pipelines[-1][:-1] + \
+            [writer_fac, count_sink]
+        drivers = exec_plan.create_drivers()
+        try:
+            TaskExecutor(
+                int(self.session.get("task_concurrency"))).execute(drivers)
+        except BaseException:
+            for s in writer_fac.sinks:
+                s.abort()
+            if created:  # CTAS is atomic: roll the metadata back on failure
+                meta.drop_table(handle)
+            raise
+        fragments = [p for s in writer_fac.sinks for p in s.finish()]
+        meta.finish_insert(insert_handle, fragments)
+        total = sum(r[0] for r in count_sink.rows())
+        return QueryResult([[total]], ["rows"], [BIGINT])
 
     def _run_plan(self, plan: OutputNode):
         """Shared execution recipe: local planning + memory wiring + task

@@ -194,6 +194,9 @@ class ConnectorMetadata(abc.ABC):
     def create_table(self, metadata: TableMetadata) -> None:
         raise NotImplementedError(f"{type(self).__name__} does not support CREATE TABLE")
 
+    def drop_table(self, table: TableHandle) -> None:
+        raise NotImplementedError(f"{type(self).__name__} does not support DROP TABLE")
+
 
 class ConnectorSplitManager(abc.ABC):
     """spi/connector/ConnectorSplitManager."""

@@ -478,7 +478,8 @@ class ExpressionTranslator:
         if name == "mod":
             return Call(common_type(args[0].type, args[1].type), "modulus", args)
         if name == "sign":
-            return Call(BIGINT, "sign", args)
+            out_t = DOUBLE if is_floating(args[0].type) else BIGINT
+            return Call(out_t, "sign", args)
         if name == "pi":
             return Constant(DOUBLE, math.pi)
         if name in ("greatest", "least"):

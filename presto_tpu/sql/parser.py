@@ -39,7 +39,7 @@ KEYWORDS = {
     "intersect", "except", "with", "values", "asc", "desc", "nulls", "first",
     "last", "explain", "analyze", "show", "tables", "schemas", "columns", "session",
     "set", "create", "table", "row", "unnest", "ordinality", "coalesce", "filter",
-    "substring", "for", "count", "exists",
+    "substring", "for", "count", "exists", "insert", "into", "drop",
     "over", "partition", "rows", "range", "unbounded", "preceding", "current",
     "following",
 }
@@ -205,7 +205,65 @@ class _Parser:
             return self.parse_show()
         if self.at_kw("set"):
             return self.parse_set_session()
+        if self.at_kw("create"):
+            return self.parse_create()
+        if self.at_kw("insert"):
+            return self.parse_insert()
+        if self.at_kw("drop"):
+            return self.parse_drop()
         return self.parse_query()
+
+    def parse_create(self) -> t.Statement:
+        self.expect_kw("create")
+        self.expect_kw("table")
+        # IF NOT EXISTS ("if" stays a plain identifier so if(c,a,b) keeps
+        # working, and a table actually NAMED if is disambiguated by lookahead)
+        not_exists = False
+        if self.peek().kind == "ident" and self.peek().text.lower() == "if" \
+                and self.peek(1).kind == "kw:not":
+            self.next()
+            self.expect_kw("not")
+            self.expect_kw("exists")
+            not_exists = True
+        name = self.parse_qualified_name()
+        if self.accept_kw("as"):
+            return t.CreateTableAsSelect(name, self.parse_query(),
+                                         not_exists=not_exists)
+        self.error("only CREATE TABLE ... AS SELECT is supported")
+
+    def parse_insert(self) -> t.Statement:
+        self.expect_kw("insert")
+        self.expect_kw("into")
+        name = self.parse_qualified_name()
+        columns: Tuple[str, ...] = ()
+        if self.at_op("("):
+            # lookahead: a '(' here could open a column list OR the query body
+            save = self.i
+            self.next()
+            if (self.peek().kind == "ident" or
+                    (self.peek().kind.startswith("kw:") and
+                     self.peek().kind[3:] not in RESERVED)):
+                cols = [self.expect_ident().lower()]
+                while self.accept_op(","):
+                    cols.append(self.expect_ident().lower())
+                if self.accept_op(")"):
+                    columns = tuple(cols)
+                else:
+                    self.i = save
+            else:
+                self.i = save
+        return t.Insert(name, columns, self.parse_query())
+
+    def parse_drop(self) -> t.Statement:
+        self.expect_kw("drop")
+        self.expect_kw("table")
+        exists_ok = False
+        if self.peek().kind == "ident" and self.peek().text.lower() == "if" \
+                and self.peek(1).kind == "kw:exists":
+            self.next()
+            self.expect_kw("exists")
+            exists_ok = True
+        return t.DropTable(self.parse_qualified_name(), exists_ok=exists_ok)
 
     def parse_explain(self) -> t.Explain:
         self.expect_kw("explain")

@@ -400,3 +400,17 @@ class SetSession(Statement):
 class CreateTableAsSelect(Statement):
     name: Tuple[str, ...] = ()
     query: Optional[Query] = None
+    not_exists: bool = False
+
+
+@_dc
+class Insert(Statement):
+    name: Tuple[str, ...] = ()
+    columns: Tuple[str, ...] = ()  # () = positional over the table schema
+    query: Optional[Query] = None
+
+
+@_dc
+class DropTable(Statement):
+    name: Tuple[str, ...] = ()
+    exists_ok: bool = False
