@@ -70,6 +70,27 @@ class Dictionary:
         out[~mask] = None
         return out
 
+    def extend(self, values: Sequence[str]) -> List[int]:
+        """Codes for `values`, appending entries this dictionary lacks (used
+        by INSERT re-encoding into a table-private dictionary). Invalidates
+        the cached reverse index on growth."""
+        pos = self.index()
+        out = []
+        new_vals = None
+        for v in values:
+            code = pos.get(v)
+            if code is None:
+                if new_vals is None:
+                    new_vals = list(self.values)
+                code = len(new_vals)
+                new_vals.append(v)
+                pos[v] = code
+            out.append(code)
+        if new_vals is not None:
+            self.values = np.asarray(new_vals, dtype=object)
+            self._index = pos
+        return out
+
     # sort_keys: rank of each code in lexicographic order, for ORDER BY on varchar.
     def sort_keys(self) -> np.ndarray:
         order = np.argsort(self.values.astype(str), kind="stable")

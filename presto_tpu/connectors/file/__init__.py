@@ -1,0 +1,403 @@
+"""File connector: directory-backed tables in the PCOL columnar format.
+
+The engine's presto-hive analogue, radically narrowed: a catalog roots at a
+directory, `<base>/<schema>/<table>/*.pcol` are the table's files. Reads are
+native-mmap scans with header-stats SPLIT PRUNING (the ORC stripe-skipping
+pattern) plus libpcol range pre-filters; writes (CTAS/INSERT) produce new
+immutable pcol files — one per writer sink, the classic append-only layout.
+
+Dictionary handling: each table exposes ONE unioned dictionary per varchar
+column (built from all files' persisted dictionaries); per-file codes remap
+to it at scan time, so files written before a dictionary grew stay valid.
+Virtual dictionaries (formatted/packed source columns) are materialized for
+the codes actually written.
+"""
+from __future__ import annotations
+
+import ctypes
+import json
+import os
+import threading
+import uuid
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ...block import Block, Dictionary, Page
+from ...formats.pcol import PcolFile, write_pcol
+from ...spi.connector import (ColumnHandle, ColumnMetadata, ColumnStatistics,
+                              Connector, ConnectorMetadata,
+                              ConnectorPageSink, ConnectorPageSinkProvider,
+                              ConnectorPageSource, ConnectorPageSourceProvider,
+                              ConnectorSplitManager, Constraint,
+                              SchemaTableName, Split, TableHandle,
+                              TableMetadata, TableStatistics)
+
+
+class _TableInfo:
+    def __init__(self, metadata: TableMetadata, files: List[str],
+                 rows: int, signature):
+        self.metadata = metadata
+        self.files = files
+        self.rows = rows
+        self.signature = signature
+
+
+class FileMetadata(ConnectorMetadata):
+    def __init__(self, connector_id: str, base_dir: str):
+        self.connector_id = connector_id
+        self.base = base_dir
+        self._cache: Dict[SchemaTableName, _TableInfo] = {}
+        self._lock = threading.Lock()
+
+    # --------------------------------------------------------------- layout
+
+    def _table_dir(self, name: SchemaTableName) -> str:
+        return os.path.join(self.base, name.schema, name.table)
+
+    def list_schemas(self) -> List[str]:
+        if not os.path.isdir(self.base):
+            return []
+        return sorted(d for d in os.listdir(self.base)
+                      if os.path.isdir(os.path.join(self.base, d)))
+
+    def list_tables(self, schema: Optional[str] = None) -> List[SchemaTableName]:
+        out = []
+        for s in ([schema] if schema else self.list_schemas()):
+            sdir = os.path.join(self.base, s)
+            if not os.path.isdir(sdir):
+                continue
+            for t in sorted(os.listdir(sdir)):
+                if os.path.isdir(os.path.join(sdir, t)):
+                    out.append(SchemaTableName(s, t))
+        return out
+
+    def _files_of(self, name: SchemaTableName) -> List[str]:
+        d = self._table_dir(name)
+        if not os.path.isdir(d):
+            return []
+        return sorted(os.path.join(d, f) for f in os.listdir(d)
+                      if f.endswith(".pcol"))
+
+    def _load(self, name: SchemaTableName) -> Optional[_TableInfo]:
+        files = self._files_of(name)
+        if not files:
+            return None
+        sig = tuple((f, os.path.getmtime(f)) for f in files)
+        with self._lock:
+            cached = self._cache.get(name)
+            if cached is not None and cached.signature == sig:
+                return cached
+        headers = []
+        rows = 0
+        for f in files:
+            pf = PcolFile(f)
+            headers.append(pf.header)
+            rows += pf.rows
+            pf.close()
+        # schema from the first file; dictionaries UNION across files so
+        # every file's codes can remap into one table-wide dictionary
+        from ...formats.pcol import _type_from_tag
+        cols = []
+        for e in headers[0]["columns"]:
+            d = None
+            if "dict" in e:
+                seen = {}
+                values: List[str] = []
+                for h in headers:
+                    he = next(c for c in h["columns"] if c["name"] == e["name"])
+                    for v in he.get("dict", []):
+                        if v not in seen:
+                            seen[v] = len(values)
+                            values.append(v)
+                d = Dictionary(values)
+            cols.append(ColumnMetadata(
+                e["name"], _type_from_tag(e["type"], e["scale"]),
+                dictionary=d))
+        info = _TableInfo(TableMetadata(name, tuple(cols)), files, rows, sig)
+        with self._lock:
+            self._cache[name] = info
+        return info
+
+    # ------------------------------------------------------------------ spi
+
+    def get_table_handle(self, name: SchemaTableName) -> Optional[TableHandle]:
+        if self._files_of(name):
+            return TableHandle(self.connector_id, name)
+        return None
+
+    def get_table_metadata(self, table: TableHandle) -> TableMetadata:
+        return self._load(table.schema_table).metadata
+
+    def get_table_statistics(self, table: TableHandle,
+                             constraint: Constraint) -> TableStatistics:
+        info = self._load(table.schema_table)
+        return TableStatistics(row_count=float(info.rows) if info else 0.0)
+
+    def table_info(self, table: TableHandle) -> _TableInfo:
+        return self._load(table.schema_table)
+
+    # ---------------------------------------------------------------- writes
+
+    def create_table(self, metadata: TableMetadata) -> None:
+        d = self._table_dir(metadata.name)
+        if self._files_of(metadata.name):
+            raise ValueError(f"table {metadata.name} already exists")
+        os.makedirs(d, exist_ok=True)
+        # an empty seed file pins the schema on disk; virtual dictionaries
+        # seed empty (data files carry their own materialized dictionaries,
+        # unioned at load)
+        names = [c.name for c in metadata.columns]
+        types = [c.type for c in metadata.columns]
+        dicts = [c.dictionary if c.dictionary is None or
+                 hasattr(c.dictionary, "values") else Dictionary([])
+                 for c in metadata.columns]
+        write_pcol(os.path.join(d, "00000000.pcol"), names, types, dicts, [])
+
+    def begin_insert(self, table: TableHandle):
+        return table
+
+    def finish_insert(self, handle, fragments) -> None:
+        with self._lock:
+            self._cache.pop(handle.schema_table, None)
+
+    def drop_table(self, table: TableHandle) -> None:
+        d = self._table_dir(table.schema_table)
+        for f in self._files_of(table.schema_table):
+            os.unlink(f)
+        try:
+            os.rmdir(d)
+        except OSError:
+            pass
+        with self._lock:
+            self._cache.pop(table.schema_table, None)
+
+
+class FileSplitManager(ConnectorSplitManager):
+    """One split per file, pruned by header min/max vs the pushed-down
+    constraint (the ORC stripe-statistics skip)."""
+
+    def __init__(self, connector_id: str, metadata: FileMetadata):
+        self.connector_id = connector_id
+        self._metadata = metadata
+
+    def get_splits(self, table: TableHandle, constraint: Constraint,
+                   desired_splits: int) -> List[Split]:
+        info = self._metadata.table_info(table)
+        splits = []
+        for b, f in enumerate(info.files):
+            pf = PcolFile(f)
+            keep = pf.rows > 0
+            if keep and constraint.domains:
+                for col, dom in constraint.domains.items():
+                    if col not in pf.columns:
+                        continue
+                    lo, hi = dom if isinstance(dom, tuple) else (None, None)
+                    mn, mx = pf.column_stats(col)
+                    if mn is None:
+                        continue
+                    if (hi is not None and mn > hi) or \
+                            (lo is not None and mx < lo):
+                        keep = False
+                        break
+            pf.close()
+            if keep:
+                splits.append(Split(self.connector_id,
+                                    payload=(table.schema_table, f),
+                                    bucket=b))
+        return splits  # [] = every file pruned: the scan yields no pages
+
+
+class FilePageSource(ConnectorPageSource):
+    def __init__(self, metadata: FileMetadata, split: Split,
+                 columns: Sequence[ColumnHandle], page_capacity: int,
+                 constraint: Constraint):
+        self._metadata = metadata
+        self.split = split
+        self.columns = list(columns)
+        self.capacity = page_capacity
+        self.constraint = constraint
+
+    def __iter__(self) -> Iterator[Page]:
+        name, path = self.split.payload
+        info = self._metadata._load(name)
+        table_dicts = {c.name: c.dictionary for c in info.metadata.columns}
+        pf = PcolFile(path)
+        try:
+            if pf.rows == 0:
+                return
+            prefilter = self._native_prefilter(pf)
+            names = [c.name for c in self.columns]
+            remap = {}
+            for n in names:
+                e = pf.columns[n]
+                td = table_dicts.get(n)
+                if "dict" in e and td is not None and \
+                        list(e["dict"]) != list(td.values):
+                    pos = {v: i for i, v in enumerate(td.values)}
+                    remap[n] = np.asarray([pos[v] for v in e["dict"]],
+                                          dtype=np.int32)
+            for lo in range(0, pf.rows, self.capacity):
+                hi = min(lo + self.capacity, pf.rows)
+                n_rows = hi - lo
+                blocks = []
+                for cname in names:
+                    data, nulls, _d = pf.read_column(cname)
+                    seg = np.array(data[lo:hi])
+                    if cname in remap:
+                        seg = remap[cname][np.clip(seg.astype(np.int32), 0,
+                                                   len(remap[cname]) - 1)]
+                    if n_rows < self.capacity:
+                        seg = np.concatenate(
+                            [seg, np.zeros(self.capacity - n_rows,
+                                           dtype=seg.dtype)])
+                    nseg = None
+                    if nulls is not None:
+                        nseg = np.zeros(self.capacity, dtype=bool)
+                        nseg[:n_rows] = nulls[lo:hi]
+                    tt = info.metadata.column(cname).type
+                    blocks.append(Block(tt, seg, nseg, table_dicts.get(cname)))
+                mask = np.arange(self.capacity) < n_rows
+                if prefilter is not None:
+                    mask = mask & np.pad(prefilter[lo:hi],
+                                         (0, self.capacity - n_rows))
+                yield Page(tuple(blocks), mask)
+        finally:
+            pf.close()
+
+    def _native_prefilter(self, pf: PcolFile) -> Optional[np.ndarray]:
+        """AND together pushed-down ranges via libpcol's native scan kernels
+        (skips rows before they ever reach the device)."""
+        if not self.constraint.domains:
+            return None
+        try:
+            from ...native import libpcol
+            lib = libpcol()
+        except Exception:
+            return None
+        mask: Optional[np.ndarray] = None
+        for col, dom in self.constraint.domains.items():
+            if col not in pf.columns:
+                continue
+            lo, hi = dom if isinstance(dom, tuple) else (None, None)
+            if lo is None and hi is None:
+                continue
+            data, nulls, _ = pf.read_column(col)
+            if data.dtype == np.int64:
+                fn = lib.pcol_filter_range_i64
+            elif data.dtype == np.int32:
+                fn = lib.pcol_filter_range_i32
+            else:
+                continue
+            if mask is None:
+                mask = np.ones(pf.rows, dtype=np.uint8)
+            c = np.ascontiguousarray(data)
+            fn(c.ctypes.data, len(c),
+               np.iinfo(np.int64).min if lo is None else int(lo),
+               np.iinfo(np.int64).max if hi is None else int(hi),
+               mask.ctypes.data)
+        return mask.astype(bool) if mask is not None else None
+
+
+class FilePageSourceProvider(ConnectorPageSourceProvider):
+    def __init__(self, metadata: FileMetadata):
+        self._metadata = metadata
+
+    def create_page_source(self, split: Split, columns: Sequence[ColumnHandle],
+                           page_capacity: int,
+                           constraint: Constraint = Constraint.all()
+                           ) -> ConnectorPageSource:
+        return FilePageSource(self._metadata, split, columns, page_capacity,
+                              constraint)
+
+
+class FilePageSink(ConnectorPageSink):
+    """Buffers host pages; finish() writes ONE immutable pcol file."""
+
+    def __init__(self, metadata: FileMetadata, table: TableHandle):
+        self._metadata = metadata
+        self._table = table
+        self._pages: List[Page] = []
+        self.rows_written = 0
+
+    def append_page(self, page: Page) -> None:
+        import jax
+
+        host = jax.device_get(page)
+        self._pages.append(host)
+        self.rows_written += int(np.asarray(host.mask).sum())
+
+    def finish(self):
+        if not self._pages:
+            return []
+        info = self._metadata.table_info(self._table)
+        names = [c.name for c in info.metadata.columns]
+        types = [c.type for c in info.metadata.columns]
+        dicts, pages = _materialize_dicts(self._pages)
+        d = self._metadata._table_dir(self._table.schema_table)
+        path = os.path.join(d, f"{uuid.uuid4().hex[:12]}.pcol")
+        write_pcol(path, names, types, dicts, pages)
+        return [path]
+
+
+def _materialize_dicts(pages):
+    """-> (per-column dictionaries, pages) ready to persist. Blocks carry
+    their own dictionaries; virtual ones (formatted/packed) cannot persist,
+    so the codes actually written decode to strings and re-encode through a
+    real Dictionary."""
+    ncols = len(pages[0].blocks)
+    out_dicts: List[Optional[Dictionary]] = []
+    out_pages = list(pages)
+    for ci in range(ncols):
+        d = pages[0].blocks[ci].dictionary
+        if d is None or hasattr(d, "values"):
+            out_dicts.append(d)
+            continue
+        codes = np.concatenate(
+            [np.asarray(p.blocks[ci].data)[np.asarray(p.mask)]
+             for p in pages]).astype(np.int64)
+        uniq = np.unique(codes)
+        strings = d.lookup(uniq)
+        new_d = Dictionary([str(s) for s in strings])
+        code_map = {int(c): i for i, c in enumerate(uniq)}
+        new_pages = []
+        for p in out_pages:
+            b = p.blocks[ci]
+            data = np.asarray(b.data).astype(np.int64)
+            mapped = np.asarray([code_map.get(int(x), 0) for x in data],
+                                dtype=np.int32)
+            blocks = list(p.blocks)
+            blocks[ci] = Block(b.type, mapped, b.nulls, new_d)
+            new_pages.append(Page(tuple(blocks), p.mask))
+        out_pages = new_pages
+        out_dicts.append(new_d)
+    return out_dicts, out_pages
+
+
+class FilePageSinkProvider(ConnectorPageSinkProvider):
+    def __init__(self, metadata: FileMetadata):
+        self._metadata = metadata
+
+    def create_page_sink(self, insert_handle) -> ConnectorPageSink:
+        return FilePageSink(self._metadata, insert_handle)
+
+
+class FileConnector(Connector):
+    def __init__(self, connector_id: str, base_dir: str):
+        os.makedirs(base_dir, exist_ok=True)
+        self._metadata = FileMetadata(connector_id, base_dir)
+        self._splits = FileSplitManager(connector_id, self._metadata)
+        self._sources = FilePageSourceProvider(self._metadata)
+        self._sinks = FilePageSinkProvider(self._metadata)
+
+    def metadata(self) -> ConnectorMetadata:
+        return self._metadata
+
+    def split_manager(self) -> ConnectorSplitManager:
+        return self._splits
+
+    def page_source_provider(self) -> ConnectorPageSourceProvider:
+        return self._sources
+
+    def page_sink_provider(self) -> Optional[ConnectorPageSinkProvider]:
+        return self._sinks

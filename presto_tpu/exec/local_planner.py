@@ -47,6 +47,61 @@ from ..utils.testing import PageConsumerFactory
 from ..exec.driver import Driver
 
 
+def _extract_constraint(filter_parts, scan: TableScanNode) -> Constraint:
+    """Scan-filter conjuncts -> per-column [lo, hi] domains (TupleDomain
+    extraction, narrowed to constant comparisons — what file/split pruning
+    needs). Values are the engine's substrate ints (scaled decimals, date
+    days, dictionary codes for equality on sorted dictionaries are NOT
+    extracted — only numeric columns)."""
+    from ..ops.expressions import Call, Constant, InputRef
+
+    col_of = {i: col.name for i, (_s, col) in enumerate(scan.assignments)}
+    domains: Dict[str, List] = {}
+
+    def note(ch: int, lo, hi):
+        name = col_of.get(ch)
+        if name is None:
+            return
+        cur = domains.setdefault(name, [None, None])
+        if lo is not None:
+            cur[0] = lo if cur[0] is None else max(cur[0], lo)
+        if hi is not None:
+            cur[1] = hi if cur[1] is None else min(cur[1], hi)
+
+    for part in filter_parts:
+        if not isinstance(part, Call) or len(part.args) != 2:
+            continue
+        a, b = part.args
+        if isinstance(a, Constant) and isinstance(b, InputRef):
+            flip = {"less_than": "greater_than",
+                    "less_than_or_equal": "greater_than_or_equal",
+                    "greater_than": "less_than",
+                    "greater_than_or_equal": "less_than_or_equal",
+                    "equal": "equal"}.get(part.name)
+            if flip is None:
+                continue
+            a, b, name = b, a, flip
+        elif isinstance(a, InputRef) and isinstance(b, Constant):
+            name = part.name
+        else:
+            continue
+        v = b.value
+        if v is None or isinstance(v, str):
+            continue
+        # the +-1 strict-bound tightening is only sound on integral
+        # substrates; float constants keep the inclusive bound (pruning must
+        # over-approximate, never drop satisfying files)
+        step = 1 if isinstance(v, int) else 0
+        if name == "equal":
+            note(a.channel, v, v)
+        elif name in ("less_than", "less_than_or_equal"):
+            note(a.channel, None, v - (step if name == "less_than" else 0))
+        elif name in ("greater_than", "greater_than_or_equal"):
+            note(a.channel, v + (step if name == "greater_than" else 0), None)
+    return Constraint({k: tuple(v) for k, v in domains.items()}) \
+        if domains else Constraint.all()
+
+
 class _ConcatPageSource(ConnectorPageSource):
     def __init__(self, sources):
         self.sources = list(sources)
@@ -214,7 +269,8 @@ class LocalExecutionPlanner:
         processor = PageProcessor(base.layout() if isinstance(base, Chain)
                                   else base, and_all(filter_parts), projections)
         if isinstance(cur, TableScanNode):
-            sources = self._page_sources(cur)
+            constraint = _extract_constraint(filter_parts, cur)
+            sources = self._page_sources(cur, constraint)
             fac = TableScanOperatorFactory(next(self._ids), sources,
                                            processor.output_types, processor)
             return Chain([fac], list(out_symbols), processor.output_dicts)
@@ -229,11 +285,15 @@ class LocalExecutionPlanner:
             dicts.append(meta.column(col.name).dictionary)
         return InputLayout([s.type for s, _ in node.assignments], dicts)
 
-    def _page_sources(self, node: TableScanNode):
+    def _page_sources(self, node: TableScanNode,
+                      constraint: Optional[Constraint] = None):
         """-> callable worker -> [page source]: splits dealt round-robin over
-        the fragment's workers, one concatenated source (= one driver) each."""
+        the fragment's workers, one concatenated source (= one driver) each.
+        `constraint` carries pushed-down column ranges so split managers can
+        prune (file stats, key ranges)."""
         conn = self.metadata.connector(node.table.connector_id)
-        splits = conn.split_manager().get_splits(node.table, Constraint.all(), 8)
+        constraint = constraint or Constraint.all()
+        splits = conn.split_manager().get_splits(node.table, constraint, 8)
         cols = [c for _, c in node.assignments]
         provider = conn.page_source_provider()
         count = self.n_workers
@@ -241,7 +301,8 @@ class LocalExecutionPlanner:
         def for_worker(w: int):
             mine = [s for i, s in enumerate(splits) if i % count == w]
             return [_ConcatPageSource(
-                provider.create_page_source(s, cols, self.page_capacity)
+                provider.create_page_source(s, cols, self.page_capacity,
+                                            constraint)
                 for s in mine)]
         return for_worker
 

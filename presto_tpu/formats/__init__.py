@@ -1,0 +1,1 @@
+"""Columnar file formats (the presto-orc / presto-parquet layer, TPU-native)."""

@@ -1,0 +1,231 @@
+"""PCOL: the engine's TPU-native columnar file format.
+
+Analogue of the reference's columnar formats (presto-orc 46k LoC,
+presto-parquet), re-designed for the TPU host path instead of ported:
+
+- column chunks are RAW little-endian arrays at 64-byte alignment — a scan
+  is mmap -> numpy view -> device DMA, with zero decode (the reference burns
+  worker CPU decompressing ORC streams; HBM-bound TPU pipelines want bytes,
+  not codecs);
+- dictionary varchar stores the code array + the dictionary values once —
+  the engine's native string representation round-trips losslessly;
+- a JSON header carries schema + chunk offsets + per-column min/max stats,
+  so split pruning reads ~1KB per file (the ORC stripe-footer pattern);
+- the data plane (mmap, stats, range pre-filters) is native C++ (libpcol),
+  falling back to numpy when no toolchain is available.
+
+Layout:  magic 'PCOL1\\n' | u32 header_len | header json | padded chunks...
+Header: {"rows": N, "columns": [{name, type, scale, dtype, offset, nbytes,
+         nulls_offset?, dict?: [values...], min?, max?}]}
+"""
+from __future__ import annotations
+
+import ctypes
+import json
+import os
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..block import Block, Dictionary, Page
+from ..types import (BIGINT, BOOLEAN, DATE, DecimalType, DOUBLE, INTEGER,
+                     Type, VARCHAR, WIDE_VARCHAR)
+
+MAGIC = b"PCOL1\n"
+_ALIGN = 64
+
+_TYPE_TAGS = {"bigint": BIGINT, "integer": INTEGER, "double": DOUBLE,
+              "boolean": BOOLEAN, "date": DATE, "varchar": VARCHAR,
+              "wide_varchar": WIDE_VARCHAR}
+
+
+def _type_tag(t: Type) -> Tuple[str, int]:
+    if isinstance(t, DecimalType):
+        return "decimal", t.scale
+    name = t.name
+    if name == "varchar" and getattr(t, "wide", False):
+        return "wide_varchar", 0
+    return name, 0
+
+
+def _type_from_tag(tag: str, scale: int) -> Type:
+    if tag == "decimal":
+        return DecimalType(18, scale)
+    return _TYPE_TAGS[tag]
+
+
+def _pad(n: int) -> int:
+    return (n + _ALIGN - 1) // _ALIGN * _ALIGN
+
+
+def _native_stats(arr: np.ndarray):
+    """Column min/max via libpcol when available (bandwidth-bound native
+    loop), else numpy."""
+    try:
+        from ..native import libpcol
+        lib = libpcol()
+    except Exception:
+        lib = None
+    if lib is not None and arr.dtype in (np.int64, np.int32, np.float64) \
+            and len(arr) > 0:
+        c = np.ascontiguousarray(arr)
+        if arr.dtype == np.float64:
+            mn, mx = ctypes.c_double(), ctypes.c_double()
+            lib.pcol_stats_f64(c.ctypes.data, len(c),
+                               ctypes.byref(mn), ctypes.byref(mx))
+        elif arr.dtype == np.int32:
+            mn, mx = ctypes.c_int64(), ctypes.c_int64()
+            lib.pcol_stats_i32(c.ctypes.data, len(c),
+                               ctypes.byref(mn), ctypes.byref(mx))
+        else:
+            mn, mx = ctypes.c_int64(), ctypes.c_int64()
+            lib.pcol_stats_i64(c.ctypes.data, len(c),
+                               ctypes.byref(mn), ctypes.byref(mx))
+        return mn.value, mx.value
+    if len(arr) == 0:
+        return None, None
+    return np.min(arr).item(), np.max(arr).item()
+
+
+def write_pcol(path: str, names: Sequence[str], types: Sequence[Type],
+               dicts: Sequence[Optional[Dictionary]],
+               pages: Sequence[Page]) -> int:
+    """Write pages (live rows compacted) as one pcol file; returns rows."""
+    ncols = len(names)
+    masks = [np.asarray(p.mask) for p in pages]
+    keeps = [np.flatnonzero(m) for m in masks]
+    total = int(sum(len(k) for k in keeps))
+
+    cols = []
+    for c in range(ncols):
+        datas = [np.asarray(p.blocks[c].data)[k]
+                 for p, k in zip(pages, keeps)]
+        data = np.concatenate(datas) if datas else \
+            np.zeros(0, dtype=types[c].np_dtype)
+        data = np.ascontiguousarray(data.astype(types[c].np_dtype,
+                                                copy=False))
+        nulls = None
+        if any(p.blocks[c].nulls is not None for p in pages):
+            nparts = [np.asarray(p.blocks[c].null_mask())[k]
+                      for p, k in zip(pages, keeps)]
+            nm = np.concatenate(nparts)
+            if nm.any():
+                nulls = np.ascontiguousarray(nm.astype(np.uint8))
+        cols.append((data, nulls))
+
+    # header with chunk offsets (two passes: size then write)
+    headers = []
+    offset = 0  # relative to the data section start
+    for c in range(ncols):
+        data, nulls = cols[c]
+        tag, scale = _type_tag(types[c])
+        entry: Dict = {"name": names[c], "type": tag, "scale": scale,
+                       "dtype": data.dtype.str, "offset": offset,
+                       "nbytes": int(data.nbytes)}
+        offset = _pad(offset + data.nbytes)
+        if nulls is not None:
+            entry["nulls_offset"] = offset
+            offset = _pad(offset + nulls.nbytes)
+        d = dicts[c]
+        if d is not None:
+            if not hasattr(d, "values"):
+                raise ValueError(
+                    f"column {names[c]}: virtual dictionaries cannot be "
+                    "persisted; decode before writing")
+            entry["dict"] = [str(v) for v in d.values]
+        mn, mx = _native_stats(data) if data.dtype.kind in "if" \
+            else (None, None)
+        if mn is not None:
+            entry["min"], entry["max"] = mn, mx
+        headers.append(entry)
+
+    header = json.dumps({"rows": total, "columns": headers}).encode()
+    with open(path, "wb") as f:
+        f.write(MAGIC)
+        f.write(np.uint32(len(header)).tobytes())
+        f.write(header)
+        data_start = _pad(f.tell())
+        f.write(b"\0" * (data_start - f.tell()))
+        for c in range(ncols):
+            data, nulls = cols[c]
+            f.write(data.tobytes())
+            f.write(b"\0" * (_pad(data.nbytes) - data.nbytes))
+            if nulls is not None:
+                f.write(nulls.tobytes())
+                f.write(b"\0" * (_pad(nulls.nbytes) - nulls.nbytes))
+    return total
+
+
+class PcolFile:
+    """Reader: native mmap when available, else a host read."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._map = None
+        self._lib = None
+        try:
+            from ..native import libpcol
+            self._lib = libpcol()
+            self._map = self._lib.pcol_open(path.encode())
+            if not self._map:
+                self._lib = None
+        except Exception:
+            self._lib = None
+        if self._lib is not None:
+            length = self._lib.pcol_length(self._map)
+            base = self._lib.pcol_data(self._map)
+            self._buf = np.ctypeslib.as_array(base, shape=(length,))
+        else:
+            self._buf = np.fromfile(path, dtype=np.uint8)
+        assert bytes(self._buf[:6]) == MAGIC, f"{path}: not a pcol file"
+        hlen = int(np.frombuffer(self._buf[6:10], dtype=np.uint32)[0])
+        self.header = json.loads(bytes(self._buf[10:10 + hlen]))
+        self.rows = self.header["rows"]
+        self._data_start = _pad(10 + hlen)
+        self.columns = {e["name"]: e for e in self.header["columns"]}
+
+    def close(self) -> None:
+        if self._lib is not None and self._map:
+            self._lib.pcol_close(self._map)
+            self._map = None
+            self._lib = None
+
+    def column_stats(self, name: str):
+        e = self.columns[name]
+        return e.get("min"), e.get("max")
+
+    def read_column(self, name: str):
+        """-> (data view, null mask or None, Dictionary or None). Zero-copy
+        views into the mapping."""
+        e = self.columns[name]
+        lo = self._data_start + e["offset"]
+        data = self._buf[lo: lo + e["nbytes"]].view(np.dtype(e["dtype"]))
+        nulls = None
+        if "nulls_offset" in e:
+            nlo = self._data_start + e["nulls_offset"]
+            nulls = self._buf[nlo: nlo + self.rows].view(np.uint8) \
+                .astype(bool)
+        d = Dictionary(e["dict"]) if "dict" in e else None
+        return data, nulls, d
+
+    def pages(self, names: Sequence[str], page_capacity: int):
+        """Yield fixed-capacity pages over the selected columns."""
+        cols = [self.read_column(n) for n in names]
+        types = [_type_from_tag(self.columns[n]["type"],
+                                self.columns[n]["scale"]) for n in names]
+        for lo in range(0, max(self.rows, 1), page_capacity):
+            hi = min(lo + page_capacity, self.rows)
+            n = hi - lo
+            blocks = []
+            for (data, nulls, d), tt in zip(cols, types):
+                seg = np.zeros(page_capacity, dtype=data.dtype)
+                seg[:n] = data[lo:hi]
+                nseg = None
+                if nulls is not None:
+                    nseg = np.zeros(page_capacity, dtype=bool)
+                    nseg[:n] = nulls[lo:hi]
+                blocks.append(Block(tt, seg, nseg, d))
+            mask = np.arange(page_capacity) < n
+            yield Page(tuple(blocks), mask)
+            if self.rows == 0:
+                break

@@ -1,0 +1,73 @@
+"""Native runtime components (C++): lazy-built, ctypes-bound.
+
+The engine's compute path is JAX/XLA; the IO/runtime ring around it is native
+where the reference's is (presto-orc's decode loops, the airlift buffer
+stack). `libpcol` owns the columnar-file data plane — mmap, write-time
+statistics, range pre-filters — all running at memory bandwidth outside the
+GIL."""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "pcol.cpp")
+_BUILD_DIR = os.path.join(_HERE, "build")
+_SO = os.path.join(_BUILD_DIR, "libpcol.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+
+
+def _build() -> str:
+    os.makedirs(_BUILD_DIR, exist_ok=True)
+    if os.path.exists(_SO) and \
+            os.path.getmtime(_SO) >= os.path.getmtime(_SRC):
+        return _SO
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", _SRC, "-o", _SO]
+    subprocess.run(cmd, check=True, capture_output=True)
+    return _SO
+
+
+def libpcol() -> ctypes.CDLL:
+    """Load (building if needed) the native library; raises on toolchain
+    failure — callers fall back to the pure-numpy path."""
+    global _lib
+    with _lock:
+        if _lib is None:
+            lib = ctypes.CDLL(_build())
+            lib.pcol_open.restype = ctypes.c_void_p
+            lib.pcol_open.argtypes = [ctypes.c_char_p]
+            lib.pcol_length.restype = ctypes.c_uint64
+            lib.pcol_length.argtypes = [ctypes.c_void_p]
+            lib.pcol_data.restype = ctypes.POINTER(ctypes.c_uint8)
+            lib.pcol_data.argtypes = [ctypes.c_void_p]
+            lib.pcol_close.argtypes = [ctypes.c_void_p]
+            lib.pcol_stats_i64.argtypes = [
+                ctypes.c_void_p, ctypes.c_uint64,
+                ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64)]
+            lib.pcol_stats_i32.argtypes = lib.pcol_stats_i64.argtypes
+            lib.pcol_stats_f64.argtypes = [
+                ctypes.c_void_p, ctypes.c_uint64,
+                ctypes.POINTER(ctypes.c_double),
+                ctypes.POINTER(ctypes.c_double)]
+            lib.pcol_filter_range_i64.restype = ctypes.c_uint64
+            lib.pcol_filter_range_i64.argtypes = [
+                ctypes.c_void_p, ctypes.c_uint64, ctypes.c_int64,
+                ctypes.c_int64, ctypes.c_void_p]
+            lib.pcol_filter_range_i32.restype = ctypes.c_uint64
+            lib.pcol_filter_range_i32.argtypes = \
+                lib.pcol_filter_range_i64.argtypes
+            _lib = lib
+    return _lib
+
+
+def native_available() -> bool:
+    try:
+        libpcol()
+        return True
+    except Exception:
+        return False

@@ -39,10 +39,15 @@ class TableWriterOperator(Operator):
         self._rows += int(np.asarray(page.mask).sum())
         if self.remaps is not None or self.column_dicts is not None:
             blocks = []
+            mask_np = np.asarray(page.mask)
             for i, b in enumerate(page.blocks):
                 data = b.data
                 remap = self.remaps[i] if self.remaps else None
-                if remap is not None:
+                if callable(remap):  # virtual-source value-level re-encode
+                    live = mask_np if b.nulls is None else \
+                        (mask_np & ~np.asarray(b.nulls))
+                    data = remap(np.asarray(data), live)
+                elif remap is not None:
                     codes = np.clip(np.asarray(data).astype(np.int64), 0,
                                     len(remap) - 1)
                     data = remap[codes]

@@ -22,6 +22,33 @@ from .types import BIGINT
 from .sql.planner.planner import LogicalPlanner
 
 
+def _virtual_remap(source_dict, target_dict):
+    """-> callable(codes, live) -> int32 codes in target_dict's space,
+    decoding the virtual source per batch and extending the target for unseen
+    values. Only LIVE lanes decode: masked/null lanes carry stale codes that
+    must never pollute the dictionary. One lock: writer drivers may run
+    concurrently under the task executor."""
+    import threading
+
+    import numpy as np
+
+    lock = threading.Lock()
+
+    def remap(codes: "np.ndarray", live: "np.ndarray") -> "np.ndarray":
+        codes = np.asarray(codes, dtype=np.int64)
+        out = np.zeros(len(codes), dtype=np.int32)
+        sel = np.flatnonzero(np.asarray(live))
+        if len(sel) == 0:
+            return out
+        uniq, inverse = np.unique(codes[sel], return_inverse=True)
+        strings = [str(v) for v in source_dict.lookup(uniq)]
+        with lock:
+            mapped = np.asarray(target_dict.extend(strings), dtype=np.int32)
+        out[sel] = mapped[inverse]
+        return out
+    return remap
+
+
 @dataclasses.dataclass
 class QueryResult:
     rows: List[list]
@@ -185,36 +212,35 @@ class LocalQueryRunner:
                     continue
                 # re-encode source codes into the table's private dictionary,
                 # extending it for values it has not seen
-                if sd is None or not hasattr(sd, "values") or \
-                        not hasattr(c.dictionary, "values"):
+                if sd is None or not hasattr(c.dictionary, "values"):
                     raise ValueError(
-                        f"INSERT into dictionary column {c.name} requires "
-                        "materialized dictionaries on both sides")
+                        f"INSERT into dictionary column {c.name} requires a "
+                        "materialized target dictionary")
                 import numpy as _np
                 tgt = c.dictionary
-                pos = {v: i for i, v in enumerate(tgt.values)}
-                new_vals = list(tgt.values)
-                mapping = []
-                for v in sd.values:
-                    if v not in pos:
-                        pos[v] = len(new_vals)
-                        new_vals.append(v)
-                    mapping.append(pos[v])
-                if len(new_vals) != len(tgt.values):
-                    tgt.values = _np.asarray(new_vals, dtype=object)
-                    tgt._index = None  # invalidate the cached reverse index
-                remaps.append(_np.asarray(mapping, dtype=_np.int32))
+                if not hasattr(sd, "values"):
+                    # virtual source (formatted/packed): value-level re-encode
+                    remaps.append(_virtual_remap(sd, tgt))
+                    continue
+                remaps.append(_np.asarray(
+                    tgt.extend([str(v) for v in sd.values]), dtype=_np.int32))
 
         sink_provider = conn.page_sink_provider()
         if sink_provider is None:
             raise ValueError(f"catalog {qname.catalog} is not writable")
         insert_handle = meta.begin_insert(handle)
-        target_meta = meta.get_table_metadata(handle)
-        column_dicts = [c.dictionary for c in target_meta.columns]
-        writer_fac = TableWriterOperatorFactory(
-            9000, sink_provider, insert_handle,
-            remaps=remaps if isinstance(stmt, t.Insert) else None,
-            column_dicts=column_dicts)
+        if isinstance(stmt, t.Insert) and any(r is not None for r in remaps):
+            # INSERT re-encodes into the table's dictionaries; CTAS pages keep
+            # their source dictionaries (codes match the copies by construction,
+            # and file sinks materialize virtual dictionaries from the blocks)
+            target_meta = meta.get_table_metadata(handle)
+            column_dicts = [c.dictionary for c in target_meta.columns]
+            writer_fac = TableWriterOperatorFactory(
+                9000, sink_provider, insert_handle,
+                remaps=remaps, column_dicts=column_dicts)
+        else:
+            writer_fac = TableWriterOperatorFactory(9000, sink_provider,
+                                                    insert_handle)
         count_sink = PageConsumerFactory(9001, [BIGINT])
         # swap the result consumer for writer -> row-count consumer
         exec_plan.pipelines[-1] = exec_plan.pipelines[-1][:-1] + \
