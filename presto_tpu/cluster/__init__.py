@@ -1,0 +1,23 @@
+"""Multi-process cluster tier: coordinator + worker servers over HTTP (DCN).
+
+This package is the engine's analogue of the reference's distributed runtime
+(layers 5/6/8/9 of SURVEY.md §1): discovery + heartbeat failure detection,
+node/stage scheduling, remote tasks, worker task management, partitioned
+output buffers with token-acked page pull, and the page wire format.
+
+Division of labor with the SPMD tier (presto_tpu/parallel/): inside one host's
+TPU slice, fragments execute as shard_map collectives over ICI; ACROSS hosts,
+this package ships serialized page frames over HTTP — the reference's
+HTTP+LZ4 data plane (operator/ExchangeClient.java) mapped onto the DCN tier,
+where XLA collectives are not available."""
+__all__ = ["ClusterQueryRunner", "WorkerServer"]
+
+
+def __getattr__(name):  # lazy: `python -m presto_tpu.cluster.worker` must not
+    if name == "ClusterQueryRunner":          # re-import its own module
+        from .coordinator import ClusterQueryRunner
+        return ClusterQueryRunner
+    if name == "WorkerServer":
+        from .worker import WorkerServer
+        return WorkerServer
+    raise AttributeError(name)

@@ -1,0 +1,164 @@
+"""Task output buffers: the producer half of the cross-host shuffle.
+
+Analogue of execution/buffer/ (PartitionedOutputBuffer / BroadcastOutputBuffer
+/ ClientBuffer, /root/reference/presto-main): each task owns one OutputBuffer
+with a ClientBuffer per consumer; consumers pull serialized page frames with a
+monotonically increasing token — requesting token T acknowledges (frees) every
+frame below T, re-requesting T is idempotent (ClientBuffer's token protocol,
+server/TaskResource.java:245-318).
+
+Backpressure: the buffer bounds retained bytes; enqueue blocks the producing
+driver thread until a consumer drains (the reference blocks the task's output
+future the same way)."""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Tuple
+
+PARTITIONED = "PARTITIONED"
+BROADCAST = "BROADCAST"
+GATHER = "GATHER"          # single consumer buffer (TaskOutputOperator case)
+
+
+class ClientBuffer:
+    """One consumer's frame queue with token acks."""
+
+    def __init__(self, lock: threading.Condition):
+        self._cv = lock
+        self._frames: List[Tuple[int, bytes]] = []  # (token, frame)
+        self._next_token = 0
+        self._no_more = False
+        self._aborted = False
+
+    # producer side (caller holds the cv lock via OutputBuffer)
+    def enqueue_locked(self, frame: bytes) -> int:
+        token = self._next_token
+        self._frames.append((token, frame))
+        self._next_token += 1
+        return len(frame)
+
+    def set_no_more_locked(self) -> None:
+        self._no_more = True
+
+    def abort_locked(self) -> int:
+        freed = sum(len(f) for _, f in self._frames)
+        self._frames.clear()
+        self._aborted = True
+        self._no_more = True
+        return freed
+
+    # consumer side
+    def ack_locked(self, token: int) -> int:
+        """Drop frames below `token`; returns bytes freed."""
+        freed = 0
+        while self._frames and self._frames[0][0] < token:
+            freed += len(self._frames[0][1])
+            self._frames.pop(0)
+        return freed
+
+    def get_locked(self, token: int) -> Tuple[Optional[bytes], int, bool]:
+        """-> (frame|None, next_token, complete). Caller holds lock."""
+        for tok, frame in self._frames:
+            if tok == token:
+                return frame, token + 1, False
+        complete = (self._no_more and
+                    (not self._frames or self._frames[-1][0] < token))
+        return None, token, complete
+
+
+class OutputBuffer:
+    """Per-task output: `n_buffers` client buffers of serialized frames."""
+
+    def __init__(self, kind: str, n_buffers: int,
+                 max_bytes: int = 64 << 20):
+        assert kind in (PARTITIONED, BROADCAST, GATHER)
+        self.kind = kind
+        self.n_buffers = n_buffers if kind != GATHER else 1
+        self._cv = threading.Condition()
+        self._buffers = [ClientBuffer(self._cv) for _ in range(self.n_buffers)]
+        self._bytes = 0
+        self._max_bytes = max_bytes
+        self._no_more = False
+        self._failed: Optional[str] = None
+
+    # ------------------------------------------------------------- producer
+
+    def enqueue(self, buffer_id: int, frame: bytes,
+                timeout_s: float = 300.0) -> None:
+        """Blocks while the buffer is over its byte bound (backpressure)."""
+        with self._cv:
+            deadline = None
+            while self._bytes + len(frame) > self._max_bytes and self._bytes:
+                if self._failed:
+                    raise RuntimeError(f"output buffer failed: {self._failed}")
+                import time as _t
+                if deadline is None:
+                    deadline = _t.monotonic() + timeout_s
+                if not self._cv.wait(timeout=1.0) and _t.monotonic() > deadline:
+                    raise TimeoutError("output buffer backpressure timeout")
+            if self._failed:
+                raise RuntimeError(f"output buffer failed: {self._failed}")
+            self._bytes += self._buffers[buffer_id].enqueue_locked(frame)
+            self._cv.notify_all()
+
+    def enqueue_broadcast(self, frame: bytes) -> None:
+        with self._cv:
+            if self._failed:
+                raise RuntimeError(f"output buffer failed: {self._failed}")
+            for b in self._buffers:
+                self._bytes += b.enqueue_locked(frame)
+            self._cv.notify_all()
+
+    def set_no_more_pages(self) -> None:
+        with self._cv:
+            self._no_more = True
+            for b in self._buffers:
+                b.set_no_more_locked()
+            self._cv.notify_all()
+
+    def fail(self, message: str) -> None:
+        """Poison the buffer: producers and consumers unblock with an error."""
+        with self._cv:
+            self._failed = message
+            self._cv.notify_all()
+
+    # ------------------------------------------------------------- consumer
+
+    def get(self, buffer_id: int, token: int, wait_s: float = 1.0
+            ) -> Tuple[Optional[bytes], int, bool]:
+        """Long-poll for frame `token` of `buffer_id`; acks frames below it.
+        -> (frame|None, next_token, complete)."""
+        import time as _t
+
+        deadline = _t.monotonic() + wait_s
+        with self._cv:
+            if self._failed:
+                raise RuntimeError(f"task output failed: {self._failed}")
+            self._bytes -= self._buffers[buffer_id].ack_locked(token)
+            self._cv.notify_all()
+            while True:
+                frame, nxt, complete = self._buffers[buffer_id].get_locked(token)
+                if frame is not None or complete:
+                    return frame, nxt, complete
+                remaining = deadline - _t.monotonic()
+                if remaining <= 0:
+                    return None, token, False
+                self._cv.wait(timeout=remaining)
+                if self._failed:
+                    raise RuntimeError(f"task output failed: {self._failed}")
+
+    def abort(self, buffer_id: int) -> None:
+        with self._cv:
+            self._bytes -= self._buffers[buffer_id].abort_locked()
+            self._cv.notify_all()
+
+    def destroy(self) -> None:
+        with self._cv:
+            for b in self._buffers:
+                self._bytes -= b.abort_locked()
+            self._no_more = True
+            self._cv.notify_all()
+
+    def retained_bytes(self) -> int:
+        with self._cv:
+            return self._bytes

@@ -1,0 +1,133 @@
+"""Consumer half of the cross-host shuffle: HTTP page pull with token acks.
+
+Analogue of operator/ExchangeClient.java:145 + HttpPageBufferClient.java:88,301
+(/root/reference/presto-main): for each upstream task location, GET
+{location}/results/{buffer_id}/{token} long-polls one frame at a time; the next
+request's token acknowledges everything before it. Transient HTTP errors back
+off and retry (server/remotetask/Backoff.java); a hard error or an upstream
+task failure fails the consumer."""
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from typing import Iterator, List, Optional, Sequence
+
+from ..block import Dictionary, Page
+from ..spi.connector import ConnectorPageSource
+from ..types import Type
+from .serde import deserialize_pages
+
+# transient-failure budget before a location is declared dead
+_MAX_ERROR_S = 60.0
+
+
+def http_json(method: str, url: str, body: Optional[bytes] = None,
+              timeout_s: float = 30.0) -> dict:
+    req = urllib.request.Request(url, data=body, method=method)
+    if body is not None:
+        req.add_header("Content-Type", "application/octet-stream")
+    with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+        data = resp.read()
+    return json.loads(data) if data else {}
+
+
+class PageBufferClient:
+    """One upstream location's pull loop state."""
+
+    def __init__(self, location: str, buffer_id: int):
+        self.location = location.rstrip("/")
+        self.buffer_id = buffer_id
+        self.token = 0
+        self.complete = False
+        self._error_since: Optional[float] = None
+
+    def poll(self, timeout_s: float = 10.0) -> Optional[bytes]:
+        """One GET; returns a frame or None (no data yet / now complete)."""
+        url = (f"{self.location}/results/{self.buffer_id}/{self.token}"
+               f"?wait={timeout_s:.1f}")
+        req = urllib.request.Request(url, method="GET")
+        try:
+            with urllib.request.urlopen(req, timeout=timeout_s + 15.0) as resp:
+                nxt = int(resp.headers.get("X-Next-Token", self.token))
+                complete = resp.headers.get("X-Complete") == "true"
+                frame = resp.read()
+        except urllib.error.HTTPError as e:
+            if e.code == 404:
+                # producer task not created yet (all-at-once scheduling may
+                # reach the consumer first) — transient within the budget
+                return self._transient(e)
+            raise RuntimeError(
+                f"exchange source {self.location} failed: {e} "
+                f"{e.read()[:500].decode(errors='replace')}") from e
+        except (urllib.error.URLError, OSError, TimeoutError) as e:
+            return self._transient(e)
+        self._error_since = None
+        self.token = nxt
+        self.complete = complete
+        return frame if frame else None
+
+    def _transient(self, e: Exception) -> None:
+        now = time.monotonic()
+        if self._error_since is None:
+            self._error_since = now
+        if now - self._error_since > _MAX_ERROR_S:
+            raise RuntimeError(
+                f"exchange source {self.location} unreachable: {e}") from e
+        time.sleep(0.2)
+        return None
+
+    def finished_ack(self) -> None:
+        """Final ack freeing the server-side buffer (abort endpoint)."""
+        try:
+            url = f"{self.location}/results/{self.buffer_id}"
+            req = urllib.request.Request(url, method="DELETE")
+            urllib.request.urlopen(req, timeout=5.0).read()
+        except Exception:
+            pass  # buffer cleanup is best-effort; task teardown also frees it
+
+
+class StreamingRemoteSource(ConnectorPageSource):
+    """Page source over N upstream task locations — the worker-side endpoint of
+    a fragment's RemoteSourceNode (ExchangeOperator.java:35 analogue). Iterating
+    round-robins the locations, yielding pages as frames arrive; exhausts when
+    every location reports complete."""
+
+    def __init__(self, locations: Sequence[str], buffer_id: int,
+                 types: Sequence[Type],
+                 dicts: Sequence[Optional[Dictionary]],
+                 page_capacity: int,
+                 cancelled: Optional[threading.Event] = None):
+        self.clients = [PageBufferClient(loc, buffer_id) for loc in locations]
+        self.types = list(types)
+        self.dicts = list(dicts)
+        self.page_capacity = page_capacity
+        self.cancelled = cancelled
+
+    def __iter__(self) -> Iterator[Page]:
+        pending = list(self.clients)
+        while pending:
+            if self.cancelled is not None and self.cancelled.is_set():
+                raise RuntimeError("task cancelled while reading exchange")
+            progressed = False
+            for c in list(pending):
+                # short poll while multiple sources are live so one slow
+                # producer cannot starve the others; the tail drains long-polled
+                frame = c.poll(timeout_s=0.2 if len(pending) > 1 else 10.0)
+                if frame:
+                    progressed = True
+                    for page in deserialize_pages(frame, self.types, self.dicts,
+                                                  self.page_capacity):
+                        yield page
+                if c.complete:
+                    c.finished_ack()
+                    pending.remove(c)
+            if not progressed and pending:
+                time.sleep(0.01)
+
+    def close(self) -> None:
+        for c in self.clients:
+            if not c.complete:
+                c.finished_ack()

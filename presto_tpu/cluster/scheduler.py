@@ -1,0 +1,195 @@
+"""Coordinator-side scheduling: node selection, remote tasks, stage execution.
+
+Analogues (/root/reference/presto-main):
+  - execution/scheduler/NodeScheduler.java:59 + SimpleNodeSelector.java:45 —
+    pick worker nodes for a stage's tasks
+  - server/remotetask/HttpRemoteTask.java:103,491-541 — the coordinator's
+    proxy for one worker task: POST updates, poll status with backoff
+  - execution/scheduler/SqlQueryScheduler.java:114,549 + SqlStageExecution —
+    create every stage's tasks (all-at-once policy: data streams between
+    stages, so all tasks start together) and monitor them to completion
+  - server/remotetask/Backoff.java — transient-failure retry budget
+"""
+from __future__ import annotations
+
+import dataclasses
+import pickle
+import time
+import urllib.error
+import urllib.request
+from typing import Dict, List, Optional
+
+from ..metadata import Session
+from ..sql.planner.fragmenter import Fragment, SINGLE_PART, SubPlan
+from ..sql.planner.plan import RemoteSourceNode
+from .discovery import NodeInfo
+from .task import (DONE_STATES, FAILED, FINISHED, TaskInfo,
+                   TaskUpdateRequest)
+
+
+class RemoteTask:
+    """Coordinator proxy for one worker task (HttpRemoteTask analogue)."""
+
+    def __init__(self, task_id: str, node: NodeInfo):
+        self.task_id = task_id
+        self.node = node
+        self.location = f"{node.uri}/v1/task/{task_id}"
+        self.info: Optional[TaskInfo] = None
+
+    def create(self, request: TaskUpdateRequest, retries: int = 3) -> TaskInfo:
+        body = pickle.dumps(request)
+        last: Optional[Exception] = None
+        for attempt in range(retries):
+            req = urllib.request.Request(
+                self.location, data=body, method="POST",
+                headers={"Content-Type": "application/octet-stream"})
+            try:
+                with urllib.request.urlopen(req, timeout=30.0) as resp:
+                    self.info = pickle.loads(resp.read())
+                    return self.info
+            except (urllib.error.URLError, OSError) as e:
+                last = e
+                time.sleep(0.2 * (attempt + 1))
+        raise RuntimeError(
+            f"cannot create task {self.task_id} on {self.node.node_id}: {last}")
+
+    def poll_info(self) -> Optional[TaskInfo]:
+        req = urllib.request.Request(self.location, method="GET")
+        try:
+            with urllib.request.urlopen(req, timeout=10.0) as resp:
+                self.info = pickle.loads(resp.read())
+                return self.info
+        except (urllib.error.URLError, OSError):
+            return None  # judged by the failure detector, not one lost poll
+
+    def cancel(self, abort: bool = True) -> None:
+        try:
+            req = urllib.request.Request(
+                self.location + ("?abort=true" if abort else ""),
+                method="DELETE")
+            urllib.request.urlopen(req, timeout=5.0).read()
+        except Exception:
+            pass
+
+
+class NodeScheduler:
+    """SimpleNodeSelector.java:45 (narrowed): every active node runs one task
+    of each distributed fragment; single-task fragments rotate over nodes by
+    fragment id so consecutive SINGLE stages spread."""
+
+    def __init__(self, nodes: List[NodeInfo]):
+        assert nodes, "no active worker nodes"
+        self.nodes = nodes
+
+    def select(self, fragment: Fragment) -> List[NodeInfo]:
+        if fragment.partitioning == SINGLE_PART:
+            return [self.nodes[fragment.id % len(self.nodes)]]
+        return list(self.nodes)
+
+
+@dataclasses.dataclass
+class StageExecution:
+    fragment: Fragment
+    tasks: List[RemoteTask]
+
+
+class SqlQueryScheduler:
+    """Create all stages' tasks, monitor to completion, expose root location.
+
+    Stages are created bottom-up (producers first) so consumers' first pulls
+    mostly find their sources; data still STREAMS between stages — no stage
+    waits for another to finish before starting (all-at-once policy,
+    AllAtOnceExecutionPolicy.java)."""
+
+    def __init__(self, query_id: str, subplan: SubPlan,
+                 nodes: List[NodeInfo], session: Session):
+        self.query_id = query_id
+        self.subplan = subplan
+        self.session = session
+        self.selector = NodeScheduler(nodes)
+        self.stages: Dict[int, StageExecution] = {}
+        self._consumer_tasks = self._consumer_task_counts()
+
+    def _consumer_task_counts(self) -> Dict[int, int]:
+        """fragment id -> number of tasks of its consuming fragment."""
+        counts: Dict[int, int] = {}
+        for frag in self.subplan.fragments:
+            n_tasks = 1 if frag.partitioning == SINGLE_PART \
+                else len(self.selector.nodes)
+            for fid in _remote_source_ids(frag.root):
+                counts[fid] = n_tasks
+        counts[self.subplan.root_fragment.id] = 1  # the coordinator pulls root
+        return counts
+
+    def schedule(self) -> None:
+        task_counts = {
+            f.id: (1 if f.partitioning == SINGLE_PART
+                   else len(self.selector.nodes))
+            for f in self.subplan.fragments}
+        for frag in self.subplan.fragments:  # bottom-up order from fragmenter
+            nodes = self.selector.select(frag)
+            tasks = [RemoteTask(f"{self.query_id}.{frag.id}.{i}", node)
+                     for i, node in enumerate(nodes)]
+            input_locations = {
+                fid: [t.location for t in self.stages[fid].tasks]
+                for fid in _remote_source_ids(frag.root)}
+            for i, task in enumerate(tasks):
+                task.create(TaskUpdateRequest(
+                    task_id=task.task_id,
+                    query_id=self.query_id,
+                    subplan=self.subplan,
+                    fragment_id=frag.id,
+                    worker_index=i,
+                    task_counts=task_counts,
+                    input_locations=input_locations,
+                    session=self.session,
+                    output_buffers=self._consumer_tasks[frag.id]))
+            self.stages[frag.id] = StageExecution(frag, tasks)
+
+    # ------------------------------------------------------------ monitoring
+
+    def root_task(self) -> RemoteTask:
+        return self.stages[self.subplan.root_fragment.id].tasks[0]
+
+    def all_tasks(self) -> List[RemoteTask]:
+        return [t for s in self.stages.values() for t in s.tasks]
+
+    def check_failures(self, active_node_ids: Optional[set] = None) -> None:
+        """Poll task infos; raise on any FAILED task or dead node (queries with
+        tasks on failed nodes fail — the reference has no intra-query retry
+        either, SURVEY §5)."""
+        for task in self.all_tasks():
+            info = task.poll_info()
+            if info is not None and info.state == FAILED:
+                err = info.error or {}
+                raise RuntimeError(
+                    f"task {task.task_id} failed on {task.node.node_id}: "
+                    f"{err.get('message')}\n{err.get('stack', '')[-800:]}")
+            if active_node_ids is not None \
+                    and task.node.node_id not in active_node_ids \
+                    and (info is None or info.state not in DONE_STATES):
+                raise RuntimeError(
+                    f"worker {task.node.node_id} died with task "
+                    f"{task.task_id} in state "
+                    f"{info.state if info else 'UNREACHABLE'}")
+
+    def is_finished(self) -> bool:
+        info = self.root_task().info
+        return info is not None and info.state == FINISHED
+
+    def abort(self) -> None:
+        for task in self.all_tasks():
+            task.cancel(abort=True)
+
+
+def _remote_source_ids(node) -> List[int]:
+    out: List[int] = []
+
+    def walk(n):
+        if isinstance(n, RemoteSourceNode):
+            out.append(n.fragment_id)
+            return
+        for c in n.children():
+            walk(c)
+    walk(node)
+    return out

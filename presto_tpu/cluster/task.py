@@ -1,0 +1,385 @@
+"""Worker task runtime: task state machine, output partitioning, task manager.
+
+Analogues (/root/reference/presto-main):
+  - execution/TaskStateMachine.java + TaskState (PLANNED/RUNNING/FLUSHING/
+    FINISHED/CANCELED/ABORTED/FAILED)
+  - execution/SqlTaskManager.java:84,351 (create-or-update semantics, cleanup)
+  - execution/SqlTaskExecution.java:82 (fragment -> local plan -> drivers)
+  - operator/PartitionedOutputOperator.java:297,380-440 (the sink that routes
+    rows to consumer buffers) and TaskOutputOperator.java:149 (single buffer)
+
+A task executes ONE fragment of a query on ONE worker: it locally plans the
+shipped SubPlan bottom-up (so string-dictionary identities stay coherent within
+this process — the plan, not pickled dictionaries, is the source of truth),
+wires RemoteSourceNodes to streaming HTTP exchange clients, replaces the sink
+with a partitioned output buffer, and drives the pipelines on the worker's
+task executor threads."""
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+import traceback
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..block import Dictionary, Page
+from ..exec.local_planner import LocalExecutionPlanner
+from ..exec.task_executor import TaskExecutor
+from ..metadata import MetadataManager, Session
+from ..ops.operator import Operator, OperatorContext, OperatorFactory
+from ..sql.planner.fragmenter import SINGLE_PART, SubPlan
+from ..sql.planner.plan import BROADCAST, GATHER, OutputNode, REPARTITION
+from ..types import Type
+from . import buffers
+from .exchange_client import StreamingRemoteSource
+from .serde import pages_to_columns, serialize_columns
+
+# TaskState vocabulary (execution/TaskState.java)
+PLANNED = "PLANNED"
+RUNNING = "RUNNING"
+FINISHED = "FINISHED"
+CANCELED = "CANCELED"
+ABORTED = "ABORTED"
+FAILED = "FAILED"
+DONE_STATES = {FINISHED, CANCELED, ABORTED, FAILED}
+
+
+def mix64_np(x: np.ndarray) -> np.ndarray:
+    """numpy twin of ops/hash_join._mix64 — same constants, so cluster routing
+    and kernel hashing can never disagree."""
+    x = x.astype(np.uint64)
+    x = (x ^ (x >> np.uint64(33))) * np.uint64(0xFF51AFD7ED558CCD)
+    x = (x ^ (x >> np.uint64(33))) * np.uint64(0xC4CEB9FE1A85EC53)
+    return x ^ (x >> np.uint64(33))
+
+
+def combined_key_np(keys: Sequence[np.ndarray]) -> np.ndarray:
+    if len(keys) == 1:
+        return keys[0].astype(np.int64)
+    acc = mix64_np(keys[0].astype(np.int64))
+    for k in keys[1:]:
+        acc = mix64_np(acc ^ (k.astype(np.int64).astype(np.uint64)
+                              * np.uint64(0x9E3779B97F4A7C15)))
+    return acc.astype(np.int64)
+
+
+def partition_ids_np(key: np.ndarray, n_parts: int) -> np.ndarray:
+    return (mix64_np(key) % np.uint64(n_parts)).astype(np.int32)
+
+
+@dataclasses.dataclass
+class TaskUpdateRequest:
+    """POST /v1/task/{taskId} body (pickled) — the fragment+wiring a worker
+    needs (server/TaskUpdateRequest.java analogue)."""
+    task_id: str
+    query_id: str
+    subplan: SubPlan                      # the WHOLE query's fragments
+    fragment_id: int                      # which fragment THIS task runs
+    worker_index: int                     # this task's index in the fragment
+    task_counts: Dict[int, int]           # fragment id -> task count
+    # fragment id -> ordered producer-task result locations (".../results" base)
+    input_locations: Dict[int, List[str]]
+    session: Session
+    output_buffers: int = 1               # consumer count for this task's output
+
+
+@dataclasses.dataclass
+class TaskInfo:
+    task_id: str
+    state: str
+    error: Optional[dict] = None
+    rows_out: int = 0
+
+
+def plan_subplan(subplan: SubPlan, metadata: MetadataManager, session: Session,
+                 task_counts: Dict[int, int], target_fragment_id=None,
+                 sink_factory=None):
+    """Locally plan every fragment bottom-up, threading producer output
+    dictionaries into consumers (the mesh runner's pattern). Returns
+    {fragment_id: (LocalExecutionPlanner, LocalExecutionPlan)}.
+
+    Every cluster participant runs this same deterministic planning over its
+    own metadata — schema (types + dictionary identities) is a plan-time
+    property agreed by construction, so neither types nor dictionaries ever
+    ride the wire (the reference ships block encodings instead)."""
+    frag_dicts: Dict[int, List[Optional[Dictionary]]] = {}
+    plans = {}
+    for frag in subplan.fragments:
+        if frag is subplan.root_fragment:
+            root = OutputNode(frag.root, subplan.column_names,
+                              subplan.output_symbols)
+        else:
+            syms = frag.root.outputs()
+            root = OutputNode(frag.root, [s.name for s in syms], syms)
+        lp = LocalExecutionPlanner(metadata, session,
+                                   n_workers=task_counts.get(frag.id, 1),
+                                   remote_dicts=frag_dicts)
+        sf = sink_factory if frag.id == target_fragment_id else None
+        ep = lp.plan(root, sink_factory=sf)
+        frag_dicts[frag.id] = ep.output_dicts
+        plans[frag.id] = (lp, ep)
+    return plans
+
+
+class TaskOutputOperator(Operator):
+    """Sink: partition/broadcast this task's output pages into its
+    OutputBuffer as serialized frames (PartitionedOutputOperator analogue;
+    rows accumulate per partition and flush at page granularity)."""
+
+    def __init__(self, context: OperatorContext, types: List[Type],
+                 output: buffers.OutputBuffer, kind: str,
+                 key_idx: Optional[List[int]], flush_rows: int):
+        super().__init__(context)
+        self._types = types
+        self.output = output
+        self.kind = kind
+        self.key_idx = key_idx
+        self.flush_rows = flush_rows
+        ncols = len(types)
+        self._acc: List[List[List[np.ndarray]]] = [
+            [[] for _ in range(2 * ncols)] for _ in range(output.n_buffers)]
+        self._acc_rows = [0] * output.n_buffers
+        self.rows_out = 0
+
+    @property
+    def output_types(self) -> List[Type]:
+        return self._types
+
+    def add_input(self, page: Page) -> None:
+        self.context.record_input(page, page.capacity)
+        datas, nulls, nrows = pages_to_columns([page], self._types)
+        if nrows == 0:
+            return
+        self.rows_out += nrows
+        ncols = len(self._types)
+        nulls = [n if n is not None else np.zeros(nrows, dtype=bool)
+                 for n in nulls]
+        if self.kind == BROADCAST:
+            frame = serialize_columns(datas, [n if n.any() else None
+                                              for n in nulls], nrows)
+            self.output.enqueue_broadcast(frame)
+            return
+        if self.kind == GATHER or self.output.n_buffers == 1:
+            self._append(0, datas, nulls, None)
+        else:
+            keys = [np.where(nulls[i], 0, datas[i]).astype(np.int64)
+                    for i in self.key_idx]
+            pid = partition_ids_np(combined_key_np(keys),
+                                   self.output.n_buffers)
+            order = np.argsort(pid, kind="stable")
+            pid_s = pid[order]
+            bounds = np.searchsorted(pid_s, np.arange(self.output.n_buffers + 1))
+            for b in range(self.output.n_buffers):
+                sel = order[bounds[b]:bounds[b + 1]]
+                if len(sel):
+                    self._append(b, datas, nulls, sel)
+        for b in range(self.output.n_buffers):
+            if self._acc_rows[b] >= self.flush_rows:
+                self._flush(b)
+
+    def _append(self, b: int, datas, nulls, sel) -> None:
+        ncols = len(self._types)
+        for c in range(ncols):
+            self._acc[b][c].append(datas[c] if sel is None else datas[c][sel])
+            self._acc[b][ncols + c].append(
+                nulls[c] if sel is None else nulls[c][sel])
+        self._acc_rows[b] += len(datas[0]) if sel is None else len(sel)
+
+    def _flush(self, b: int) -> None:
+        if self._acc_rows[b] == 0:
+            return
+        ncols = len(self._types)
+        datas = [np.concatenate(self._acc[b][c]) for c in range(ncols)]
+        nulls = [np.concatenate(self._acc[b][ncols + c]) for c in range(ncols)]
+        frame = serialize_columns(
+            datas, [n if n.any() else None for n in nulls], self._acc_rows[b])
+        self.output.enqueue(b, frame)
+        self._acc[b] = [[] for _ in range(2 * ncols)]
+        self._acc_rows[b] = 0
+
+    def get_output(self) -> Optional[Page]:
+        return None
+
+    def finish(self) -> None:
+        if not self._finishing:
+            for b in range(self.output.n_buffers):
+                self._flush(b)
+            self.output.set_no_more_pages()
+        super().finish()
+
+
+class TaskOutputFactory(OperatorFactory):
+    def __init__(self, operator_id: int, types: List[Type],
+                 output: buffers.OutputBuffer, kind: str,
+                 key_idx: Optional[List[int]], flush_rows: int = 1 << 14):
+        super().__init__(operator_id, "TaskOutput")
+        self.types = types
+        self.output = output
+        self.kind = kind
+        self.key_idx = key_idx
+        self.flush_rows = flush_rows
+        self.operators: List[TaskOutputOperator] = []
+
+    def create_operator(self, worker: int = 0) -> TaskOutputOperator:
+        op = TaskOutputOperator(
+            OperatorContext(self.operator_id, self.name, worker=worker),
+            self.types, self.output, self.kind, self.key_idx, self.flush_rows)
+        self.operators.append(op)
+        return op
+
+
+class SqlTask:
+    """One fragment execution on this worker (execution/SqlTask.java:69)."""
+
+    def __init__(self, request: TaskUpdateRequest, metadata: MetadataManager):
+        self.request = request
+        self.metadata = metadata
+        self.task_id = request.task_id
+        self.state = PLANNED
+        self.error: Optional[dict] = None
+        self.created = time.time()
+        self.cancelled = threading.Event()
+        self.output_types: List[Type] = []
+        self.output_dicts: List[Optional[Dictionary]] = []
+        self._sink: Optional[TaskOutputFactory] = None
+        kind = self._output_kind()
+        self.output = buffers.OutputBuffer(
+            buffers.BROADCAST if kind == BROADCAST else
+            (buffers.GATHER if request.output_buffers == 1
+             else buffers.PARTITIONED),
+            request.output_buffers)
+        self.thread = threading.Thread(
+            target=self._run, name=f"task-{self.task_id}", daemon=True)
+
+    def _output_kind(self) -> str:
+        frag = self._fragment()
+        return frag.output_kind or GATHER
+
+    def _fragment(self):
+        for f in self.request.subplan.fragments:
+            if f.id == self.request.fragment_id:
+                return f
+        raise KeyError(f"fragment {self.request.fragment_id} not in subplan")
+
+    def start(self) -> None:
+        self.thread.start()
+
+    # ------------------------------------------------------------ lifecycle
+
+    def _run(self) -> None:
+        try:
+            self.state = RUNNING
+            drivers = self._plan_drivers()
+            if self.cancelled.is_set():
+                raise RuntimeError("task cancelled")
+            concurrency = int(self.request.session.get("task_concurrency"))
+            TaskExecutor(concurrency).execute(drivers)
+            if self._sink is not None and not self._sink.operators:
+                # fragment produced no sink operator (shouldn't happen) —
+                # still close the buffer so consumers terminate
+                self.output.set_no_more_pages()
+            self.state = FINISHED if not self.cancelled.is_set() else CANCELED
+        except Exception as e:  # noqa: BLE001 — reported via TaskInfo
+            self.error = {"message": str(e), "type": type(e).__name__,
+                          "stack": traceback.format_exc()[-2000:]}
+            self.state = FAILED
+            self.output.fail(str(e))
+
+    def _plan_drivers(self):
+        req = self.request
+        frag = self._fragment()
+        plans = plan_subplan(req.subplan, self.metadata, req.session,
+                             req.task_counts, target_fragment_id=req.fragment_id,
+                             sink_factory=self._make_sink(frag))
+        own_lp, own_plan = plans[req.fragment_id]
+        self.output_types = own_plan.output_types
+        self.output_dicts = own_plan.output_dicts
+        # wire remote sources to streaming HTTP pulls
+        page_cap = int(req.session.get("page_capacity"))
+        for fid, slot in own_lp.remote_slots.items():
+            locations = req.input_locations.get(fid, [])
+            dicts = plans[fid][1].output_dicts
+            types = [s.type for s in self._producer_outputs(fid)]
+
+            def factory(worker, _locs=locations, _t=types, _d=dicts):
+                return StreamingRemoteSource(
+                    _locs, req.worker_index, _t, _d, page_cap,
+                    cancelled=self.cancelled)
+            slot.source_factory = factory
+        return own_plan.create_drivers(req.worker_index)
+
+    def _producer_outputs(self, fragment_id: int):
+        for f in self.request.subplan.fragments:
+            if f.id == fragment_id:
+                return f.root.outputs()
+        raise KeyError(fragment_id)
+
+    def _make_sink(self, frag):
+        def make(types: List[Type], dicts) -> TaskOutputFactory:
+            key_idx = None
+            if frag.output_kind == REPARTITION and frag.output_keys:
+                names = [s.name for s in frag.root.outputs()]
+                key_idx = [names.index(k.name) for k in frag.output_keys]
+            self._sink = TaskOutputFactory(
+                999, types, self.output, frag.output_kind or GATHER, key_idx)
+            return self._sink
+        return make
+
+    # ------------------------------------------------------------------ api
+
+    def cancel(self, abort: bool = False) -> None:
+        self.cancelled.set()
+        if self.state not in DONE_STATES:
+            self.state = ABORTED if abort else CANCELED
+        self.output.destroy()
+
+    def info(self) -> TaskInfo:
+        rows = self._sink.operators[0].rows_out \
+            if self._sink and self._sink.operators else 0
+        return TaskInfo(self.task_id, self.state, self.error, rows)
+
+
+class WorkerTaskManager:
+    """execution/SqlTaskManager.java:84 — owns this worker's tasks."""
+
+    def __init__(self, metadata: MetadataManager,
+                 max_done_tasks: int = 200):
+        self.metadata = metadata
+        self.tasks: Dict[str, SqlTask] = {}
+        self._lock = threading.Lock()
+        self.max_done_tasks = max_done_tasks
+
+    def create_or_update(self, request: TaskUpdateRequest) -> TaskInfo:
+        with self._lock:
+            task = self.tasks.get(request.task_id)
+            if task is None:
+                task = SqlTask(request, self.metadata)
+                self.tasks[request.task_id] = task
+                task.start()
+                self._cleanup_locked()
+        return task.info()
+
+    def get(self, task_id: str) -> Optional[SqlTask]:
+        return self.tasks.get(task_id)
+
+    def cancel(self, task_id: str, abort: bool = False) -> bool:
+        task = self.tasks.get(task_id)
+        if task is None:
+            return False
+        task.cancel(abort)
+        return True
+
+    def cancel_query(self, query_id: str) -> None:
+        for task in list(self.tasks.values()):
+            if task.request.query_id == query_id:
+                task.cancel(abort=True)
+
+    def _cleanup_locked(self) -> None:
+        done = [t for t in self.tasks.values() if t.state in DONE_STATES]
+        if len(done) <= self.max_done_tasks:
+            return
+        done.sort(key=lambda t: t.created)
+        for t in done[:len(done) - self.max_done_tasks]:
+            self.tasks.pop(t.task_id, None)

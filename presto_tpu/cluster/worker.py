@@ -1,0 +1,217 @@
+"""Worker server: the task-execution HTTP endpoint of a cluster node.
+
+Analogue of the worker role of server/PrestoServer.java + server/TaskResource
+(/root/reference/presto-main/.../server/TaskResource.java:84,122,245):
+
+  POST   /v1/task/{taskId}                         create/update (pickled
+                                                   TaskUpdateRequest body)
+  GET    /v1/task/{taskId}                         TaskInfo (pickled)
+  DELETE /v1/task/{taskId}[?abort=true]            cancel/abort
+  GET    /v1/task/{taskId}/results/{buf}/{token}   pull one page frame
+         (binary body; X-Next-Token / X-Complete headers; ?wait= long-poll)
+  DELETE /v1/task/{taskId}/results/{buf}           release the client buffer
+  GET    /v1/status                                heartbeat + node info
+  PUT    /v1/info/state                            "SHUTTING_DOWN" drains
+                                                   (GracefulShutdownHandler.java:43)
+
+Control-plane bodies are pickled — both ends run this same binary, the
+reference's JSON/SMILE codec pair plays the equivalent role across its JVMs.
+Workers announce themselves to the coordinator (discovery.Announcer)."""
+from __future__ import annotations
+
+import pickle
+import re
+import threading
+import time
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from ..metadata import CatalogManager, MetadataManager
+from .task import DONE_STATES, TaskUpdateRequest, WorkerTaskManager
+
+ACTIVE = "ACTIVE"
+SHUTTING_DOWN = "SHUTTING_DOWN"
+
+
+def default_catalogs() -> CatalogManager:
+    """Every node builds the same static catalog set from its own process
+    (the reference loads etc/catalog/*.properties per node)."""
+    from ..connectors.blackhole import BlackholeConnector
+    from ..connectors.tpcds import TpcdsConnector
+    from ..connectors.tpch.connector import TpchConnector
+
+    catalogs = CatalogManager()
+    catalogs.register("tpch", TpchConnector("tpch"))
+    catalogs.register("tpcds", TpcdsConnector("tpcds"))
+    catalogs.register("blackhole", BlackholeConnector("blackhole"))
+    return catalogs
+
+
+class _WorkerHandler(BaseHTTPRequestHandler):
+    worker: "WorkerServer" = None  # bound per server instance
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):  # noqa: A003
+        pass
+
+    def _send(self, body: bytes, status: int = 200, headers=()) -> None:
+        self.send_response(status)
+        for k, v in headers:
+            self.send_header(k, v)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        if body:
+            self.wfile.write(body)
+
+    def _send_pickle(self, obj, status: int = 200) -> None:
+        self._send(pickle.dumps(obj), status,
+                   [("Content-Type", "application/octet-stream")])
+
+    # ------------------------------------------------------------ endpoints
+
+    def do_POST(self) -> None:  # noqa: N802
+        m = re.fullmatch(r"/v1/task/([^/]+)", self.path)
+        if not m:
+            return self._send(b"not found", 404)
+        if self.worker.state == SHUTTING_DOWN:
+            return self._send(b"shutting down", 503)
+        length = int(self.headers.get("Content-Length", 0))
+        request: TaskUpdateRequest = pickle.loads(self.rfile.read(length))
+        info = self.worker.tasks.create_or_update(request)
+        self._send_pickle(info)
+
+    def do_GET(self) -> None:  # noqa: N802
+        path, _, query = self.path.partition("?")
+        m = re.fullmatch(r"/v1/task/([^/]+)/results/(\d+)/(\d+)", path)
+        if m:
+            task = self.worker.tasks.get(m.group(1))
+            if task is None:
+                return self._send(b"no such task", 404)
+            wait = float(urllib.parse.parse_qs(query).get("wait", ["1.0"])[0])
+            try:
+                frame, nxt, complete = task.output.get(
+                    int(m.group(2)), int(m.group(3)), wait_s=min(wait, 30.0))
+            except RuntimeError as e:
+                return self._send(str(e).encode(), 500)
+            return self._send(
+                frame or b"", 200,
+                [("Content-Type", "application/octet-stream"),
+                 ("X-Next-Token", str(nxt)),
+                 ("X-Complete", "true" if complete else "false")])
+        m = re.fullmatch(r"/v1/task/([^/]+)", path)
+        if m:
+            task = self.worker.tasks.get(m.group(1))
+            if task is None:
+                return self._send(b"no such task", 404)
+            return self._send_pickle(task.info())
+        if path.rstrip("/") == "/v1/status":
+            import json
+            active = sum(1 for t in self.worker.tasks.tasks.values()
+                         if t.state not in DONE_STATES)
+            return self._send(json.dumps({
+                "nodeId": self.worker.node_id,
+                "state": self.worker.state,
+                "activeTasks": active,
+                "uptime": round(time.time() - self.worker.start_time, 1),
+            }).encode(), 200, [("Content-Type", "application/json")])
+        self._send(b"not found", 404)
+
+    def do_HEAD(self) -> None:  # noqa: N802 — failure-detector ping
+        if self.path.rstrip("/") == "/v1/status":
+            return self._send(b"", 200)
+        self._send(b"", 404)
+
+    def do_DELETE(self) -> None:  # noqa: N802
+        m = re.fullmatch(r"/v1/task/([^/]+)/results/(\d+)", self.path)
+        if m:
+            task = self.worker.tasks.get(m.group(1))
+            if task is not None:
+                task.output.abort(int(m.group(2)))
+            return self._send(b"", 204)
+        path, _, query = self.path.partition("?")
+        m = re.fullmatch(r"/v1/task/([^/]+)", path)
+        if m:
+            abort = "abort=true" in query
+            self.worker.tasks.cancel(m.group(1), abort=abort)
+            return self._send(b"", 204)
+        self._send(b"not found", 404)
+
+    def do_PUT(self) -> None:  # noqa: N802 — graceful shutdown
+        if self.path.rstrip("/") == "/v1/info/state":
+            length = int(self.headers.get("Content-Length", 0))
+            state = self.rfile.read(length).decode().strip().strip('"')
+            if state == SHUTTING_DOWN:
+                self.worker.begin_shutdown()
+                return self._send(b"", 200)
+            return self._send(b"bad state", 400)
+        self._send(b"not found", 404)
+
+
+class WorkerServer:
+    """One worker node: HTTP server + task manager + announcer."""
+
+    def __init__(self, port: int = 0,
+                 catalogs: Optional[CatalogManager] = None,
+                 coordinator_uri: Optional[str] = None,
+                 node_id: Optional[str] = None,
+                 host: str = "127.0.0.1"):
+        catalogs = catalogs or default_catalogs()
+        self.metadata = MetadataManager(catalogs)
+        self.tasks = WorkerTaskManager(self.metadata)
+        self.state = ACTIVE
+        self.start_time = time.time()
+        handler = type("BoundWorkerHandler", (_WorkerHandler,), {"worker": self})
+        self.httpd = ThreadingHTTPServer((host, port), handler)
+        self.port = self.httpd.server_address[1]
+        self.uri = f"http://{host}:{self.port}"
+        self.node_id = node_id or f"worker-{self.port}"
+        self._announcer = None
+        if coordinator_uri:
+            from .discovery import Announcer
+            self._announcer = Announcer(coordinator_uri, self.node_id, self.uri)
+
+    def start(self) -> "WorkerServer":
+        threading.Thread(target=self.httpd.serve_forever,
+                         name=f"worker-{self.port}", daemon=True).start()
+        if self._announcer:
+            self._announcer.start()
+        return self
+
+    def begin_shutdown(self) -> None:
+        """Drain: stop accepting tasks, stop announcing; the process exits when
+        active tasks finish (GracefulShutdownHandler semantics)."""
+        self.state = SHUTTING_DOWN
+        if self._announcer:
+            self._announcer.stop()
+
+    def active_task_count(self) -> int:
+        return sum(1 for t in self.tasks.tasks.values()
+                   if t.state not in DONE_STATES)
+
+    def stop(self) -> None:
+        if self._announcer:
+            self._announcer.stop()
+        for t in list(self.tasks.tasks.values()):
+            t.cancel(abort=True)
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+def main(argv=None) -> None:
+    import argparse
+
+    ap = argparse.ArgumentParser(prog="presto-tpu-worker")
+    ap.add_argument("--port", type=int, default=8081)
+    ap.add_argument("--coordinator", default=None,
+                    help="coordinator URI to announce to")
+    args = ap.parse_args(argv)
+    server = WorkerServer(port=args.port, coordinator_uri=args.coordinator)
+    if server._announcer:
+        server._announcer.start()
+    print(f"presto-tpu worker {server.node_id} listening on :{server.port}")
+    server.httpd.serve_forever()
+
+
+if __name__ == "__main__":
+    main()

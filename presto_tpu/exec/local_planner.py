@@ -144,12 +144,21 @@ class RemoteSourceSlot:
     def __init__(self, fragment_id: int):
         self.fragment_id = fragment_id
         self._pages_by_worker: Dict[int, List[Page]] = {}
+        # cluster mode plugs a streaming HTTP source in here (callable
+        # worker -> ConnectorPageSource); default is the deposited-pages replay
+        self.source_factory = None
 
     def set_pages(self, worker: int, pages: List[Page]) -> None:
         self._pages_by_worker[worker] = list(pages)
 
     def pages(self, worker: int) -> List[Page]:
         return self._pages_by_worker.get(worker, [])
+
+    def make_source(self, worker: int):
+        from ..spi.connector import FixedPageSource
+        if self.source_factory is not None:
+            return self.source_factory(worker)
+        return FixedPageSource(self.pages(worker))
 
 
 @dataclasses.dataclass
@@ -202,7 +211,10 @@ class LocalExecutionPlanner:
         self._memory_ctx = memory
         self._revoke_check = revoke_check
 
-    def plan(self, root: OutputNode) -> LocalExecutionPlan:
+    def plan(self, root: OutputNode, sink_factory=None) -> LocalExecutionPlan:
+        """`sink_factory`: optional callable (types, dicts) -> OperatorFactory
+        replacing the default page-buffer sink (cluster tasks sink into their
+        partitioned output buffers instead)."""
         chain = self.visit(root.source)
         # final projection into the user's column order
         want = [s.name for s in root.symbols]
@@ -210,8 +222,12 @@ class LocalExecutionPlanner:
         if want != have:
             chain = self._append_project(
                 chain, [(s, symbol_ref(s.name, s.type)) for s in root.symbols])
-        sink = PageConsumerFactory(next(self._ids),
-                                   [s.type for s in chain.symbols])
+        if sink_factory is not None:
+            sink = sink_factory([s.type for s in chain.symbols],
+                                list(chain.dicts))
+        else:
+            sink = PageConsumerFactory(next(self._ids),
+                                       [s.type for s in chain.symbols])
         self.pipelines.append(chain.factories + [sink])
         mem = getattr(self, "_memory_ctx", None)
         if mem is not None:
@@ -320,13 +336,12 @@ class LocalExecutionPlanner:
         """Replay each worker's exchange-output pages (ExchangeOperator.java:35
         analogue — the collective already ran; this is the local endpoint). The
         slot is filled by the runner between fragment executions."""
-        from ..spi.connector import FixedPageSource
         slot = self.remote_slots.get(node.fragment_id)
         if slot is None:
             slot = self.remote_slots[node.fragment_id] = \
                 RemoteSourceSlot(node.fragment_id)
         fac = TableScanOperatorFactory(
-            next(self._ids), lambda w: [FixedPageSource(slot.pages(w))],
+            next(self._ids), lambda w: [slot.make_source(w)],
             [s.type for s in node.symbols], None)
         dicts = self.remote_dicts.get(node.fragment_id,
                                       [None] * len(node.symbols))

@@ -59,6 +59,15 @@ class _Handler(BaseHTTPRequestHandler):
     # ------------------------------------------------------------ endpoints
 
     def do_POST(self) -> None:  # noqa: N802 - http.server naming
+        if self.path.rstrip("/") == "/v1/announcement":
+            # worker service announcement (cluster mode: discovery endpoint)
+            nodes = getattr(self.manager.runner, "nodes", None)
+            if nodes is None:
+                return self._not_found()
+            length = int(self.headers.get("Content-Length", 0))
+            body = json.loads(self.rfile.read(length).decode())
+            nodes.announce(body["nodeId"], body["uri"])
+            return self._send_json({"announced": body["nodeId"]}, status=202)
         if self.path.rstrip("/") != "/v1/statement":
             return self._not_found()
         length = int(self.headers.get("Content-Length", 0))
@@ -82,6 +91,19 @@ class _Handler(BaseHTTPRequestHandler):
                 "nodeVersion": {"version": _VERSION},
                 "uptime": round(time.time() - _START_TIME, 1),
                 "coordinator": True,
+            })
+        if self.path.rstrip("/") == "/v1/cluster":
+            # ClusterStatsResource.java analogue (feeds the web UI)
+            queries = self.manager.list_queries()
+            nodes = getattr(self.manager.runner, "nodes", None)
+            return self._send_json({
+                "runningQueries": sum(q.state == "RUNNING" for q in queries),
+                "queuedQueries": sum(q.state == "QUEUED" for q in queries),
+                "totalQueries": len(queries),
+                "activeWorkers": len(nodes.active_nodes()) if nodes else 1,
+                "nodes": [{"nodeId": n.node_id, "uri": n.uri,
+                           "failureRatio": round(n.failure_ratio, 3)}
+                          for n in (nodes.all_nodes() if nodes else [])],
             })
         if self.path.rstrip("/") == "/v1/query":
             return self._send_json([self._query_json(q)
@@ -149,20 +171,31 @@ def main(argv=None) -> None:
     ap.add_argument("--schema", default="tiny")
     ap.add_argument("--distributed", action="store_true",
                     help="serve queries through the mesh-distributed engine")
+    ap.add_argument("--cluster", action="store_true",
+                    help="coordinator role: execute on announced worker "
+                         "processes (start them with python -m "
+                         "presto_tpu.cluster.worker --coordinator URI)")
+    ap.add_argument("--min-workers", type=int, default=1)
     args = ap.parse_args(argv)
 
     from ..metadata import Session
     session = Session(catalog="tpch", schema=args.schema)
-    if args.distributed:
+    if args.cluster:
+        from ..cluster import ClusterQueryRunner
+        runner = ClusterQueryRunner(session=session,
+                                    min_workers=args.min_workers)
+        mode = "cluster-coordinator"
+    elif args.distributed:
         from ..parallel.runner import DistributedQueryRunner
         runner = DistributedQueryRunner(session=session)
+        mode = "distributed"
     else:
         from ..runner import LocalQueryRunner
         runner = LocalQueryRunner(session=session)
+        mode = "local"
     server = PrestoTpuServer(runner, port=args.port)
     print(f"presto-tpu server listening on :{server.port} "
-          f"({'distributed' if args.distributed else 'local'}, "
-          f"schema={args.schema})")
+          f"({mode}, schema={args.schema})")
     server.serve()
 
 

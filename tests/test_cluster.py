@@ -1,0 +1,240 @@
+"""Cluster-mode tests: coordinator + worker processes over HTTP.
+
+The multi-node ring of the reference test strategy (SURVEY §4 ring 3):
+DistributedQueryRunner.java:77 boots a discovery server + N TestingPrestoServer
+instances with real HTTP exchanges in one JVM — here N WorkerServers and a
+ClusterQueryRunner coordinator run in one process with real HTTP between them,
+and results are checked against the single-process LocalQueryRunner."""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from presto_tpu.block import Block, Page
+from presto_tpu.cluster import ClusterQueryRunner, WorkerServer
+from presto_tpu.cluster.buffers import OutputBuffer, PARTITIONED
+from presto_tpu.cluster.serde import deserialize_pages, serialize_pages
+from presto_tpu.metadata import Session
+from presto_tpu.runner import LocalQueryRunner
+from presto_tpu.types import BIGINT, DOUBLE
+from presto_tpu.utils.testing import assert_rows_equal
+
+
+# ---------------------------------------------------------------------------
+# serde + buffers units
+# ---------------------------------------------------------------------------
+
+def test_page_serde_roundtrip():
+    n = 100
+    data = np.arange(n, dtype=np.int64)
+    nulls = (data % 7 == 0)
+    mask = (data % 3 != 0)
+    dbl = np.linspace(0, 1, n)
+    page = Page((Block(BIGINT, data, nulls), Block(DOUBLE, dbl)),
+                mask.copy())
+    frame = serialize_pages([page], [BIGINT, DOUBLE])
+    out = deserialize_pages(frame, [BIGINT, DOUBLE], [None, None],
+                            page_capacity=1 << 14)
+    live = np.flatnonzero(mask)
+    got_rows = [r for p in out for r in p.to_pylists()]
+    want_rows = [[None if nulls[i] else int(data[i]), float(dbl[i])]
+                 for i in live]
+    assert got_rows == want_rows
+
+
+def test_page_serde_empty():
+    frame = serialize_pages([], [BIGINT])
+    assert deserialize_pages(frame, [BIGINT], [None], 1024) == []
+
+
+def test_output_buffer_token_protocol():
+    buf = OutputBuffer(PARTITIONED, 2)
+    buf.enqueue(0, b"frame-a")
+    buf.enqueue(0, b"frame-b")
+    buf.enqueue(1, b"frame-c")
+    frame, nxt, complete = buf.get(0, 0)
+    assert frame == b"frame-a" and nxt == 1 and not complete
+    # re-request is idempotent (client retry after lost response)
+    frame2, _, _ = buf.get(0, 0)
+    assert frame2 == b"frame-a"
+    frame, nxt, complete = buf.get(0, 1)
+    assert frame == b"frame-b" and nxt == 2
+    buf.set_no_more_pages()
+    frame, _, complete = buf.get(0, 2, wait_s=0.1)
+    assert frame is None and complete
+    frame, _, complete = buf.get(1, 0)
+    assert frame == b"frame-c" and not complete
+    frame, _, complete = buf.get(1, 1, wait_s=0.1)
+    assert frame is None and complete
+
+
+def test_output_buffer_backpressure_unblocks():
+    buf = OutputBuffer(PARTITIONED, 1, max_bytes=64)
+    buf.enqueue(0, b"x" * 60)
+    done = threading.Event()
+
+    def producer():
+        buf.enqueue(0, b"y" * 60)  # blocks until the consumer acks frame 0
+        done.set()
+
+    threading.Thread(target=producer, daemon=True).start()
+    time.sleep(0.1)
+    assert not done.is_set()
+    buf.get(0, 0)          # read frame 0
+    buf.get(0, 1, wait_s=2.0)  # ack frame 0, read frame 1
+    assert done.wait(2.0)
+
+
+# ---------------------------------------------------------------------------
+# full cluster: coordinator + 2 workers, real HTTP
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def cluster():
+    session = Session(catalog="tpch", schema="tiny")
+    runner = ClusterQueryRunner(session=session, min_workers=2,
+                                worker_wait_s=10.0)
+    workers = [WorkerServer(port=0).start() for _ in range(2)]
+    for w in workers:
+        runner.nodes.announce(w.node_id, w.uri)
+    # keep announcements fresh for the duration of the module
+    stop = threading.Event()
+
+    def keep_alive():
+        while not stop.wait(1.0):
+            for w in workers:
+                runner.nodes.announce(w.node_id, w.uri)
+
+    threading.Thread(target=keep_alive, daemon=True).start()
+    local = LocalQueryRunner(session=session)
+    yield runner, local
+    stop.set()
+    runner.detector.stop()
+    for w in workers:
+        w.stop()
+
+
+CLUSTER_QUERIES = [
+    # aggregation with partial/final split over a repartition exchange
+    "select l_returnflag, count(*), sum(l_quantity), avg(l_extendedprice) "
+    "from lineitem group by l_returnflag",
+    # distributed join + aggregation + order
+    "select o_orderpriority, count(*) c from orders "
+    "where o_orderdate >= date '1995-01-01' "
+    "group by o_orderpriority order by o_orderpriority",
+    # join across an exchange, with a varchar dictionary riding the wire
+    "select n_name, count(*) from nation, region "
+    "where n_regionkey = r_regionkey and r_name = 'ASIA' "
+    "group by n_name order by n_name",
+    # global aggregation (gather to single)
+    "select count(*), sum(l_extendedprice * l_discount) from lineitem "
+    "where l_quantity < 24",
+    # order by + limit through the gather
+    "select c_name, c_acctbal from customer order by c_acctbal desc limit 7",
+]
+
+
+@pytest.mark.parametrize("sql", CLUSTER_QUERIES)
+def test_cluster_query_matches_local(cluster, sql):
+    runner, local = cluster
+    got = runner.execute(sql)
+    want = local.execute(sql)
+    ordered = "order by" in sql
+    assert_rows_equal(got.rows, want.rows, ordered=ordered)
+
+
+def test_cluster_tpch_q3(cluster):
+    from presto_tpu.models.tpch_sql import QUERIES
+    runner, local = cluster
+    got = runner.execute(QUERIES[3])
+    want = local.execute(QUERIES[3])
+    assert_rows_equal(got.rows, want.rows, ordered=True)
+
+
+def test_cluster_task_failure_propagates(cluster):
+    runner, _ = cluster
+    # the coordinator's local engine has a `memory` catalog the workers do not
+    # configure: planning succeeds on the coordinator, the worker task fails,
+    # and the failure must propagate (not hang the coordinator)
+    runner.local.execute(
+        "create table memory.default.coord_only as select 1 as x")
+    with pytest.raises(Exception, match="(?i)task .* failed"):
+        runner.execute("select count(*) from memory.default.coord_only")
+
+
+def test_failure_detector_gates_dead_node():
+    from presto_tpu.cluster.discovery import (DiscoveryNodeManager,
+                                              HeartbeatFailureDetector)
+    nodes = DiscoveryNodeManager()
+    nodes.announce("dead-node", "http://127.0.0.1:1")  # nothing listens
+    detector = HeartbeatFailureDetector(nodes, period_s=0.05).start()
+    try:
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            # announcements stay fresh; only the failure ratio gates it out
+            nodes.announce("dead-node", "http://127.0.0.1:1")
+            if not nodes.active_nodes():
+                break
+            time.sleep(0.05)
+        assert not nodes.active_nodes(), "dead node was never gated out"
+    finally:
+        detector.stop()
+
+
+def test_cluster_insufficient_workers_raises():
+    runner = ClusterQueryRunner(min_workers=3, worker_wait_s=0.2)
+    runner.detector.stop()
+    with pytest.raises(RuntimeError, match="active workers"):
+        runner.execute("select count(*) from nation")
+
+
+def test_rest_protocol_over_cluster():
+    """Full stack: REST coordinator (+/v1/announcement discovery) -> cluster
+    scheduler -> worker tasks -> paged client results."""
+    from presto_tpu import client
+    from presto_tpu.server.http_server import PrestoTpuServer
+
+    runner = ClusterQueryRunner(
+        session=Session(catalog="tpch", schema="tiny"), min_workers=1,
+        worker_wait_s=15.0)
+    server = PrestoTpuServer(runner, port=0)
+    server.start()
+    worker = WorkerServer(port=0,
+                          coordinator_uri=f"http://127.0.0.1:{server.port}"
+                          ).start()
+    try:
+        rows = client.execute(f"http://127.0.0.1:{server.port}",
+                              "select r_name from region order by r_name")
+        assert [r[0] for r in rows] == \
+            ["AFRICA", "AMERICA", "ASIA", "EUROPE", "MIDDLE EAST"]
+        # cluster stats endpoint sees the announced worker
+        import json as _json
+        import urllib.request
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{server.port}/v1/cluster", timeout=5) as r:
+            stats = _json.loads(r.read())
+        assert stats["activeWorkers"] == 1
+        assert stats["nodes"][0]["nodeId"] == worker.node_id
+    finally:
+        worker.stop()
+        runner.detector.stop()
+        server.stop()
+
+
+def test_graceful_shutdown_drains():
+    import urllib.request
+    w = WorkerServer(port=0).start()
+    try:
+        req = urllib.request.Request(f"{w.uri}/v1/info/state",
+                                     data=b'"SHUTTING_DOWN"', method="PUT")
+        urllib.request.urlopen(req, timeout=5.0).read()
+        assert w.state == "SHUTTING_DOWN"
+        # a shutting-down worker refuses new tasks
+        req = urllib.request.Request(f"{w.uri}/v1/task/t1", data=b"x",
+                                     method="POST")
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(req, timeout=5.0)
+        assert exc.value.code == 503
+    finally:
+        w.stop()
