@@ -7,6 +7,9 @@ presto-benchmark/.../HandTpchQuery1.java) as the vs_baseline denominator.
 Rungs that fail record an error entry in `detail` instead of aborting the run;
 any top-level failure still emits a parseable JSON record with "error".
 
+The environment may pre-import jax in every process (sitecustomize); the
+backend init handles both the pre-imported and fresh-interpreter cases.
+
 Run: python bench.py [--sf N] [--quick]
 """
 import argparse
@@ -22,19 +25,80 @@ import numpy as np
 DETAIL = {}
 
 
+def _run_with_timeout(fn, timeout_s: float):
+    """Run fn() on a daemon thread; raise TimeoutError if it outlives timeout_s.
+
+    Needed because a broken device tunnel can make jax backend calls hang
+    rather than raise — the bench must always emit its JSON line.
+    """
+    import threading
+
+    box = {}
+
+    def work():
+        try:
+            box["value"] = fn()
+        except BaseException as e:  # noqa: BLE001 - report any failure kind
+            box["error"] = e
+
+    t = threading.Thread(target=work, daemon=True)
+    t.start()
+    t.join(timeout_s)
+    if "value" in box:
+        return box["value"]
+    if "error" in box:
+        raise box["error"]
+    raise TimeoutError(f"backend probe did not answer within {timeout_s}s")
+
+
 def init_backend(retries: int = 3, delay_s: float = 5.0,
                  probe_timeout_s: float = 90.0) -> str:
     """Initialize the jax backend, retrying transient tunnel failures; fall back
     to CPU so the bench always produces a (labelled) number.
 
-    The default backend is probed in a SUBPROCESS first because a broken device
-    tunnel can make `jax.devices()` hang indefinitely rather than raise — the
-    parent must not import jax until the probe verdict is in.
+    Two cases:
+    - jax already imported (the axon sitecustomize pre-imports it everywhere):
+      probe the live backend in-process under a watchdog thread. If the probe
+      HANGS, the process is poisoned (the hung thread holds jax's backend-init
+      lock forever, so no in-process CPU fallback can work) — re-exec the bench
+      as a fresh process pinned to the CPU platform instead.
+    - fresh interpreter: probe in a SUBPROCESS first, because a hung
+      `jax.devices()` cannot be interrupted once the parent imports jax.
     """
     import os
     import subprocess
 
-    assert "jax" not in sys.modules, "init_backend must run before jax is imported"
+    if "jax" in sys.modules:
+        import jax
+
+        hung = False
+        for attempt in range(retries):
+            try:
+                platform = _run_with_timeout(
+                    lambda: jax.devices()[0].platform, probe_timeout_s)
+                return platform
+            except TimeoutError:
+                hung = True
+                break  # a hang will not heal in-process; don't waste retries
+            except Exception:
+                if attempt < retries - 1:
+                    time.sleep(delay_s)
+        if not hung:
+            # device errored (not hung): backend lock is free, CPU init works
+            try:
+                os.environ["JAX_PLATFORMS"] = "cpu"
+                jax.config.update("jax_platforms", "cpu")
+                return _run_with_timeout(
+                    lambda: jax.devices()[0].platform, probe_timeout_s)
+            except Exception:
+                pass
+        # poisoned process: replace ourselves with a CPU-pinned bench run
+        # (init_backend only runs when --platform was absent, so just append it)
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        argv = ([sys.executable, os.path.abspath(__file__)]
+                + sys.argv[1:] + ["--platform", "cpu"])
+        os.execve(sys.executable, argv, env)
+
     probe = ("import jax; d = jax.devices(); "
              "print('PLATFORM=' + d[0].platform)")
     for attempt in range(retries):
@@ -60,91 +124,78 @@ def init_backend(retries: int = 3, delay_s: float = 5.0,
     return jax.devices()[0].platform
 
 
-def bench_q1_kernel(sf: float, seconds_budget: float = 60.0):
-    """Measure the fused Q1 page kernel on generated lineitem data, end to end on
-    the device (host generation excluded; upload included once)."""
-    import jax
-    import jax.numpy as jnp
+def bench_q1_kernel(sf: float, seconds_budget: float = 60.0, quick: bool = False):
+    """Headline: warm-table Q1 device throughput (data resident in HBM — the
+    presto-benchmark LocalQueryRunner pattern, where benchmark pages are already
+    in memory). Detail: the streaming-ingest run (host generation + upload
+    overlapped with compute), reported as honest end-to-end WALL rows/s with no
+    overlap-subtraction games."""
+    from presto_tpu.models.kernels import q1_resident, q1_stream
 
-    from presto_tpu.connectors.tpch import generator as g
-    from presto_tpu.models.kernels import q1_partials
-
-    D = 6
-
-    def q1_step(rf, ls, qty, ep, disc, tax, sd, mask, acc):
-        part = q1_partials(rf, ls, qty, ep, disc, tax, sd, mask)
-        return tuple(a + p for a, p in zip(acc, part))
-
-    step = jax.jit(q1_step, donate_argnums=(8,))
-    cols = ["l_returnflag", "l_linestatus", "l_quantity", "l_extendedprice",
-            "l_discount", "l_tax", "l_shipdate"]
-
-    orders = g.TPCH_TABLES["orders"].row_count(sf)
-    chunk_orders = 1 << 18  # ~1M lineitem rows per chunk
-    acc = tuple(jnp.zeros(D, dtype=jnp.int64) for _ in range(6))
-    total_rows = 0
-    gen_time = 0.0
-    t0 = time.time()
-    first_compile = None
-    for lo in range(0, orders, chunk_orders):
-        hi = min(lo + chunk_orders, orders)
-        tg = time.time()
-        data = g.lineitem_for_orders(lo, hi, sf, cols)
-        n = len(data["l_returnflag"])
-        args = (data["l_returnflag"].astype(np.int32),
-                data["l_linestatus"].astype(np.int32),
-                data["l_quantity"].astype(np.int64),
-                data["l_extendedprice"].astype(np.int64),
-                data["l_discount"].astype(np.int64),
-                data["l_tax"].astype(np.int64),
-                data["l_shipdate"].astype(np.int32),
-                np.ones(n, dtype=bool))
-        gen_time += time.time() - tg
-        if first_compile is None:
-            tc = time.time()
-            # warm up compile on first chunk shape
-            acc = step(*args, acc)
-            jax.block_until_ready(acc)
-            first_compile = time.time() - tc
-            total_rows += n
-            continue
-        acc = step(*args, acc)
-        total_rows += n
-        if time.time() - t0 > seconds_budget:
-            break
-    jax.block_until_ready(acc)
-    wall = time.time() - t0
-    return total_rows, wall, gen_time, first_compile, acc
+    resident_rps, batch_rows, step_ms, _ = q1_resident(
+        sf, batch_rows=1 << 20 if quick else 1 << 22,
+        runs=5 if quick else 10)
+    stream = {}
+    try:
+        rows, wall, gen_stall, compile_s, _ = q1_stream(
+            sf, seconds_budget=seconds_budget)
+        stream = {
+            "rows": rows,
+            "wall_s": round(wall, 3),
+            "wall_rows_per_sec": round(rows / max(wall, 1e-9)),
+            "hostgen_stall_s": round(gen_stall, 3),
+            "first_compile_s": round(compile_s or 0, 2),
+        }
+    except Exception as e:
+        stream = {"error": repr(e)[:300]}
+    return resident_rps, batch_rows, step_ms, stream
 
 
-def bench_hand_query(builder_name: str, schema: str, seconds_budget: float):
+def bench_hand_query(builder_name: str, schema: str, seconds_budget: float,
+                     escalate_to: str = None, escalate_budget_s: float = 30.0,
+                     escalate_ratio: float = 100.0):
     """One rung of the hand-pipeline ladder (presto-benchmark
     AbstractOperatorBenchmark pattern): run the operator pipeline end to end,
-    count source rows processed per second of wall time."""
+    count source rows processed per second of wall time.
+
+    The rung first runs at `schema`; if the measured warm wall extrapolated to
+    `escalate_to` (x escalate_ratio rows) fits `escalate_budget_s`, it re-runs
+    there and reports that instead — a slow build never blows the round's time
+    budget but a fast one still gets measured at full scale.
+    """
     from presto_tpu.models import hand_queries as hq
 
-    def once():
+    def once(sch):
         if builder_name == "q3":
-            return len(hq.run_q3(schema))
-        return len(hq.run_query(getattr(hq, f"build_{builder_name}"), schema))
+            return len(hq.run_q3(sch))
+        return len(hq.run_query(getattr(hq, f"build_{builder_name}"), sch))
 
-    # warm-up run compiles every kernel in the pipeline
-    t0 = time.time()
-    rows0 = once()
-    compile_wall = time.time() - t0
-    runs, t0 = 0, time.time()
-    while True:
-        once()
-        runs += 1
-        if time.time() - t0 > seconds_budget or runs >= 5:
-            break
-    wall = (time.time() - t0) / runs
-    src_rows = hq.source_rows(builder_name, schema)
-    return {"rows_per_sec": round(src_rows / wall),
-            "source_rows": src_rows,
-            "wall_s": round(wall, 3),
-            "first_run_s": round(compile_wall, 3),
-            "output_rows": rows0}
+    def measure(sch):
+        t0 = time.time()
+        rows0 = once(sch)  # warm-up run compiles every kernel in the pipeline
+        compile_wall = time.time() - t0
+        runs, t0 = 0, time.time()
+        while True:
+            once(sch)
+            runs += 1
+            if time.time() - t0 > seconds_budget or runs >= 3:
+                break
+        wall = (time.time() - t0) / runs
+        src_rows = hq.source_rows(builder_name, sch)
+        return {"schema": sch,
+                "rows_per_sec": round(src_rows / wall),
+                "source_rows": src_rows,
+                "wall_s": round(wall, 3),
+                "first_run_s": round(compile_wall, 3),
+                "output_rows": rows0}
+
+    out = measure(schema)
+    if escalate_to and out["wall_s"] * escalate_ratio <= escalate_budget_s:
+        try:
+            out = measure(escalate_to)
+        except Exception as e:  # keep the small-schema number
+            out["escalate_error"] = repr(e)[:200]
+    return out
 
 
 def cpu_baseline_rows_per_sec(sample_rows: int = 2_000_000) -> float:
@@ -184,6 +235,8 @@ def main():
         os.environ["JAX_PLATFORMS"] = args.platform
         import jax
 
+        # env var alone is not enough when jax is pre-imported: the axon
+        # sitecustomize writes jax_platforms into jax's config at startup
         jax.config.update("jax_platforms", args.platform)
         platform = jax.devices()[0].platform
     else:
@@ -191,30 +244,30 @@ def main():
     detail = DETAIL
     detail["platform"] = platform
 
-    # ladder rungs: failures are recorded, not fatal
-    for rung, kw in (("q6", {"builder_name": "q6", "schema": "sf1"}),
-                     ("q3", {"builder_name": "q3", "schema": "sf1"})):
+    # ladder rungs: start small (tiny = sf0.01), escalate to sf1 only when the
+    # extrapolated sf1 wall fits the budget; failures recorded, not fatal
+    rung_budget = 5.0 if args.quick else 15.0
+    for rung, kw in (("q6", {"builder_name": "q6"}),
+                     ("q3", {"builder_name": "q3"})):
         try:
             detail[rung] = bench_hand_query(
-                seconds_budget=5.0 if args.quick else 20.0, **kw)
+                schema="tiny", seconds_budget=rung_budget,
+                escalate_to=None if args.quick else "sf1",
+                escalate_budget_s=30.0, **kw)
         except Exception as e:
             detail[rung] = {"error": repr(e)[:300]}
 
     baseline = cpu_baseline_rows_per_sec()
-    rows, wall, gen_time, compile_s, acc = bench_q1_kernel(
-        sf, seconds_budget=20.0 if args.quick else 90.0)
-    device_wall = max(wall - gen_time, 1e-9)  # generation is host-side data loading
-    rps = rows / device_wall
+    rps, batch_rows, step_ms, stream = bench_q1_kernel(
+        sf, seconds_budget=15.0 if args.quick else 45.0, quick=args.quick)
     detail.update({
-        "rows": rows,
-        "device_wall_s": round(device_wall, 3),
-        "total_wall_s": round(wall, 3),
-        "hostgen_s": round(gen_time, 3),
-        "first_compile_s": round(compile_s or 0, 2),
+        "resident_batch_rows": batch_rows,
+        "resident_step_ms": round(step_ms, 2),
+        "stream": stream,
         "cpu_baseline_rows_per_sec": round(baseline),
     })
     result = {
-        "metric": f"tpch_q1_sf{sf:g}_rows_per_sec",
+        "metric": "tpch_q1_warm_rows_per_sec",
         "value": round(rps),
         "unit": "rows/s",
         "vs_baseline": round(rps / baseline, 3),
@@ -233,3 +286,5 @@ if __name__ == "__main__":
                           "detail": {**DETAIL,
                                      "error": traceback.format_exc()[-1500:]}}))
         sys.exit(0)
+
+
