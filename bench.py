@@ -190,7 +190,9 @@ def bench_hand_query(builder_name: str, schema: str, seconds_budget: float,
                 "output_rows": rows0}
 
     out = measure(schema)
-    if escalate_to and out["wall_s"] * escalate_ratio <= escalate_budget_s:
+    # the escalated schema costs ~(warm-up + >=1 timed run + recompile slack)
+    # = >= 3x one run; guard on the full predicted spend, not a single run
+    if escalate_to and out["wall_s"] * escalate_ratio * 3 <= escalate_budget_s:
         try:
             out = measure(escalate_to)
         except Exception as e:  # keep the small-schema number

@@ -13,6 +13,7 @@ from typing import Dict, Iterator, List, Optional, Sequence
 
 import numpy as np
 
+from ...utils.batching import clamp_capacity
 from ...block import Block, Page
 from ...spi.connector import (ColumnHandle, ColumnMetadata, ColumnStatistics,
                               Connector, ConnectorFactory, ConnectorMetadata,
@@ -116,7 +117,9 @@ class TpcdsPageSource(ConnectorPageSource):
                  page_capacity: int):
         self.split = split
         self.columns = list(columns)
-        self.capacity = page_capacity
+        # clamp to split size — padded rows are real upload+compute waste
+        _name, _sf, lo, hi = split.payload
+        self.capacity = clamp_capacity(hi - lo, page_capacity)
         self._bytes = 0
 
     def __iter__(self) -> Iterator[Page]:

@@ -579,3 +579,70 @@ def generate_rows(table: str, row_lo: int, row_hi: int, sf: float,
     t = TPCH_TABLES[table]
     idx = np.arange(row_lo, row_hi, dtype=np.int64)
     return {name: t.column(name).gen(idx, sf) for name in columns}
+
+
+def _orderkey_hi(sf: float) -> int:
+    n = int(sf * 1_500_000)
+    return int(_order_key(np.asarray([max(n - 1, 0)]))[0])
+
+
+# Static value domains per (table, column), derived from the generator formulas
+# above — the narrow wire dtype must be a function of (column, sf) only, never
+# of a chunk's observed values, so every page of a scan shares one dtype
+# signature (one XLA trace). Bounds are inclusive and intentionally generous.
+NARROW_BOUNDS = {
+    ("lineitem", "l_orderkey"): lambda sf: (1, _orderkey_hi(sf)),
+    ("lineitem", "l_partkey"): lambda sf: (1, max(int(sf * 200_000), 1)),
+    ("lineitem", "l_suppkey"): lambda sf: (1, max(int(sf * 10_000), 1)),
+    ("lineitem", "l_linenumber"): lambda sf: (1, 7),
+    ("lineitem", "l_quantity"): lambda sf: (100, 5000),
+    ("lineitem", "l_extendedprice"): lambda sf: (90100, 10_495_000),
+    ("lineitem", "l_discount"): lambda sf: (0, 10),
+    ("lineitem", "l_tax"): lambda sf: (0, 8),
+    ("lineitem", "l_shipdate"): lambda sf: (MIN_DATE, MAX_ORDER_DATE + 121),
+    ("lineitem", "l_commitdate"): lambda sf: (MIN_DATE, MAX_ORDER_DATE + 90),
+    ("lineitem", "l_receiptdate"): lambda sf: (MIN_DATE, MAX_ORDER_DATE + 151),
+    ("orders", "o_orderkey"): lambda sf: (1, _orderkey_hi(sf)),
+    ("orders", "o_custkey"): lambda sf: (1, max(int(sf * 150_000), 1)),
+    ("orders", "o_totalprice"): lambda sf: (0, 80_000_000),
+    ("orders", "o_orderdate"): lambda sf: (MIN_DATE, MAX_ORDER_DATE),
+    ("orders", "o_shippriority"): lambda sf: (0, 0),
+    ("customer", "c_custkey"): lambda sf: (1, max(int(sf * 150_000), 1)),
+    ("customer", "c_nationkey"): lambda sf: (0, 24),
+    ("customer", "c_acctbal"): lambda sf: (-99999, 999999),
+    ("part", "p_partkey"): lambda sf: (1, max(int(sf * 200_000), 1)),
+    ("part", "p_size"): lambda sf: (1, 50),
+    ("part", "p_retailprice"): lambda sf: (90000, 209_900),
+    ("partsupp", "ps_partkey"): lambda sf: (1, max(int(sf * 200_000), 1)),
+    ("partsupp", "ps_suppkey"): lambda sf: (1, max(int(sf * 10_000), 1)),
+    ("partsupp", "ps_availqty"): lambda sf: (1, 9999),
+    ("partsupp", "ps_supplycost"): lambda sf: (100, 100_000),
+    ("supplier", "s_suppkey"): lambda sf: (1, max(int(sf * 10_000), 1)),
+    ("supplier", "s_nationkey"): lambda sf: (0, 24),
+    ("supplier", "s_acctbal"): lambda sf: (-99999, 999999),
+    ("nation", "n_nationkey"): lambda sf: (0, 24),
+    ("nation", "n_regionkey"): lambda sf: (0, 4),
+    ("region", "r_regionkey"): lambda sf: (0, 4),
+}
+
+
+def narrow_dtype(table: str, column: str, sf: float,
+                 dictionary=None) -> Optional[np.dtype]:
+    """Smallest wire dtype for a column, or None to keep the declared one.
+
+    Numeric columns use NARROW_BOUNDS; plain-Dictionary varchar codes are
+    bounded by the dictionary size (static). Wide/virtual dictionaries keep
+    their declared dtype.
+    """
+    fn = NARROW_BOUNDS.get((table, column))
+    if fn is not None:
+        lo, hi = fn(sf)
+    elif type(dictionary).__name__ == "Dictionary" and dictionary is not None:
+        lo, hi = 0, max(len(dictionary) - 1, 0)
+    else:
+        return None
+    for dt in (np.int8, np.int16, np.int32):
+        info = np.iinfo(dt)
+        if info.min <= lo and hi <= info.max:
+            return np.dtype(dt)
+    return None

@@ -40,7 +40,7 @@ def _lineitem_source(schema: str, columns: List[str], page_capacity: int,
     return _table_source(schema, "lineitem", columns, page_capacity, n_splits)
 
 
-def build_q6(schema: str = "sf1", page_capacity: int = 1 << 16):
+def build_q6(schema: str = "sf1", page_capacity: int = 1 << 20):
     """TPC-H Q6: sum(extendedprice*discount) under date/discount/quantity filter."""
     columns = ["l_shipdate", "l_discount", "l_quantity", "l_extendedprice"]
     source, layout = _lineitem_source(schema, columns, page_capacity)
@@ -64,7 +64,7 @@ def build_q6(schema: str = "sf1", page_capacity: int = 1 << 16):
     return Driver(ops), sink
 
 
-def build_q1(schema: str = "sf1", page_capacity: int = 1 << 16):
+def build_q1(schema: str = "sf1", page_capacity: int = 1 << 20):
     """TPC-H Q1: grouped aggregation over returnflag x linestatus (direct strategy)."""
     columns = ["l_returnflag", "l_linestatus", "l_quantity", "l_extendedprice",
                "l_discount", "l_tax", "l_shipdate"]
@@ -119,7 +119,7 @@ def _table_source(schema: str, table: str, columns: List[str], page_capacity: in
     return ConcatPageSource(sources), layout
 
 
-def build_q3(schema: str = "sf1", page_capacity: int = 1 << 16):
+def build_q3(schema: str = "sf1", page_capacity: int = 1 << 20):
     """TPC-H Q3: customer semi-> orders build -> lineitem probe -> group -> TopN.
 
     Physical plan (what the SQL planner will emit for the single-chip case):
@@ -192,7 +192,7 @@ def build_q3(schema: str = "sf1", page_capacity: int = 1 << 16):
     return [d1, d2, d3], sink
 
 
-def run_q3(schema: str = "sf1", page_capacity: int = 1 << 16):
+def run_q3(schema: str = "sf1", page_capacity: int = 1 << 20):
     drivers, sink = build_q3(schema, page_capacity)
     for d in drivers:  # build pipelines first, then probe (scheduler ordering)
         d.run_to_completion()

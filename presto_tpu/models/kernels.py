@@ -39,6 +39,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..utils.batching import take_rows
+
 # 1998-12-01 minus 90 days, as days since epoch (the Q1 shipdate cutoff)
 Q1_CUTOFF_DAYS = 10471
 Q1_N_FLAGS = 3    # l_returnflag domain: A N R
@@ -226,24 +228,8 @@ def q1_stream(sf: float, seconds_budget: float = 60.0,
     def assemble(n_target: int):
         """Take exactly n_target rows from pend (callers ensured enough)."""
         nonlocal pend_rows
-        taken = [[] for _ in range(7)]
-        got = 0
-        while got < n_target:
-            chunk = pend[0]
-            n = len(chunk[0])
-            need = n_target - got
-            if n <= need:
-                pend.pop(0)
-                for i in range(7):
-                    taken[i].append(chunk[i])
-                got += n
-            else:
-                for i in range(7):
-                    taken[i].append(chunk[i][:need])
-                pend[0] = tuple(c[need:] for c in chunk)
-                got = n_target
         pend_rows -= n_target
-        return tuple(np.concatenate(parts) for parts in taken)
+        return tuple(take_rows(pend, n_target))
 
     def dispatch(args, nrows):
         nonlocal acc, first_compile, total_rows

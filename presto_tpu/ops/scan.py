@@ -1,38 +1,120 @@
 """Table scan operator: connector page source -> device pages.
 
 Analogue of operator/TableScanOperator.java and the fused
-ScanFilterAndProjectOperator.java:55. The host-side generator/connector produces numpy
-pages; this operator uploads them to the device (`jax.device_put`), optionally through
-a fused filter+project processor so the very first device kernel already prunes —
-the host->HBM transfer is the analogue of the reference's page-source read, and
-fusion here minimizes the bytes that ever hit later pipeline stages.
+ScanFilterAndProjectOperator.java:55. The host-side generator/connector produces
+numpy pages; this operator uploads them to the device and runs the fused
+filter+project processor so the very first device kernel already prunes.
+
+TPU-first design of the host→HBM boundary (the streaming-scan wall):
+- connectors may emit NARROW dtypes (see tpch connector `_narrow_array`) — the
+  scan widens back to each block's declared type ON DEVICE, inside the same
+  jitted program as the filter/projections, so the narrow form only exists on
+  the wire;
+- a prefetch thread walks the page source and issues the (async) uploads ahead
+  of the driver, double-buffering host generation/IO against device compute —
+  the role `isBlocked` futures play in the reference's ScanFilterAndProject
+  laziness (operator/Driver.java:347-434 overlap of IO and compute).
 """
 from __future__ import annotations
 
+import queue
+import threading
 from typing import Iterator, List, Optional
 
 import jax
 import jax.numpy as jnp
 
-from ..block import Page
+from ..block import Block, Page
 from ..spi.connector import ConnectorPageSource
 from ..types import Type
 from .filter_project import PageProcessor
 from .operator import Operator, OperatorContext, OperatorFactory, timed
 
+_SENTINEL = object()
+
+
+def _widen_page(page: Page) -> Page:
+    """Device-side upcast of narrow wire blocks to their declared dtypes."""
+    blocks = []
+    for b in page.blocks:
+        want = jnp.dtype(b.type.np_dtype)
+        data = b.data if b.data.dtype == want else b.data.astype(want)
+        blocks.append(Block(b.type, data, b.nulls, b.dictionary))
+    return Page(tuple(blocks), page.mask.astype(jnp.bool_))
+
+
+class _Prefetcher:
+    """Walks a page source on a daemon thread, uploading pages ahead of the
+    consumer. Depth bounds in-flight host+device memory; errors surface on the
+    consuming thread."""
+
+    def __init__(self, source: ConnectorPageSource, device, depth: int = 2):
+        self._source = source
+        self._device = device
+        self._q: queue.Queue = queue.Queue(maxsize=depth)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        try:
+            for page in self._source:
+                if self._stop.is_set():
+                    return
+                page = jax.tree.map(
+                    lambda a: jax.device_put(a, self._device), page)
+                while not self._stop.is_set():
+                    try:
+                        self._q.put(page, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+        except BaseException as e:  # noqa: BLE001 - re-raised by next()
+            self._put_forever(("error", e))
+            return
+        self._put_forever(_SENTINEL)
+
+    def _put_forever(self, item):
+        while not self._stop.is_set():
+            try:
+                self._q.put(item, timeout=0.1)
+                return
+            except queue.Full:
+                continue
+
+    def next(self) -> Optional[Page]:
+        item = self._q.get()
+        if item is _SENTINEL:
+            return None
+        if isinstance(item, tuple) and len(item) == 2 and item[0] == "error":
+            raise item[1]
+        return item
+
+    def close(self):
+        self._stop.set()
+        # drain so a blocked producer can observe the stop flag and exit
+        while True:
+            try:
+                self._q.get_nowait()
+            except queue.Empty:
+                break
+
 
 class TableScanOperator(Operator):
     def __init__(self, context: OperatorContext, source: ConnectorPageSource,
                  types: List[Type], processor: Optional[PageProcessor] = None,
-                 device=None, ready=None):
+                 device=None, ready=None, process_fn=None, prefetch: bool = True):
         super().__init__(context)
         self.source = source
-        self._iter: Optional[Iterator[Page]] = None
         self._types = types
         self.processor = processor
         self.device = device
+        self._process_fn = process_fn  # shared jitted widen(+filter/project)
         self._ready = ready  # None = always ready; else poll before reading
         self._done = False
+        self._prefetch_enabled = prefetch
+        self._prefetcher: Optional[_Prefetcher] = None
+        self._iter: Optional[Iterator[Page]] = None
 
     def is_blocked(self):
         """A replay scan (union buffer) blocks until its producers finish —
@@ -55,28 +137,46 @@ class TableScanOperator(Operator):
     def add_input(self, page: Page) -> None:
         raise RuntimeError("table scan takes no input")
 
-    @timed("get_output_ns")
-    def get_output(self) -> Optional[Page]:
-        if self._done:
-            return None
+    def _next_uploaded(self) -> Optional[Page]:
+        if self._prefetch_enabled:
+            if self._prefetcher is None:
+                self._prefetcher = _Prefetcher(self.source, self.device)
+            return self._prefetcher.next()
         if self._iter is None:
             self._iter = iter(self.source)
         try:
             page = next(self._iter)
         except StopIteration:
+            return None
+        return jax.tree.map(lambda a: jax.device_put(a, self.device), page)
+
+    @timed("get_output_ns")
+    def get_output(self) -> Optional[Page]:
+        if self._done:
+            return None
+        page = self._next_uploaded()
+        if page is None:
             self._done = True
             self.source.close()
             return None
-        # upload: host numpy blocks -> device arrays (async under XLA)
-        page = jax.tree.map(lambda a: jax.device_put(a, self.device), page)
         self.context.record_input(page, page.capacity)
-        if self.processor is not None:
-            page = self.processor(page)
+        if self._process_fn is not None:
+            page = self._process_fn(page)
+        elif self.processor is not None:
+            page = self.processor(_widen_page(page))
+        else:
+            page = _widen_page(page)
         self.context.record_output(page, page.capacity)
         return page
 
     def is_finished(self) -> bool:
         return self._done or self._finishing
+
+    def close(self) -> None:
+        if self._prefetcher is not None:
+            self._prefetcher.close()
+            self._prefetcher = None
+        super().close()
 
 
 class TableScanOperatorFactory(OperatorFactory):
@@ -87,7 +187,8 @@ class TableScanOperatorFactory(OperatorFactory):
     several drivers of one worker can split a multi-source scan."""
 
     def __init__(self, operator_id: int, page_sources, types: List[Type],
-                 processor: Optional[PageProcessor] = None, ready=None):
+                 processor: Optional[PageProcessor] = None, ready=None,
+                 prefetch: bool = True):
         super().__init__(operator_id, "TableScan")
         if callable(page_sources):
             self._sources_fn = page_sources
@@ -98,6 +199,14 @@ class TableScanOperatorFactory(OperatorFactory):
         self._processor = processor
         self._ready = ready  # worker -> poll-able "producers finished?"
         self._remaining = {}
+        self._prefetch = prefetch
+        # one shared jit for widen+filter+project: a single kernel per page,
+        # shared across all drivers/workers of this factory (one compile)
+        if processor is not None:
+            self._process_fn = jax.jit(
+                lambda p: processor._process(_widen_page(p)))
+        else:
+            self._process_fn = jax.jit(_widen_page)
 
     def create_operator(self, worker: int = 0) -> Operator:
         if worker not in self._remaining:
@@ -105,4 +214,6 @@ class TableScanOperatorFactory(OperatorFactory):
         src = self._remaining[worker].pop(0)
         return TableScanOperator(self.context(worker), src, self._types,
                                  self._processor,
-                                 ready=self._ready(worker) if self._ready else None)
+                                 ready=self._ready(worker) if self._ready else None,
+                                 process_fn=self._process_fn,
+                                 prefetch=self._prefetch)

@@ -61,7 +61,7 @@ class LocalQueryRunner:
 
     def __init__(self, session: Optional[Session] = None,
                  catalogs: Optional[CatalogManager] = None,
-                 page_capacity: int = 1 << 14):
+                 page_capacity: int = 1 << 18):
         if catalogs is None:
             catalogs = CatalogManager()
             catalogs.register("tpch", TpchConnector("tpch"))

@@ -1,0 +1,53 @@
+"""Host-side column-chunk re-batching shared by page sources and kernels.
+
+The streaming scan and the bench kernel both need "take exactly N rows off a
+pending list of column chunks" — one implementation so partial-chunk view
+semantics can never diverge between them.
+"""
+from __future__ import annotations
+
+from typing import List, Sequence
+
+import numpy as np
+
+
+def take_rows(pend: List[Sequence[np.ndarray]], count: int) -> List[np.ndarray]:
+    """Remove exactly `count` rows from the front of `pend` (in place).
+
+    `pend` is a list of chunks; each chunk is an indexable sequence of
+    equal-length column arrays. Returns one concatenated array per column.
+    Callers must ensure `pend` holds at least `count` rows.
+    """
+    if not pend:
+        return []
+    n_cols = len(pend[0])
+    taken: List[List[np.ndarray]] = [[] for _ in range(n_cols)]
+    got = 0
+    while got < count:
+        chunk = pend[0]
+        n = len(chunk[0])
+        need = count - got
+        if n <= need:
+            pend.pop(0)
+            for i in range(n_cols):
+                taken[i].append(chunk[i])
+            got += n
+        else:
+            for i in range(n_cols):
+                taken[i].append(chunk[i][:need])
+            pend[0] = [c[need:] for c in chunk]
+            got = count
+    return [parts[0] if len(parts) == 1 else np.concatenate(parts)
+            for parts in taken]
+
+
+def clamp_capacity(est_rows: int, page_capacity: int, floor: int = 64) -> int:
+    """Clamp a page capacity to the expected row count's pow2 bucket.
+
+    Padded rows are real upload+compute waste on small splits; pow2 bucketing
+    keeps the shape set (and thus XLA recompiles) small.
+    """
+    if est_rows <= 0:
+        return min(page_capacity, floor)
+    cap = 1 << max(int(est_rows - 1).bit_length(), floor.bit_length() - 1)
+    return min(page_capacity, cap)
