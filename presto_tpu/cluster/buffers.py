@@ -13,6 +13,7 @@ future the same way)."""
 from __future__ import annotations
 
 import threading
+import time
 from typing import Dict, List, Optional, Tuple
 
 PARTITIONED = "PARTITIONED"
@@ -32,6 +33,8 @@ class ClientBuffer:
 
     # producer side (caller holds the cv lock via OutputBuffer)
     def enqueue_locked(self, frame: bytes) -> int:
+        if self._aborted:
+            return 0  # consumer is gone: drop, never accumulate unacked bytes
         token = self._next_token
         self._frames.append((token, frame))
         self._next_token += 1
@@ -83,28 +86,35 @@ class OutputBuffer:
 
     # ------------------------------------------------------------- producer
 
-    def enqueue(self, buffer_id: int, frame: bytes,
-                timeout_s: float = 300.0) -> None:
-        """Blocks while the buffer is over its byte bound (backpressure)."""
-        with self._cv:
-            deadline = None
-            while self._bytes + len(frame) > self._max_bytes and self._bytes:
-                if self._failed:
-                    raise RuntimeError(f"output buffer failed: {self._failed}")
-                import time as _t
-                if deadline is None:
-                    deadline = _t.monotonic() + timeout_s
-                if not self._cv.wait(timeout=1.0) and _t.monotonic() > deadline:
-                    raise TimeoutError("output buffer backpressure timeout")
+    def _wait_for_space_locked(self, need: int, timeout_s: float) -> None:
+        """Bounded producer wait while the buffer is over its byte bound
+        (backpressure; the reference's OutputBuffers block the same way).
+        Caller holds the cv lock."""
+        deadline = None
+        while self._bytes + need > self._max_bytes and self._bytes:
             if self._failed:
                 raise RuntimeError(f"output buffer failed: {self._failed}")
+            if deadline is None:
+                deadline = time.monotonic() + timeout_s
+            if not self._cv.wait(timeout=1.0) and time.monotonic() > deadline:
+                raise TimeoutError("output buffer backpressure timeout")
+        if self._failed:
+            raise RuntimeError(f"output buffer failed: {self._failed}")
+
+    def enqueue(self, buffer_id: int, frame: bytes,
+                timeout_s: float = 300.0) -> None:
+        with self._cv:
+            self._wait_for_space_locked(len(frame), timeout_s)
             self._bytes += self._buffers[buffer_id].enqueue_locked(frame)
             self._cv.notify_all()
 
-    def enqueue_broadcast(self, frame: bytes) -> None:
+    def enqueue_broadcast(self, frame: bytes, timeout_s: float = 300.0) -> None:
+        """A broadcast producer retains one copy per live consumer, so
+        outrunning consumers would grow memory without bound (the reference's
+        BroadcastOutputBuffer blocks the producer at the memory bound too)."""
         with self._cv:
-            if self._failed:
-                raise RuntimeError(f"output buffer failed: {self._failed}")
+            need = len(frame) * max(len(self._buffers), 1)
+            self._wait_for_space_locked(need, timeout_s)
             for b in self._buffers:
                 self._bytes += b.enqueue_locked(frame)
             self._cv.notify_all()

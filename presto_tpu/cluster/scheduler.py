@@ -13,7 +13,6 @@ Analogues (/root/reference/presto-main):
 from __future__ import annotations
 
 import dataclasses
-import pickle
 import time
 import urllib.error
 import urllib.request
@@ -22,6 +21,7 @@ from typing import Dict, List, Optional
 from ..metadata import Session
 from ..sql.planner.fragmenter import Fragment, SINGLE_PART, SubPlan
 from ..sql.planner.plan import RemoteSourceNode
+from . import codec
 from .discovery import NodeInfo
 from .task import (DONE_STATES, FAILED, FINISHED, TaskInfo,
                    TaskUpdateRequest)
@@ -37,16 +37,27 @@ class RemoteTask:
         self.info: Optional[TaskInfo] = None
 
     def create(self, request: TaskUpdateRequest, retries: int = 3) -> TaskInfo:
-        body = pickle.dumps(request)
+        body = codec.dumps(request)
         last: Optional[Exception] = None
         for attempt in range(retries):
             req = urllib.request.Request(
                 self.location, data=body, method="POST",
-                headers={"Content-Type": "application/octet-stream"})
+                headers={"Content-Type": "application/json"})
             try:
                 with urllib.request.urlopen(req, timeout=30.0) as resp:
-                    self.info = pickle.loads(resp.read())
+                    self.info = codec.loads(resp.read())
                     return self.info
+            except urllib.error.HTTPError as e:
+                # 4xx = the worker REJECTED the request (bad body / conflicting
+                # task content) — deterministic, so surface its diagnostic body
+                # instead of retrying it as if it were a network blip
+                detail = e.read().decode("utf-8", "replace")[:500]
+                if 400 <= e.code < 500:
+                    raise RuntimeError(
+                        f"worker {self.node.node_id} rejected task "
+                        f"{self.task_id} ({e.code}): {detail}") from None
+                last = RuntimeError(f"HTTP {e.code}: {detail}")
+                time.sleep(0.2 * (attempt + 1))
             except (urllib.error.URLError, OSError) as e:
                 last = e
                 time.sleep(0.2 * (attempt + 1))
@@ -57,7 +68,7 @@ class RemoteTask:
         req = urllib.request.Request(self.location, method="GET")
         try:
             with urllib.request.urlopen(req, timeout=10.0) as resp:
-                self.info = pickle.loads(resp.read())
+                self.info = codec.loads(resp.read())
                 return self.info
         except (urllib.error.URLError, OSError):
             return None  # judged by the failure detector, not one lost poll

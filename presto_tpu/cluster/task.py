@@ -20,11 +20,13 @@ import dataclasses
 import threading
 import time
 import traceback
+import uuid
 from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
 from ..block import Dictionary, Page
+from . import codec
 from ..exec.local_planner import LocalExecutionPlanner
 from ..exec.task_executor import TaskExecutor
 from ..metadata import MetadataManager, Session
@@ -69,10 +71,11 @@ def partition_ids_np(key: np.ndarray, n_parts: int) -> np.ndarray:
     return (mix64_np(key) % np.uint64(n_parts)).astype(np.int32)
 
 
+@codec.register
 @dataclasses.dataclass
 class TaskUpdateRequest:
-    """POST /v1/task/{taskId} body (pickled) — the fragment+wiring a worker
-    needs (server/TaskUpdateRequest.java analogue)."""
+    """POST /v1/task/{taskId} body (JSON via cluster/codec) — the
+    fragment+wiring a worker needs (server/TaskUpdateRequest.java analogue)."""
     task_id: str
     query_id: str
     subplan: SubPlan                      # the WHOLE query's fragments
@@ -85,12 +88,14 @@ class TaskUpdateRequest:
     output_buffers: int = 1               # consumer count for this task's output
 
 
+@codec.register
 @dataclasses.dataclass
 class TaskInfo:
     task_id: str
     state: str
     error: Optional[dict] = None
     rows_out: int = 0
+    instance_id: str = ""
 
 
 def plan_subplan(subplan: SubPlan, metadata: MetadataManager, session: Session,
@@ -237,6 +242,9 @@ class SqlTask:
         self.request = request
         self.metadata = metadata
         self.task_id = request.task_id
+        # a recreated task restarts result tokens at 0; the instance id lets a
+        # consumer detect that (reference: PRESTO_TASK_INSTANCE_ID header)
+        self.instance_id = uuid.uuid4().hex
         self.state = PLANNED
         self.error: Optional[dict] = None
         self.created = time.time()
@@ -338,7 +346,8 @@ class SqlTask:
     def info(self) -> TaskInfo:
         rows = self._sink.operators[0].rows_out \
             if self._sink and self._sink.operators else 0
-        return TaskInfo(self.task_id, self.state, self.error, rows)
+        return TaskInfo(self.task_id, self.state, self.error, rows,
+                        self.instance_id)
 
 
 class WorkerTaskManager:
@@ -359,6 +368,15 @@ class WorkerTaskManager:
                 self.tasks[request.task_id] = task
                 task.start()
                 self._cleanup_locked()
+            elif (request.query_id, request.fragment_id,
+                  request.worker_index) != (task.request.query_id,
+                                            task.request.fragment_id,
+                                            task.request.worker_index):
+                # an update must describe the SAME work; silently returning
+                # the old task's info would strand a rescheduled fragment
+                raise ValueError(
+                    f"task {request.task_id} exists with different content "
+                    f"(instance {task.instance_id})")
         return task.info()
 
     def get(self, task_id: str) -> Optional[SqlTask]:

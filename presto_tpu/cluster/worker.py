@@ -3,9 +3,9 @@
 Analogue of the worker role of server/PrestoServer.java + server/TaskResource
 (/root/reference/presto-main/.../server/TaskResource.java:84,122,245):
 
-  POST   /v1/task/{taskId}                         create/update (pickled
+  POST   /v1/task/{taskId}                         create/update (JSON
                                                    TaskUpdateRequest body)
-  GET    /v1/task/{taskId}                         TaskInfo (pickled)
+  GET    /v1/task/{taskId}                         TaskInfo (JSON)
   DELETE /v1/task/{taskId}[?abort=true]            cancel/abort
   GET    /v1/task/{taskId}/results/{buf}/{token}   pull one page frame
          (binary body; X-Next-Token / X-Complete headers; ?wait= long-poll)
@@ -14,12 +14,14 @@ Analogue of the worker role of server/PrestoServer.java + server/TaskResource
   PUT    /v1/info/state                            "SHUTTING_DOWN" drains
                                                    (GracefulShutdownHandler.java:43)
 
-Control-plane bodies are pickled — both ends run this same binary, the
+Control-plane bodies are structured JSON (cluster/codec.py allow-list codec —
+the reference uses JSON/SMILE on the same boundary,
+server/InternalCommunicationConfig.java:92-98; pickle would be remote code
+execution for anything that can reach the port). Both ends run this binary, the
 reference's JSON/SMILE codec pair plays the equivalent role across its JVMs.
 Workers announce themselves to the coordinator (discovery.Announcer)."""
 from __future__ import annotations
 
-import pickle
 import re
 import threading
 import time
@@ -28,6 +30,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
 from ..metadata import CatalogManager, MetadataManager
+from . import codec
 from .task import DONE_STATES, TaskUpdateRequest, WorkerTaskManager
 
 ACTIVE = "ACTIVE"
@@ -64,9 +67,9 @@ class _WorkerHandler(BaseHTTPRequestHandler):
         if body:
             self.wfile.write(body)
 
-    def _send_pickle(self, obj, status: int = 200) -> None:
-        self._send(pickle.dumps(obj), status,
-                   [("Content-Type", "application/octet-stream")])
+    def _send_codec(self, obj, status: int = 200) -> None:
+        self._send(codec.dumps(obj), status,
+                   [("Content-Type", "application/json")])
 
     # ------------------------------------------------------------ endpoints
 
@@ -77,9 +80,18 @@ class _WorkerHandler(BaseHTTPRequestHandler):
         if self.worker.state == SHUTTING_DOWN:
             return self._send(b"shutting down", 503)
         length = int(self.headers.get("Content-Length", 0))
-        request: TaskUpdateRequest = pickle.loads(self.rfile.read(length))
-        info = self.worker.tasks.create_or_update(request)
-        self._send_pickle(info)
+        body = self.rfile.read(length)
+        try:
+            request: TaskUpdateRequest = codec.loads(body)
+        except Exception as e:  # non-JSON / unregistered class: reject
+            return self._send(f"bad task body: {e}".encode(), 400)
+        if not isinstance(request, TaskUpdateRequest):
+            return self._send(b"bad task body: not a TaskUpdateRequest", 400)
+        try:
+            info = self.worker.tasks.create_or_update(request)
+        except ValueError as e:
+            return self._send(str(e).encode(), 409)
+        self._send_codec(info)
 
     def do_GET(self) -> None:  # noqa: N802
         path, _, query = self.path.partition("?")
@@ -89,22 +101,27 @@ class _WorkerHandler(BaseHTTPRequestHandler):
             if task is None:
                 return self._send(b"no such task", 404)
             wait = float(urllib.parse.parse_qs(query).get("wait", ["1.0"])[0])
+            buffer_id = int(m.group(2))
+            if buffer_id >= task.output.n_buffers:
+                return self._send(b"no such buffer", 404)
             try:
                 frame, nxt, complete = task.output.get(
-                    int(m.group(2)), int(m.group(3)), wait_s=min(wait, 30.0))
-            except RuntimeError as e:
+                    buffer_id, int(m.group(3)), wait_s=min(wait, 30.0))
+            except Exception as e:  # an aborted connection would look like a
+                # transient network error to PageBufferClient and retry for 60s
                 return self._send(str(e).encode(), 500)
             return self._send(
                 frame or b"", 200,
                 [("Content-Type", "application/octet-stream"),
                  ("X-Next-Token", str(nxt)),
-                 ("X-Complete", "true" if complete else "false")])
+                 ("X-Complete", "true" if complete else "false"),
+                 ("X-Task-Instance-Id", task.instance_id)])
         m = re.fullmatch(r"/v1/task/([^/]+)", path)
         if m:
             task = self.worker.tasks.get(m.group(1))
             if task is None:
                 return self._send(b"no such task", 404)
-            return self._send_pickle(task.info())
+            return self._send_codec(task.info())
         if path.rstrip("/") == "/v1/status":
             import json
             active = sum(1 for t in self.worker.tasks.tasks.values()
@@ -155,7 +172,11 @@ class WorkerServer:
                  catalogs: Optional[CatalogManager] = None,
                  coordinator_uri: Optional[str] = None,
                  node_id: Optional[str] = None,
-                 host: str = "127.0.0.1"):
+                 host: str = "127.0.0.1",
+                 announce_host: Optional[str] = None):
+        """`host` is the bind address; `announce_host` is what peers dial
+        (defaults to `host`) — a worker binding 0.0.0.0 must announce a
+        routable address, not the wildcard."""
         catalogs = catalogs or default_catalogs()
         self.metadata = MetadataManager(catalogs)
         self.tasks = WorkerTaskManager(self.metadata)
@@ -164,7 +185,22 @@ class WorkerServer:
         handler = type("BoundWorkerHandler", (_WorkerHandler,), {"worker": self})
         self.httpd = ThreadingHTTPServer((host, port), handler)
         self.port = self.httpd.server_address[1]
-        self.uri = f"http://{host}:{self.port}"
+        announce = announce_host or host
+        if announce == "0.0.0.0":
+            # gethostbyname(hostname) often maps to 127.0.1.1 via /etc/hosts;
+            # a routed UDP socket's source address is the reachable interface
+            import socket
+            probe = coordinator_uri or "http://8.8.8.8"
+            target = urllib.parse.urlsplit(probe).hostname or "8.8.8.8"
+            s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+            try:
+                s.connect((target, 80))  # no packets sent; just routes
+                announce = s.getsockname()[0]
+            except OSError:
+                announce = socket.gethostbyname(socket.gethostname())
+            finally:
+                s.close()
+        self.uri = f"http://{announce}:{self.port}"
         self.node_id = node_id or f"worker-{self.port}"
         self._announcer = None
         if coordinator_uri:
@@ -203,10 +239,18 @@ def main(argv=None) -> None:
 
     ap = argparse.ArgumentParser(prog="presto-tpu-worker")
     ap.add_argument("--port", type=int, default=8081)
+    ap.add_argument("--host", default="127.0.0.1",
+                    help="bind address (0.0.0.0 to serve other hosts)")
+    ap.add_argument("--announce-host", default=None,
+                    help="address peers should dial (defaults to --host, or "
+                         "this host's name when binding 0.0.0.0)")
+    ap.add_argument("--node-id", default=None)
     ap.add_argument("--coordinator", default=None,
                     help="coordinator URI to announce to")
     args = ap.parse_args(argv)
-    server = WorkerServer(port=args.port, coordinator_uri=args.coordinator)
+    server = WorkerServer(port=args.port, coordinator_uri=args.coordinator,
+                          host=args.host, announce_host=args.announce_host,
+                          node_id=args.node_id)
     if server._announcer:
         server._announcer.start()
     print(f"presto-tpu worker {server.node_id} listening on :{server.port}")
