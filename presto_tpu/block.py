@@ -41,11 +41,14 @@ class Dictionary:
     the same role in the reference: spi/block/DictionaryBlock.java).
     """
 
-    __slots__ = ("values", "_index")
+    __slots__ = ("values", "_index", "_ranks", "_order", "_sorted")
 
     def __init__(self, values: Sequence[str]):
         self.values = np.asarray(values, dtype=object)
         self._index = None
+        self._ranks = None
+        self._order = None
+        self._sorted = None
 
     def __len__(self):
         return len(self.values)
@@ -93,10 +96,28 @@ class Dictionary:
 
     # sort_keys: rank of each code in lexicographic order, for ORDER BY on varchar.
     def sort_keys(self) -> np.ndarray:
-        order = np.argsort(self.values.astype(str), kind="stable")
-        ranks = np.empty(len(self.values), dtype=np.int32)
-        ranks[order] = np.arange(len(self.values), dtype=np.int32)
-        return ranks
+        if self._ranks is None or len(self._ranks) != len(self.values):
+            order = np.argsort(self.values.astype(str), kind="stable")
+            ranks = np.empty(len(self.values), dtype=np.int32)
+            ranks[order] = np.arange(len(self.values), dtype=np.int32)
+            self._ranks = ranks
+            self._order = order.astype(np.int32)
+        return self._ranks
+
+    def sort_order(self) -> np.ndarray:
+        """Inverse of sort_keys: rank -> code (argsort of the values)."""
+        self.sort_keys()
+        return self._order
+
+    def is_sorted(self) -> bool:
+        """True when codes ARE lexicographic ranks (ingest-built dictionaries
+        are sorted; INSERT's Dictionary.extend appends, breaking this —
+        min/max over codes is only valid when this holds)."""
+        if self._sorted is None or self._sorted[1] != len(self.values):
+            v = self.values.astype(str)
+            ok = bool(np.all(v[:-1] <= v[1:])) if len(v) > 1 else True
+            self._sorted = (ok, len(self.values))
+        return self._sorted[0]
 
     def __hash__(self):
         return id(self)

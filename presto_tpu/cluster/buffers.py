@@ -113,7 +113,8 @@ class OutputBuffer:
         outrunning consumers would grow memory without bound (the reference's
         BroadcastOutputBuffer blocks the producer at the memory bound too)."""
         with self._cv:
-            need = len(frame) * max(len(self._buffers), 1)
+            live = sum(1 for b in self._buffers if not b._aborted)
+            need = len(frame) * max(live, 1)
             self._wait_for_space_locked(need, timeout_s)
             for b in self._buffers:
                 self._bytes += b.enqueue_locked(frame)

@@ -43,6 +43,7 @@ class PageBufferClient:
         self.token = 0
         self.complete = False
         self._error_since: Optional[float] = None
+        self._instance_id: Optional[str] = None
 
     def poll(self, timeout_s: float = 10.0) -> Optional[bytes]:
         """One GET; returns a frame or None (no data yet / now complete)."""
@@ -53,7 +54,20 @@ class PageBufferClient:
             with urllib.request.urlopen(req, timeout=timeout_s + 15.0) as resp:
                 nxt = int(resp.headers.get("X-Next-Token", self.token))
                 complete = resp.headers.get("X-Complete") == "true"
+                instance = resp.headers.get("X-Task-Instance-Id")
                 frame = resp.read()
+            if instance:
+                if self._instance_id is None:
+                    self._instance_id = instance
+                elif self._instance_id != instance:
+                    # the producer task was RECREATED: its tokens restarted at
+                    # 0, so our token would silently skip/duplicate frames —
+                    # fail the query loudly (reference: PRESTO_TASK_INSTANCE_ID
+                    # mismatch aborts the page client)
+                    raise RuntimeError(
+                        f"exchange source {self.location} was recreated "
+                        f"(instance {self._instance_id} -> {instance}); "
+                        f"stream tokens are no longer valid")
         except urllib.error.HTTPError as e:
             if e.code == 404:
                 # producer task not created yet (all-at-once scheduling may

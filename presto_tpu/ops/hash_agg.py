@@ -63,7 +63,18 @@ def _call_contributions(calls, page: Page, from_intermediate: bool):
             for ch in call.intermediate_channels:
                 contribs.append(datas[ch])
         else:
-            args = tuple(datas[c] for c in call.input_channels)
+            args = []
+            for c in call.input_channels:
+                a = datas[c]
+                d = page.blocks[c].dictionary
+                if call.function.name in ("min", "max") and d is not None \
+                        and not d.is_sorted():
+                    # codes of an INSERT-extended dictionary are append-ordered,
+                    # not lexicographic — compare RANKS instead; the output
+                    # path maps the winning rank back to a code
+                    a = jnp.asarray(d.sort_keys())[a]
+                args.append(a)
+            args = tuple(args)
             m = mask
             for c in call.input_channels:
                 if page.blocks[c].nulls is not None:
@@ -545,6 +556,14 @@ class HashAggregationOperator(Operator):
                 nulls = None
                 if isinstance(out, tuple):  # (data, null_mask) contract
                     out, nulls = out
+                d = call.output_dictionary
+                if call.function.name in ("min", "max") and d is not None \
+                        and not d.is_sorted():
+                    # states held sort RANKS (see _call_contributions): map the
+                    # winning rank back to its dictionary code (empty groups
+                    # clip to an arbitrary code; their null flag masks them)
+                    order = jnp.asarray(d.sort_order())
+                    out = order[jnp.clip(out, 0, len(order) - 1)]
                 out_cols.append((call.function.output_type,
                                  jnp.asarray(out, dtype=call.function.output_type.np_dtype),
                                  call.output_dictionary, nulls))
