@@ -639,7 +639,7 @@ class LocalExecutionPlanner:
                     scale_div = 10 ** at.scale
             out_dict = None
             if call.name in ("min", "max", "lag", "lead", "first_value",
-                             "last_value") and arg_chs and \
+                             "last_value", "nth_value") and arg_chs and \
                     src.dicts[arg_chs[0]] is not None:
                 out_dict = src.dicts[arg_chs[0]]
             call_channels.append((call.name, arg_chs, call.frame_mode,

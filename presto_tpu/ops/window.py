@@ -87,6 +87,9 @@ def _window_kernel(keys, args_and_nulls, mask, calls, n_keys, n_ord):
     peer_end_rev = jnp.roll(new_peer, -1).at[-1].set(True)[rev]
     peer_last = _seg_scan("max", jnp.where(peer_end_rev, n - 1 - pos, 0),
                           peer_end_rev)[rev]
+    part_end_rev = jnp.roll(new_part, -1).at[-1].set(True)[rev]
+    part_last = _seg_scan("max", jnp.where(part_end_rev, n - 1 - pos, 0),
+                          part_end_rev)[rev]
     part_id = jnp.cumsum(new_part.astype(jnp.int64)) - 1
 
     outs = []
@@ -103,6 +106,42 @@ def _window_kernel(keys, args_and_nulls, mask, calls, n_keys, n_ord):
         if name == "dense_rank":
             vals = _seg_scan("sum", new_peer.astype(jnp.int64), new_part)
             outs.append((vals[inv], None))
+            continue
+        if name == "ntile":
+            # first (s mod b) buckets get ceil(s/b) rows (spec 6.10 NTILE)
+            s = part_last - part_start + 1
+            r = pos - part_start
+            b = jnp.int64(offset)
+            q, rem = s // b, s % b
+            big = rem * (q + 1)
+            vals = jnp.where(
+                q == 0, r + 1,
+                jnp.where(r < big, r // jnp.maximum(q + 1, 1) + 1,
+                          rem + (r - big) // jnp.maximum(q, 1) + 1))
+            outs.append((vals[inv], None))
+            continue
+        if name == "percent_rank":
+            s = part_last - part_start + 1
+            vals = (peer_start - part_start).astype(jnp.float64) / \
+                jnp.maximum(s - 1, 1).astype(jnp.float64)
+            outs.append((vals[inv], None))
+            continue
+        if name == "cume_dist":
+            s = part_last - part_start + 1
+            vals = (peer_last - part_start + 1).astype(jnp.float64) / \
+                s.astype(jnp.float64)
+            outs.append((vals[inv], None))
+            continue
+        if name == "nth_value":
+            v = cargs[0][order]
+            vn = cargs[1][order] if cargs[1] is not None else None
+            target = part_start + jnp.int64(offset - 1)
+            frame_end = peer_last if frame_mode == "range" else pos
+            oob = target > frame_end  # beyond frame (incl. beyond partition)
+            clipped = jnp.clip(target, 0, n - 1)
+            vals = v[clipped]
+            nul = oob if vn is None else (vn[clipped] | oob)
+            outs.append((vals[inv], nul[inv]))
             continue
         if name in ("lag", "lead", "first_value", "last_value"):
             v = cargs[0][order]

@@ -12,6 +12,7 @@ ParsingException (presto-parser/.../parser/ParsingException.java).
 """
 from __future__ import annotations
 
+import dataclasses
 import re
 from typing import List, Optional, Tuple
 
@@ -41,7 +42,7 @@ KEYWORDS = {
     "set", "create", "table", "row", "unnest", "ordinality", "coalesce", "filter",
     "substring", "for", "count", "exists", "insert", "into", "drop",
     "over", "partition", "rows", "range", "unbounded", "preceding", "current",
-    "following",
+    "following", "grouping", "sets", "rollup", "cube",
 }
 
 _TOKEN_RE = re.compile(r"""
@@ -324,10 +325,9 @@ class _Parser:
         order_by, limit = self.parse_order_limit()
         # if the body is a bare QuerySpecification, fold outer order/limit into it
         if isinstance(body, t.QuerySpecification) and (order_by or limit is not None):
-            body = t.QuerySpecification(
-                body.select_items, body.distinct, body.from_, body.where,
-                body.group_by, body.having, order_by or body.order_by,
-                limit if limit is not None else body.limit)
+            body = dataclasses.replace(
+                body, order_by=order_by or body.order_by,
+                limit=limit if limit is not None else body.limit)
             order_by, limit = (), None
         return t.Query(body, with_, order_by, limit)
 
@@ -385,9 +385,8 @@ class _Parser:
             order_by, limit = self.parse_order_limit()
             if order_by or limit is not None:
                 if isinstance(body, t.QuerySpecification):
-                    body = t.QuerySpecification(
-                        body.select_items, body.distinct, body.from_, body.where,
-                        body.group_by, body.having, order_by, limit)
+                    body = dataclasses.replace(
+                        body, order_by=order_by, limit=limit)
                 else:
                     # ordered/limited set operation or VALUES as a term: wrap as
                     # a subquery so the ordering binds to the whole parenthesized
@@ -428,12 +427,10 @@ class _Parser:
         where = self.parse_expr() if self.accept_kw("where") else None
 
         group_by: Tuple[t.Expression, ...] = ()
+        grouping_sets: Optional[Tuple[Tuple[int, ...], ...]] = None
         if self.accept_kw("group"):
             self.expect_kw("by")
-            gb = [self.parse_expr()]
-            while self.accept_op(","):
-                gb.append(self.parse_expr())
-            group_by = tuple(gb)
+            group_by, grouping_sets = self.parse_group_by_clause()
 
         having = self.parse_expr() if self.accept_kw("having") else None
         # ORDER BY / LIMIT are NOT part of a query term: in
@@ -441,7 +438,74 @@ class _Parser:
         # whole set operation (parse_query / the parenthesized-term branch
         # attach them at the right level)
         return t.QuerySpecification(tuple(items), distinct, from_, where, group_by,
-                                    having, (), None)
+                                    having, (), None, grouping_sets)
+
+    def parse_group_by_clause(self):
+        """GROUP BY exprs | GROUPING SETS ((..),..) | ROLLUP(..) | CUBE(..).
+
+        Returns (key_exprs, grouping_sets) where grouping_sets is a tuple of
+        index-tuples into key_exprs (None for a plain GROUP BY). Reference:
+        SqlBase.g4 groupingElement / sql/analyzer GroupingOperationRewriter.
+        """
+        def parse_expr_list():
+            self.expect_op("(")
+            if self.accept_op(")"):
+                return []
+            out = [self.parse_expr()]
+            while self.accept_op(","):
+                out.append(self.parse_expr())
+            self.expect_op(")")
+            return out
+
+        def canon(sets_exprs):
+            keys: List[t.Expression] = []
+            sets = []
+            for exprs in sets_exprs:
+                idxs = []
+                for e in exprs:
+                    if e in keys:
+                        idxs.append(keys.index(e))
+                    else:
+                        idxs.append(len(keys))
+                        keys.append(e)
+                sets.append(tuple(idxs))
+            return tuple(keys), tuple(sets)
+
+        def parse_set_element():
+            # a grouping set is `(e, ...)` OR a bare expression (one-key set)
+            if self.at_op("("):
+                return parse_expr_list()
+            return [self.parse_expr()]
+
+        # grouping/rollup/cube are soft keywords: commit to the construct only
+        # with the right lookahead so `group by cube` (a column) still parses
+        if self.at_kw("grouping") and self.peek(1).kind == "kw:sets":
+            self.next()
+            self.next()
+            self.expect_op("(")
+            sets_exprs = [parse_set_element()]
+            while self.accept_op(","):
+                sets_exprs.append(parse_set_element())
+            self.expect_op(")")
+            return canon(sets_exprs)
+        if self.at_kw("rollup") and self.peek(1).kind == "op" \
+                and self.peek(1).text == "(":
+            self.next()
+            exprs = parse_expr_list()
+            sets_exprs = [exprs[:k] for k in range(len(exprs), -1, -1)]
+            return canon(sets_exprs)
+        if self.at_kw("cube") and self.peek(1).kind == "op" \
+                and self.peek(1).text == "(":
+            self.next()
+            exprs = parse_expr_list()
+            n = len(exprs)
+            sets_exprs = [[exprs[i] for i in range(n) if m & (1 << i)]
+                          for m in range(2 ** n - 1, -1, -1)]
+            return canon(sets_exprs)
+        gb = [self.parse_expr()]
+        while self.accept_op(","):
+            gb.append(self.parse_expr())
+        return tuple(gb), None
 
     def parse_select_item(self) -> t.SelectItem:
         if self.at_op("*"):

@@ -200,17 +200,22 @@ class LogicalPlanner:
         return RelationPlan(node, scope)
 
     def plan_set_operation(self, rel: t.SetOperation) -> RelationPlan:
-        if rel.op.upper() != "UNION":
-            raise SemanticError(f"{rel.op} not supported yet "
-                                "(reference rewrites to union+agg)")
+        op = rel.op.upper()
+        if op in ("INTERSECT", "EXCEPT") and not rel.distinct:
+            raise SemanticError(f"{op} ALL is not supported")
         left = self.plan_relation(rel.left)
         right = self.plan_relation(rel.right)
         lf, rf = left.scope.fields, right.scope.fields
         if len(lf) != len(rf):
-            raise SemanticError("UNION children must have the same arity")
+            raise SemanticError(f"{op} children must have the same arity")
         types = [common_type(a.type, b.type) for a, b in zip(lf, rf)]
+        # INTERSECT/EXCEPT desugar to union + marker counting (the reference's
+        # ImplementIntersectAndExceptAsUnion rule, built directly): each side
+        # contributes a 0/1 marker column, the union is grouped on the value
+        # columns, and marker sums decide membership.
+        markers = op != "UNION"
         sides = []
-        for plan, fields in ((left, lf), (right, rf)):
+        for side_idx, (plan, fields) in enumerate(((left, lf), (right, rf))):
             assigns = []
             syms = []
             for f, tt in zip(fields, types):
@@ -220,15 +225,43 @@ class LogicalPlanner:
                 assigns.append((s, e))
                 syms.append(s)
             node = plan.node
-            if any(not isinstance(e, SymbolRef) for _, e in assigns):
+            if markers:
+                for m in range(2):
+                    ms = self.symbols.new_symbol(f"mark{m}", BIGINT)
+                    assigns.append(
+                        (ms, Constant(BIGINT, 1 if m == side_idx else 0)))
+                    syms.append(ms)
+                node = ProjectNode(node, assigns)
+            elif any(not isinstance(e, SymbolRef) for _, e in assigns):
                 node = ProjectNode(node, assigns)
             sides.append((node, syms))
         out_syms = [self.symbols.new_symbol(f.name or f"col{i}", tt)
                     for i, (f, tt) in enumerate(zip(lf, types))]
-        union = UnionNode([n for n, _ in sides], out_syms,
+        union_syms = list(out_syms)
+        if markers:
+            union_syms = out_syms + [self.symbols.new_symbol("lmark", BIGINT),
+                                     self.symbols.new_symbol("rmark", BIGINT)]
+        union = UnionNode([n for n, _ in sides], union_syms,
                           [syms for _, syms in sides])
         node: PlanNode = union
-        if rel.distinct:
+        if markers:
+            lc = self.symbols.new_symbol("lcount", BIGINT)
+            rc = self.symbols.new_symbol("rcount", BIGINT)
+            node = AggregationNode(node, out_syms, [
+                (lc, AggregationCall("sum", (union_syms[-2],))),
+                (rc, AggregationCall("sum", (union_syms[-1],)))])
+            one = Constant(BIGINT, 1)
+            lref = SymbolRef(BIGINT, lc.name)
+            rref = SymbolRef(BIGINT, rc.name)
+            have_left = Call(BOOLEAN, "greater_than_or_equal", (lref, one))
+            right_pred = Call(BOOLEAN, "greater_than_or_equal", (rref, one)) \
+                if op == "INTERSECT" else \
+                Call(BOOLEAN, "equal", (rref, Constant(BIGINT, 0)))
+            node = FilterNode(node, special("AND", BOOLEAN, have_left,
+                                            right_pred))
+            node = ProjectNode(
+                node, [(s, SymbolRef(s.type, s.name)) for s in out_syms])
+        elif rel.distinct:
             node = AggregationNode(node, out_syms, [])
         fields = [Field(f.name, s, None) for f, s in zip(lf, out_syms)]
         return RelationPlan(node, Scope(fields))
@@ -314,17 +347,24 @@ class LogicalPlanner:
             if w.call.filter is not None:
                 raise SemanticError(
                     f"FILTER on window function {fname} is not supported")
-            if fname in ("row_number", "rank", "dense_rank", "count"):
+            if fname in ("row_number", "rank", "dense_rank", "count", "ntile"):
                 out_type = BIGINT
-            elif fname == "avg":
+            elif fname in ("avg", "percent_rank", "cume_dist"):
                 out_type = DOUBLE
             elif fname in ("sum", "min", "max", "lag", "lead",
-                           "first_value", "last_value"):
+                           "first_value", "last_value", "nth_value"):
                 if not w.call.args:
                     raise SemanticError(f"{fname}() needs an argument")
                 out_type = tr.translate(w.call.args[0]).type
             else:
                 raise SemanticError(f"unknown window function {fname}")
+
+            def literal_arg(ast, what):
+                off = tr.translate(ast)
+                if not isinstance(off, Constant) or off.value is None:
+                    raise SemanticError(f"{fname} {what} must be a literal")
+                return int(off.value)
+
             offset = 1
             value_args = list(w.call.args)
             if fname in ("lag", "lead"):
@@ -334,14 +374,28 @@ class LogicalPlanner:
                     raise SemanticError(
                         f"{fname} default-value argument is not supported")
                 if len(value_args) == 2:
-                    off = tr.translate(value_args[1])
-                    if not isinstance(off, Constant) or off.value is None:
-                        raise SemanticError(
-                            f"{fname} offset must be a literal")
-                    offset = int(off.value)
+                    offset = literal_arg(value_args[1], "offset")
                     value_args = value_args[:1]
+            elif fname in ("percent_rank", "cume_dist"):
+                if value_args:
+                    raise SemanticError(f"{fname} takes no arguments")
+            elif fname == "ntile":
+                if len(value_args) != 1:
+                    raise SemanticError("ntile takes exactly one argument")
+                offset = literal_arg(value_args[0], "bucket count")
+                if offset < 1:
+                    raise SemanticError("ntile bucket count must be positive")
+                value_args = []
+            elif fname == "nth_value":
+                if len(value_args) != 2:
+                    raise SemanticError("nth_value takes exactly two arguments")
+                offset = literal_arg(value_args[1], "position")
+                if offset < 1:
+                    raise SemanticError("nth_value position must be positive")
+                value_args = value_args[:1]
             args = [as_sym(a, "warg") for a in value_args]
-            if fname in ("rank", "dense_rank") and not ords:
+            if fname in ("rank", "dense_rank", "ntile", "percent_rank",
+                         "cume_dist") and not ords:
                 raise SemanticError(f"{fname}() requires ORDER BY in its "
                                     "window specification")
             wsym = self.symbols.new_symbol(fname, out_type)
@@ -782,6 +836,23 @@ class LogicalPlanner:
                 if a not in ast_subst:
                     agg_asts.append(a)
 
+        # grouping(key) markers: 0 when the key is present in a branch's
+        # grouping set, 1 otherwise (GroupingOperationRewriter analogue)
+        grouping_markers: List[Tuple[Symbol, int]] = []
+        for src in sources:
+            for g in _find_grouping_calls(src):
+                if g in ast_subst:
+                    continue
+                if len(g.args) != 1 or g.args[0] not in key_asts:
+                    raise SemanticError(
+                        "grouping() takes exactly one grouping-key expression")
+                key_idx = key_asts.index(g.args[0])
+                gsym = self.symbols.new_symbol("grouping", BIGINT)
+                ast_subst[g] = t.Identifier(f"$grouping{len(grouping_markers)}")
+                post_fields.append(
+                    Field(f"$grouping{len(grouping_markers)}", gsym, None))
+                grouping_markers.append((gsym, key_idx))
+
         aggregations: List[Tuple[Symbol, AggregationCall]] = []
         for j, a in enumerate(agg_asts):
             if a in ast_subst:
@@ -806,7 +877,41 @@ class LogicalPlanner:
             post_fields.append(Field(marker, sym, None))
 
         pre = ProjectNode(node, pre_assigns)
-        agg = AggregationNode(pre, key_syms, aggregations)
+        gsets = spec.grouping_sets
+        full = tuple(range(len(key_syms)))
+        if gsets is None or tuple(gsets) == (full,):
+            agg: PlanNode = AggregationNode(pre, key_syms, aggregations)
+            if grouping_markers:  # plain GROUP BY: grouping() is always 0
+                agg = ProjectNode(agg, [
+                    (s, SymbolRef(s.type, s.name))
+                    for s in key_syms + [a for a, _ in aggregations]
+                ] + [(gs, Constant(BIGINT, 0)) for gs, _ in grouping_markers])
+        else:
+            # GROUPING SETS / ROLLUP / CUBE: one aggregation per set over the
+            # shared pre-projected source, absent keys padded with typed
+            # NULLs, branches concatenated (the reference plans a GroupIdNode
+            # + single agg; the union form trades one extra source pass per
+            # set for zero new operator kinds — sets are few in practice)
+            agg_out = [s for s, _ in aggregations]
+            union_syms = key_syms + [gs for gs, _ in grouping_markers] + agg_out
+            branches: List[PlanNode] = []
+            for sset in gsets:
+                present = set(sset)
+                agg_b = AggregationNode(
+                    pre, [key_syms[i] for i in sset], aggregations)
+                assigns_b: List[Tuple[Symbol, RowExpression]] = []
+                for i, ks in enumerate(key_syms):
+                    assigns_b.append(
+                        (ks, SymbolRef(ks.type, ks.name) if i in present
+                         else Constant(ks.type, None)))
+                for gs, key_idx in grouping_markers:
+                    assigns_b.append(
+                        (gs, Constant(BIGINT, 0 if key_idx in present else 1)))
+                for s in agg_out:
+                    assigns_b.append((s, SymbolRef(s.type, s.name)))
+                branches.append(ProjectNode(agg_b, assigns_b))
+            agg = UnionNode(branches, union_syms,
+                            [list(union_syms)] * len(branches))
         post_scope = Scope(post_fields)
         node2: PlanNode = agg
 
@@ -971,3 +1076,24 @@ def _name_of(expr: t.Expression, i: int) -> str:
     if isinstance(expr, t.FunctionCall):
         return expr.name.lower()
     return f"_col{i}"
+
+
+def _find_grouping_calls(ast: t.Node) -> List[t.FunctionCall]:
+    """All grouping(...) calls in an expression tree. Shares the analyzer's
+    child walk and, like extract_aggregates/extract_windows, does NOT descend
+    into subqueries (an inner query's grouping() belongs to that query)."""
+    from ..analyzer import _ast_children
+
+    out: List[t.FunctionCall] = []
+
+    def walk(n):
+        if isinstance(n, t.FunctionCall) and n.name.lower() == "grouping":
+            out.append(n)
+            return
+        if isinstance(n, (t.SubqueryExpression, t.WindowExpression)):
+            return
+        for c in _ast_children(n):
+            walk(c)
+
+    walk(ast)
+    return out

@@ -339,6 +339,9 @@ class QuerySpecification(Relation):
     having: Optional[Expression] = None
     order_by: Tuple[SortItem, ...] = ()
     limit: Optional[int] = None
+    # GROUPING SETS / ROLLUP / CUBE: tuples of indices into group_by (which
+    # holds the distinct key expressions in canonical order); None = plain
+    grouping_sets: Optional[Tuple[Tuple[int, ...], ...]] = None
 
 
 @_dc

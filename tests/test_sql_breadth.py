@@ -1,0 +1,133 @@
+"""SQL breadth: set operations, GROUPING SETS/ROLLUP/CUBE, ranking window
+functions — verified against the sqlite oracle where it supports the syntax,
+and against manually-desugared oracle SQL where it does not (sqlite has no
+ROLLUP/CUBE).
+
+Reference analogues: optimizations/ImplementIntersectAndExceptAsUnion.java,
+sql/planner/plan/GroupIdNode.java (we desugar to a union of aggregations),
+operator/window/ (ntile/percent_rank/cume_dist/nth_value)."""
+import pytest
+
+from presto_tpu.metadata import Session
+from presto_tpu.runner import LocalQueryRunner
+from presto_tpu.utils.testing import SqliteOracle, assert_rows_equal
+
+
+@pytest.fixture(scope="module")
+def runner():
+    return LocalQueryRunner(session=Session(catalog="tpch", schema="tiny"))
+
+
+@pytest.fixture(scope="module")
+def oracle():
+    o = SqliteOracle()
+    o.load_tpch(0.01, ["nation", "region", "supplier"])
+    return o
+
+
+def _check(runner, oracle, sql, oracle_sql=None):
+    got = runner.execute(sql).rows
+    exp = oracle.query(oracle_sql or sql)
+    assert_rows_equal(got, exp)
+
+
+def test_intersect(runner, oracle):
+    _check(runner, oracle,
+           "select n_regionkey from nation where n_nationkey < 10 "
+           "intersect "
+           "select n_regionkey from nation where n_nationkey >= 5 "
+           "order by 1")
+
+
+def test_except(runner, oracle):
+    _check(runner, oracle,
+           "select n_nationkey % 7 from nation "
+           "except select n_regionkey from nation order by 1")
+
+
+def test_intersect_multi_column(runner, oracle):
+    _check(runner, oracle,
+           "select n_regionkey, n_nationkey % 3 from nation "
+           "intersect select n_regionkey, n_nationkey % 2 from nation "
+           "order by 1, 2")
+
+
+def test_except_all_rejected(runner):
+    with pytest.raises(Exception, match="EXCEPT ALL"):
+        runner.execute("select 1 except all select 2")
+
+
+def test_rollup(runner, oracle):
+    _check(runner, oracle,
+           "select n_regionkey, n_nationkey % 2, count(*), sum(n_nationkey) "
+           "from nation group by rollup(n_regionkey, n_nationkey % 2) "
+           "order by 1, 2",
+           oracle_sql="""
+             select n_regionkey, n_nationkey % 2, count(*), sum(n_nationkey)
+               from nation group by 1, 2
+             union all
+             select n_regionkey, null, count(*), sum(n_nationkey)
+               from nation group by 1
+             union all
+             select null, null, count(*), sum(n_nationkey) from nation
+             order by 1, 2""")
+
+
+def test_cube(runner, oracle):
+    _check(runner, oracle,
+           "select n_regionkey, n_nationkey % 2, count(*) "
+           "from nation group by cube(n_regionkey, n_nationkey % 2) "
+           "order by 1, 2",
+           oracle_sql="""
+             select n_regionkey, n_nationkey % 2, count(*)
+               from nation group by 1, 2
+             union all select n_regionkey, null, count(*) from nation group by 1
+             union all select null, n_nationkey % 2, count(*)
+               from nation group by 2
+             union all select null, null, count(*) from nation
+             order by 1, 2""")
+
+
+def test_grouping_sets_explicit(runner, oracle):
+    _check(runner, oracle,
+           "select n_regionkey, count(*) from nation "
+           "group by grouping sets ((n_regionkey), ()) order by 1",
+           oracle_sql="""
+             select n_regionkey, count(*) from nation group by 1
+             union all select null, count(*) from nation order by 1""")
+
+
+def test_grouping_marker(runner):
+    out = runner.execute(
+        "select n_regionkey, grouping(n_regionkey) as g, count(*) "
+        "from nation group by rollup(n_regionkey) order by 2, 1")
+    assert out.rows[-1][1] == 1 and out.rows[-1][0] is None
+    assert all(row[1] == 0 for row in out.rows[:-1])
+
+
+def test_rollup_with_having(runner, oracle):
+    _check(runner, oracle,
+           "select n_regionkey, count(*) as c from nation "
+           "group by rollup(n_regionkey) having count(*) > 5 order by 1",
+           oracle_sql="""
+             select * from (
+               select n_regionkey, count(*) as c from nation group by 1
+               union all select null, count(*) from nation)
+             where c > 5 order by 1""")
+
+
+def test_ranking_window_functions(runner, oracle):
+    _check(runner, oracle, """
+        select s_nationkey, s_suppkey,
+               ntile(3) over (partition by s_nationkey order by s_suppkey),
+               percent_rank() over (partition by s_nationkey order by s_suppkey),
+               cume_dist() over (partition by s_nationkey order by s_suppkey),
+               nth_value(s_suppkey, 2)
+                   over (partition by s_nationkey order by s_suppkey)
+          from supplier order by 1, 2""")
+
+
+def test_ntile_more_buckets_than_rows(runner, oracle):
+    _check(runner, oracle,
+           "select n_nationkey, ntile(40) over (order by n_nationkey) "
+           "from nation order by 1")
