@@ -581,7 +581,8 @@ class LocalExecutionPlanner:
         out_syms = list(node.keys)
         for i, (sym, ac) in enumerate(node.aggregations):
             arg_types = [a.type for a in ac.args]
-            fn = resolve_aggregate(ac.name, arg_types, ac.distinct)
+            fn = resolve_aggregate(ac.name, arg_types, ac.distinct,
+                                   getattr(ac, "params", ()))
             if step == P_FINAL:
                 # inputs are the partial state columns named by the exchange plan
                 isyms = node.intermediate_symbols[i]

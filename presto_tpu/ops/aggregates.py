@@ -35,10 +35,17 @@ _I64_MIN = np.int64(-(2**63))
 
 @dataclasses.dataclass
 class StateColumn:
-    """One array in the aggregate's state struct."""
+    """One array in the aggregate's state struct.
+
+    width > 1 makes this a VECTOR state: the per-group entry is a (width,)
+    array, per-row contributions are (rows, width), and the grouping kernels
+    reduce over the leading axis only — the sketch-aggregate shape
+    (approx_percentile histograms, approx_distinct HLL registers), which maps
+    to one wide segment-reduce instead of `width` scalar ones."""
     dtype: np.dtype
     reduce: str          # SUM | MIN | MAX
     identity: object     # fill value for empty groups
+    width: int = 1
 
 
 @dataclasses.dataclass
@@ -52,6 +59,9 @@ class AggregateFunction:
     # state arrays -> output array
     final_map: Callable
     intermediate_types: List[Type] = dataclasses.field(default_factory=list)
+    # splittable: state columns can ride pages between PARTIAL and FINAL steps
+    # (vector states cannot — the exchange planner keeps those single-phase)
+    splittable: bool = True
 
 
 def _ones_i64(args, mask):
@@ -60,8 +70,12 @@ def _ones_i64(args, mask):
 
 
 def resolve_aggregate(name: str, arg_types: Sequence[Type],
-                      distinct: bool = False) -> AggregateFunction:
-    """FunctionManager.resolveFunction analogue for aggregates."""
+                      distinct: bool = False,
+                      params: Sequence[object] = ()) -> AggregateFunction:
+    """FunctionManager.resolveFunction analogue for aggregates.
+
+    `params` carries literal (non-column) arguments extracted by the planner —
+    e.g. approx_percentile's fraction."""
     name = name.lower()
     if name == "count":
         if not arg_types:  # count(*)
@@ -260,44 +274,114 @@ def resolve_aggregate(name: str, arg_types: Sequence[Type],
             [DOUBLE] * 5 + [BIGINT])
 
     if name == "approx_distinct":
-        # min-hash sketch: K independent uniform-min registers per group,
-        # merged by MIN (associative => partial/final steps compose). The
-        # reference's HLL (approx error ~2.3%) needs 2048 byte registers; K=64
-        # scalar registers give ~1/sqrt(K) ~ 12% typical error, which honors
-        # the function's approximate contract on this engine's state model.
-        K = 64
-        t = arg_types[0]
+        # HyperLogLog, m=2048 registers (standard error 1.04/sqrt(m) ~ 2.3%,
+        # matching the reference's default HLL accuracy,
+        # operator/aggregation/ApproximateCountDistinctAggregation). One
+        # VECTOR state per group: register j holds max(rho) of hashes landing
+        # in bucket j; per-row contribution is a one-hot (rows, m) scatter
+        # reduced by MAX — one wide segment-reduce on the VPU.
+        M = 2048
+        LOG2M = 11
 
-        def input_map(args, mask, _k=K):
-            a0 = args[0]
-            if jnp.issubdtype(a0.dtype, jnp.floating):
-                # bitcast, not value cast: 1.25 and 1.75 must hash apart
-                x = jax.lax.bitcast_convert_type(
-                    a0.astype(jnp.float64), jnp.int64).astype(jnp.uint64)
-            else:
-                x = a0.astype(jnp.int64).astype(jnp.uint64)
-            outs = []
-            for j in range(_k):
-                h = _sketch_mix(x ^ jnp.uint64(0x9E3779B97F4A7C15 * (j + 1) & 0xFFFFFFFFFFFFFFFF))
-                u = (h >> jnp.uint64(11)).astype(jnp.float64) / float(1 << 53)
-                outs.append(jnp.where(mask, u, 1.0))
-            return tuple(outs)
+        def input_map(args, mask, _m=M):
+            h = _hash_to_u64(args[0])
+            bucket = (h >> jnp.uint64(64 - LOG2M)).astype(jnp.int32)
+            rest = (h << jnp.uint64(LOG2M)) | jnp.uint64((1 << LOG2M) - 1)
+            # rho = leading zeros + 1, via the float exponent (msb index);
+            # float64's 52-bit mantissa can misplace the msb on ~2^-52 of
+            # inputs — irrelevant at sketch accuracy
+            msb = jnp.floor(jnp.log2(rest.astype(jnp.float64)))
+            rho = jnp.clip(64.0 - msb, 1.0, 64.0 - LOG2M + 1.0
+                           ).astype(jnp.float32)
+            # wide-state contribution: (bucket, value) pair; the grouping
+            # kernels scatter value into state[group, bucket] with MAX
+            return ((jnp.where(mask, bucket, _m), rho),)
 
-        def final_map(s, _k=K):
-            total = s[0]
-            for j in range(1, _k):
-                total = total + s[j]
-            # E[min of n uniforms] = 1/(n+1); sum of K mins ~ Gamma(K, 1/(n+1))
-            est = _k / jnp.maximum(total, 1e-12) - 1.0
-            return jnp.round(jnp.maximum(est, 0.0)).astype(jnp.int64)
+        def final_map(s, _m=M):
+            regs = s[0]                               # (groups, m) f32
+            est = (0.7213 / (1 + 1.079 / _m)) * _m * _m / \
+                jnp.sum(jnp.exp2(-regs), axis=-1)
+            zeros = jnp.sum(regs == 0, axis=-1)
+            # small-range correction (linear counting)
+            small = _m * jnp.log(_m / jnp.maximum(zeros, 1).astype(jnp.float64))
+            est = jnp.where((est <= 2.5 * _m) & (zeros > 0), small, est)
+            return jnp.round(est).astype(jnp.int64)
 
         return AggregateFunction(
             "approx_distinct", BIGINT,
-            [StateColumn(np.dtype(np.float64), MIN, 1.0) for _ in range(K)],
-            input_map, final_map,
-            [DOUBLE] * K)
+            [StateColumn(np.dtype(np.float32), MAX, 0.0, width=M)],
+            input_map, final_map, [], splittable=False)
+
+    if name == "approx_percentile":
+        # log-bucketed histogram sketch: octaves 2^-16..2^31 x 8 sub-buckets
+        # x 2 signs (+1 zero bucket) of f64 counts as ONE vector state; the
+        # percentile is read off the per-group cumulative histogram with the
+        # bucket's geometric midpoint (reference: qdigest-based
+        # approx_percentile, ApproximateLongPercentileAggregations).
+        # Relative error ~= half a sub-bucket ~= 4% for 2^-16 <= |v| < 2^32;
+        # smaller magnitudes clamp into the lowest octave.
+        OCT_LO, OCT_HI, SUB = -16, 31, 8
+        N_OCT = OCT_HI - OCT_LO + 1
+        HALF = N_OCT * SUB
+        K = 2 * HALF + 1
+        t = arg_types[0]
+        int_out = not is_floating(t)  # decimals/ints stay scaled ints
+
+        centers = np.zeros(K, dtype=np.float64)
+        for i in range(N_OCT):
+            for sub_i in range(SUB):
+                mid = 2.0 ** (OCT_LO + i) * (1.0 + (sub_i + 0.5) / SUB)
+                centers[HALF + 1 + i * SUB + sub_i] = mid
+                centers[HALF - 1 - i * SUB - sub_i] = -mid
+        centers_j = jnp.asarray(centers)
+
+        def bucket_of(v):
+            mag = jnp.abs(v.astype(jnp.float64))
+            exp = jnp.clip(jnp.floor(jnp.log2(jnp.maximum(mag, 1e-300))),
+                           OCT_LO, OCT_HI)
+            sub = jnp.clip(jnp.floor((mag / jnp.exp2(exp) - 1.0) * SUB),
+                           0, SUB - 1)
+            off = ((exp - OCT_LO) * SUB + sub + 1).astype(jnp.int32)
+            return jnp.where(v == 0, HALF,
+                             jnp.where(v > 0, HALF + off, HALF - off))
+
+        def input_map(args, mask, _k=K):
+            b = jnp.where(mask, bucket_of(args[0]), _k)
+            return ((b.astype(jnp.int32), jnp.ones_like(b, jnp.float64)),)
+
+        # the percentile fraction is bound at resolve time via params (the
+        # planner extracts the literal second argument)
+        pct = float(params[0]) if params else 0.5
+        if not 0.0 < pct <= 1.0:
+            raise ValueError("approx_percentile fraction must be in (0, 1]")
+
+        def final_map(s, _p=pct):
+            hist = s[0]                              # (groups, K) f64 counts
+            total = jnp.sum(hist, axis=-1)
+            target = jnp.ceil(_p * jnp.maximum(total, 1.0))
+            cum = jnp.cumsum(hist, axis=-1)
+            idx = jnp.argmax(cum >= target[..., None], axis=-1)
+            vals = centers_j[idx]
+            out = jnp.round(vals).astype(jnp.int64) if int_out else vals
+            return out, total == 0
+
+        out_t = t if int_out else DOUBLE
+        return AggregateFunction(
+            "approx_percentile", out_t,
+            [StateColumn(np.dtype(np.float64), SUM, 0.0, width=K)],
+            input_map, final_map, [], splittable=False)
 
     raise NotImplementedError(f"aggregate function {name}({arg_types})")
+
+
+def _hash_to_u64(a0):
+    """Column -> uniform uint64 hash (bitcast floats so 1.25 != 1.75)."""
+    if jnp.issubdtype(a0.dtype, jnp.floating):
+        x = jax.lax.bitcast_convert_type(
+            a0.astype(jnp.float64), jnp.int64).astype(jnp.uint64)
+    else:
+        x = a0.astype(jnp.int64).astype(jnp.uint64)
+    return _sketch_mix(x)
 
 
 def _sketch_mix(x):

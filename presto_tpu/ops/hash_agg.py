@@ -47,6 +47,42 @@ def _segment_reduce(kind: str, values, seg_ids, num_segments: int):
     raise AssertionError(kind)
 
 
+WIDE_STATE_MAX_GROUPS = 1 << 13  # scatter-table bound for sketch aggregates
+
+
+def _state_widths(calls) -> Tuple[int, ...]:
+    return tuple(col.width for c in calls for col in c.function.state)
+
+
+def _empty_state(kind_count_widths):
+    """Zero-group state arrays matching each column's width."""
+    return tuple(jnp.zeros((0, w) if w > 1 else 0, dtype=np.float64)
+                 for w in kind_count_widths)
+
+
+def _reduce_contrib(kind: str, c, gid, num_segments: int, width: int,
+                    ident):
+    """Reduce one contribution column into a (num_segments[, width]) state.
+
+    Wide (vector) state columns arrive as a `(bucket, value)` tuple per row and
+    scatter into state[group, bucket] — never materializing a rows x width
+    one-hot. Scalar columns segment-reduce as before. 2-D plain arrays are
+    already-built states being re-grouped (combine path)."""
+    if isinstance(c, tuple):
+        bucket, vals = c
+        base = jnp.full((num_segments, width), ident, dtype=vals.dtype)
+        at = base.at[gid, bucket]
+        upd = at.add if kind == SUM else (at.min if kind == MIN else at.max)
+        return upd(vals, mode="drop")
+    return _segment_reduce(kind, c, gid, num_segments)
+
+
+def _where_valid(gvalid, s, ident):
+    """Identity-fill invalid group slots, broadcasting over vector states."""
+    cond = gvalid[:, None] if s.ndim == 2 else gvalid
+    return jnp.where(cond, s, jnp.asarray(ident, dtype=s.dtype))
+
+
 def _fill(shape, dtype, value):
     return jnp.full(shape, value, dtype=dtype)
 
@@ -93,19 +129,22 @@ def _call_contributions(calls, page: Page, from_intermediate: bool):
 # ---------------------------------------------------------------------------
 
 def sort_group_reduce(keys: Tuple[jnp.ndarray, ...], mask: jnp.ndarray,
-                      contribs: Tuple[jnp.ndarray, ...], kinds: Tuple[str, ...],
-                      identities: Tuple, out_groups: int):
+                      contribs: Tuple, kinds: Tuple[str, ...],
+                      identities: Tuple, out_groups: int,
+                      widths: Optional[Tuple[int, ...]] = None):
     """Group rows by `keys` (exact, multi-column) and reduce `contribs`.
 
     Returns (group_keys, group_states, group_valid_mask). Invalid input rows and
     groups beyond out_groups are dropped (caller sizes out_groups to capacity).
     """
     n = mask.shape[0]
+    widths = widths or (1,) * len(kinds)
     invalid = ~mask
     order = jnp.lexsort(tuple(reversed(keys)) + (invalid,))
     sk = tuple(k[order] for k in keys)
     sv = mask[order]
-    sc = tuple(c[order] for c in contribs)
+    sc = tuple((c[0][order], c[1][order]) if isinstance(c, tuple) else c[order]
+               for c in contribs)
 
     first = jnp.zeros(n, dtype=jnp.bool_).at[0].set(True)
     diff = jnp.zeros(n, dtype=jnp.bool_)
@@ -118,9 +157,8 @@ def sort_group_reduce(keys: Tuple[jnp.ndarray, ...], mask: jnp.ndarray,
     gid = jnp.minimum(gid, out_groups)    # overflow also lands in the bin
 
     states = []
-    for c, kind, ident in zip(sc, kinds, identities):
-        s = _segment_reduce(kind, c, gid, out_groups + 1)[:out_groups]
-        # empty groups get identities
+    for c, kind, ident, w in zip(sc, kinds, identities, widths):
+        s = _reduce_contrib(kind, c, gid, out_groups + 1, w, ident)[:out_groups]
         states.append(s)
     gkeys = []
     for k in sk:
@@ -129,9 +167,8 @@ def sort_group_reduce(keys: Tuple[jnp.ndarray, ...], mask: jnp.ndarray,
         gkeys.append(out)
     gvalid = jnp.arange(out_groups, dtype=jnp.int32) < jnp.minimum(num_groups, out_groups)
     # overwrite empty-group states with identities so MIN/MAX don't leak sentinels
-    fixed_states = []
-    for s, ident in zip(states, identities):
-        fixed_states.append(jnp.where(gvalid, s, jnp.asarray(ident, dtype=s.dtype)))
+    fixed_states = [_where_valid(gvalid, s, ident)
+                    for s, ident in zip(states, identities)]
     return tuple(gkeys), tuple(fixed_states), gvalid, num_groups
 
 
@@ -156,6 +193,14 @@ class GroupedAggregationBuilder:
             col.reduce for c in calls for col in c.function.state)
         self.identities: Tuple = tuple(
             col.identity for c in calls for col in c.function.state)
+        self.widths: Tuple[int, ...] = _state_widths(calls)
+        # vector (sketch) states scatter into (groups, width) tables — bound
+        # BOTH the per-page group table and the device accumulator; overflow
+        # beyond max_groups spills compacted partials to host RAM as usual
+        self._wide_cap = WIDE_STATE_MAX_GROUPS if any(
+            w > 1 for w in self.widths) else None
+        if self._wide_cap is not None:
+            self.max_groups = min(self.max_groups, self._wide_cap)
         self._acc = None            # (keys, states, valid) compact table, <= max_groups
         self._pending: List = []    # list of (keys, states, mask) partials
         self._pending_rows = 0
@@ -179,7 +224,7 @@ class GroupedAggregationBuilder:
         keys = tuple(datas[c] for c in self._key_channels)
         contribs = _call_contributions(self.calls, page, self.from_intermediate)
         return sort_group_reduce(keys, mask, tuple(contribs), self.kinds,
-                                 self.identities, out_groups)
+                                 self.identities, out_groups, self.widths)
 
     def set_channels(self, key_channels: Sequence[int]):
         self._key_channels = tuple(key_channels)
@@ -193,7 +238,15 @@ class GroupedAggregationBuilder:
 
     def add_page(self, page: Page) -> None:
         cap = page.capacity
-        gkeys, gstates, gvalid, _ = self._page_kernel(page, cap)
+        out_groups = cap if self._wide_cap is None else min(cap, self._wide_cap)
+        gkeys, gstates, gvalid, ng = self._page_kernel(page, out_groups)
+        if self._wide_cap is not None and int(ng) > out_groups:
+            # a capped group table would silently merge groups — fail loudly
+            # (sketch aggregates target few groups; the reference's qdigest /
+            # HLL states would OOM long before this bound too)
+            raise RuntimeError(
+                f"sketch aggregate over more than {out_groups} groups in one "
+                f"page is not supported")
         self._pending.append((gkeys, gstates, gvalid))
         self._pending_rows += cap
         if self._pending_rows >= 4 * self.max_groups:
@@ -219,7 +272,8 @@ class GroupedAggregationBuilder:
         size = self._table_size or _pow2(min(int(valid.shape[0]), self.max_groups))
         while True:
             gkeys, gstates, gvalid, ngroups = _combine_kernel(
-                keys, valid, states, self.kinds, self.identities, size)
+                keys, valid, states, self.kinds, self.identities, size,
+                self.widths)
             n = int(ngroups)
             if n <= size or size >= self.max_groups:
                 break
@@ -248,7 +302,7 @@ class GroupedAggregationBuilder:
     def memory_bytes(self) -> int:
         """Device-resident bytes (pending partials + compact table)."""
         per_row = sum(np.dtype(t.np_dtype).itemsize for t in self.key_types) + \
-            sum(np.dtype(col.dtype).itemsize
+            sum(np.dtype(col.dtype).itemsize * col.width
                 for c in self.calls for col in c.function.state) + 1
         rows = self._pending_rows
         if self._acc is not None:
@@ -290,8 +344,7 @@ class GroupedAggregationBuilder:
         states = [s[valid] for s in states]
         if len(keys[0]) == 0:
             z = tuple(jnp.zeros(0, dtype=t.np_dtype) for t in self.key_types)
-            s = tuple(jnp.zeros(0, dtype=np.dtype(np.float64)) for _ in self.kinds)
-            return z, s, jnp.zeros(0, dtype=jnp.bool_)
+            return z, _empty_state(self.widths), jnp.zeros(0, dtype=jnp.bool_)
         order = np.lexsort(tuple(reversed(keys)))
         keys = [k[order] for k in keys]
         states = [s[order] for s in states]
@@ -316,8 +369,8 @@ class GroupedAggregationBuilder:
             if not self._pending and self._acc is None and not self._spilled:
                 # empty input: zero groups
                 z = tuple(jnp.zeros(0, dtype=t.np_dtype) for t in self.key_types)
-                s = tuple(jnp.zeros(0, dtype=np.dtype(np.float64)) for _ in self.kinds)
-                return z, s, jnp.zeros(0, dtype=jnp.bool_)
+                return z, _empty_state(self.widths), \
+                    jnp.zeros(0, dtype=jnp.bool_)
             if self._pending:
                 self._fold()
         if self._spilled:
@@ -325,9 +378,12 @@ class GroupedAggregationBuilder:
         return self._acc
 
 
-@functools.partial(jax.jit, static_argnames=("kinds", "identities", "max_groups"))
-def _combine_kernel(keys, valid, states, kinds, identities, max_groups):
-    return sort_group_reduce(keys, valid, states, kinds, identities, max_groups)
+@functools.partial(jax.jit, static_argnames=("kinds", "identities",
+                                             "max_groups", "widths"))
+def _combine_kernel(keys, valid, states, kinds, identities, max_groups,
+                    widths=None):
+    return sort_group_reduce(keys, valid, states, kinds, identities,
+                             max_groups, widths)
 
 
 def _pow2(n: int) -> int:
@@ -351,7 +407,8 @@ class DirectAggregationBuilder:
         self.D = int(np.prod(domains))
         self.kinds = tuple(col.reduce for c in calls for col in c.function.state)
         self.identities = tuple(col.identity for c in calls for col in c.function.state)
-        self._table = None  # tuple of (D,) state arrays
+        self.widths = _state_widths(calls)
+        self._table = None  # tuple of (D,) / (D, width) state arrays
         self._seen = None   # (D,) bool: group occurred
         self._kernel = jax.jit(self._accumulate)
 
@@ -371,8 +428,9 @@ class DirectAggregationBuilder:
         gid = jnp.where(mask, gid, self.D)
         contribs = _call_contributions(self.calls, page, self.from_intermediate)
         new_table = []
-        for c, kind, ident, t in zip(contribs, self.kinds, self.identities, table):
-            part = _segment_reduce(kind, c, gid, self.D + 1)[: self.D]
+        for c, kind, ident, w, t in zip(contribs, self.kinds, self.identities,
+                                        self.widths, table):
+            part = _reduce_contrib(kind, c, gid, self.D + 1, w, ident)[: self.D]
             if kind == SUM:
                 new_table.append(t + part)
             elif kind == MIN:
@@ -386,7 +444,8 @@ class DirectAggregationBuilder:
     def add_page(self, page: Page) -> None:
         if self._table is None:
             self._table = tuple(
-                _fill((self.D,), np.dtype(col.dtype), col.identity)
+                _fill((self.D, col.width) if col.width > 1 else (self.D,),
+                      np.dtype(col.dtype), col.identity)
                 for c in self.calls for col in c.function.state)
             self._seen = jnp.zeros(self.D, dtype=jnp.bool_)
         self._table, self._seen = self._kernel(page, self._table, self._seen)
@@ -416,6 +475,7 @@ class GlobalAggregationBuilder:
         self.from_intermediate = from_intermediate
         self.kinds = tuple(col.reduce for c in calls for col in c.function.state)
         self.identities = tuple(col.identity for c in calls for col in c.function.state)
+        self.widths = _state_widths(calls)
         self._state = None
         self._kernel = jax.jit(self._accumulate)
 
@@ -429,11 +489,18 @@ class GlobalAggregationBuilder:
         mask = page.mask
         contribs = _call_contributions(self.calls, page, self.from_intermediate)
         new_state = []
-        for c, kind, s in zip(contribs, self.kinds, self._state_or(state)):
-            if self.from_intermediate:
-                c = jnp.where(mask, c, jnp.asarray(
-                    self.identities[len(new_state)], dtype=c.dtype))
-            red = {SUM: jnp.sum, MIN: jnp.min, MAX: jnp.max}[kind](c)
+        for c, kind, ident, w, s in zip(contribs, self.kinds, self.identities,
+                                        self.widths, self._state_or(state)):
+            if isinstance(c, tuple):
+                bucket, vals = c
+                base = jnp.full((w,), ident, dtype=vals.dtype)
+                at = base.at[bucket]
+                red = (at.add if kind == SUM else
+                       (at.min if kind == MIN else at.max))(vals, mode="drop")
+            else:
+                if self.from_intermediate:
+                    c = jnp.where(mask, c, jnp.asarray(ident, dtype=c.dtype))
+                red = {SUM: jnp.sum, MIN: jnp.min, MAX: jnp.max}[kind](c)
             new_state.append({SUM: lambda a, b: a + b,
                               MIN: jnp.minimum, MAX: jnp.maximum}[kind](s, red))
         return tuple(new_state)
@@ -441,20 +508,24 @@ class GlobalAggregationBuilder:
     def _state_or(self, state):
         return state
 
+    def _identity_state(self):
+        return tuple(
+            jnp.full((col.width,), col.identity, dtype=np.dtype(col.dtype))
+            if col.width > 1 else
+            jnp.asarray(col.identity, dtype=np.dtype(col.dtype))
+            for c in self.calls for col in c.function.state)
+
     def add_page(self, page: Page) -> None:
         if self._state is None:
-            self._state = tuple(
-                jnp.asarray(col.identity, dtype=np.dtype(col.dtype))
-                for c in self.calls for col in c.function.state)
+            self._state = self._identity_state()
         self._state = self._kernel(page, self._state)
 
     def finish(self):
         if self._state is None:
-            self._state = tuple(
-                jnp.asarray(col.identity, dtype=np.dtype(col.dtype))
-                for c in self.calls for col in c.function.state)
+            self._state = self._identity_state()
         keys = ()
-        states = tuple(jnp.reshape(s, (1,)) for s in self._state)
+        states = tuple(jnp.reshape(s, (1, -1) if s.ndim else (1,))
+                       for s in self._state)
         return keys, states, jnp.ones(1, dtype=jnp.bool_)
 
 
@@ -563,7 +634,10 @@ class HashAggregationOperator(Operator):
                     # winning rank back to its dictionary code (empty groups
                     # clip to an arbitrary code; their null flag masks them)
                     order = jnp.asarray(d.sort_order())
-                    out = order[jnp.clip(out, 0, len(order) - 1)]
+                    # states may arrive as f64 from the mesh exchange's
+                    # common-dtype collectives: index with ints
+                    out = order[jnp.clip(out, 0, len(order) - 1
+                                         ).astype(jnp.int32)]
                 out_cols.append((call.function.output_type,
                                  jnp.asarray(out, dtype=call.function.output_type.np_dtype),
                                  call.output_dictionary, nulls))
@@ -595,9 +669,13 @@ def make_builder(key_types, key_dicts, key_domains, calls, page_capacity,
     """Strategy pick (LocalExecutionPlanner's group-by-hash choice analogue)."""
     if not key_types:
         return GlobalAggregationBuilder(calls, from_intermediate)
+    wide = any(w > 1 for w in _state_widths(calls))
     if key_domains is not None and all(d is not None for d in key_domains):
         D = int(np.prod(key_domains))
-        if D <= direct_domain_limit:
+        # vector (sketch) states make the dense table D x width: keep the
+        # direct strategy only while that stays small
+        if D <= (direct_domain_limit if not wide
+                 else min(direct_domain_limit, WIDE_STATE_MAX_GROUPS)):
             return DirectAggregationBuilder(key_types, key_dicts, key_domains, calls,
                                             from_intermediate)
     return GroupedAggregationBuilder(key_types, key_dicts, calls, page_capacity,

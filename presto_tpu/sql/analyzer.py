@@ -26,7 +26,8 @@ from .planner.plan import Symbol
 AGGREGATE_NAMES = {"count", "sum", "avg", "min", "max", "stddev", "stddev_samp",
                    "stddev_pop", "variance", "var_samp", "var_pop", "corr",
                    "covar_samp", "covar_pop", "approx_distinct", "count_if",
-                   "bool_and", "bool_or", "every", "arbitrary", "any_value"}
+                   "bool_and", "bool_or", "every", "arbitrary", "any_value",
+                   "approx_percentile"}
 
 _ARITH_NAMES = {"+": "add", "-": "subtract", "*": "multiply", "/": "divide",
                 "%": "modulus"}
@@ -546,6 +547,8 @@ def aggregate_output_type(name: str, arg_types: Sequence[Type]) -> Type:
         return DOUBLE
     if name in ("min", "max", "arbitrary", "any_value"):
         return arg_types[0]
+    if name == "approx_percentile":
+        return DOUBLE if is_floating(arg_types[0]) else arg_types[0]
     if name in ("stddev", "stddev_samp", "stddev_pop", "variance", "var_samp",
                 "var_pop", "corr", "covar_samp", "covar_pop"):
         return DOUBLE

@@ -135,9 +135,15 @@ class ExchangePlanner:
             return AggregationNode(child, keys, node.aggregations, SINGLE), dist
 
         has_distinct = any(c.distinct for _, c in node.aggregations)
-        if has_distinct:
-            # distinct needs every row of a group on one worker: exchange the
-            # input rows, then aggregate in one step
+        # non-splittable (vector-state sketch) aggregates cannot ride their
+        # state through pages between PARTIAL and FINAL — single-phase them
+        has_unsplittable = any(
+            not resolve_aggregate(c.name, [a.type for a in c.args], c.distinct,
+                                  c.params).splittable
+            for _, c in node.aggregations)
+        if has_distinct or has_unsplittable:
+            # distinct/sketches need every row of a group on one worker:
+            # exchange the input rows, then aggregate in one step
             if keys:
                 ex = ExchangeNode(child, REPARTITION, list(keys))
                 return (AggregationNode(ex, keys, node.aggregations, SINGLE),
@@ -150,7 +156,7 @@ class ExchangePlanner:
         intermediates: List[List[Symbol]] = []
         for sym, call in node.aggregations:
             fn = resolve_aggregate(call.name, [a.type for a in call.args],
-                                   call.distinct)
+                                   call.distinct, call.params)
             intermediates.append(
                 [self.symbols.new_symbol(f"{sym.name}$s{i}", it)
                  for i, it in enumerate(fn.intermediate_types)])

@@ -9,6 +9,7 @@ the CBO (cost/StatsCalculator) analogue, narrowed to what join ordering needs.
 from __future__ import annotations
 
 import dataclasses
+import itertools
 from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 from ...metadata import MetadataManager, Session
@@ -29,6 +30,7 @@ def optimize(plan: PlanNode, metadata: MetadataManager,
              session: Session) -> PlanNode:
     """PlanOptimizers.java pipeline (fixed order, two pushdown passes around the
     join reorder exactly like the reference runs PredicatePushDown twice)."""
+    plan = implement_distinct_aggregations(plan)
     plan = push_down_predicates(plan)
     plan = reorder_joins(plan, metadata)
     plan = push_down_predicates(plan)
@@ -525,3 +527,78 @@ def remove_identity_projects(plan: PlanNode) -> PlanNode:
             return node.source
         return None
     return rewrite_plan(plan, visit)
+
+
+_DISTINCT_CTR = itertools.count()
+
+
+def implement_distinct_aggregations(plan: PlanNode) -> PlanNode:
+    """agg(DISTINCT x) -> aggregate over (keys, x)-deduplicated rows.
+
+    The reference implements distinct aggregates with MarkDistinctOperator
+    (streaming per-group hash sets); this engine's page kernels are
+    reduction-shaped, so distinct is desugared structurally instead:
+
+        Agg[k; f(DISTINCT x), g(y)]
+          -> Join on k of
+               Agg[k; g(y)](src)                              # plain branch
+               Agg[k; f(x)](Agg[k, x; ](src))                 # dedup branch
+
+    One dedup branch per distinct argument tuple; branches join on the group
+    keys (cross join when global). The single-branch case (all aggregates
+    distinct over one argument list — the common COUNT(DISTINCT x) shape)
+    needs no join at all. The multi-branch join is NULL-safe: each side joins
+    on (COALESCE(k, 0), CAST(k IS NULL AS BIGINT)) pairs, so NULL group keys
+    match their counterparts instead of dropping (IS NOT DISTINCT FROM).
+    """
+
+    def fn(node):
+        if not isinstance(node, AggregationNode) or \
+                not any(c.distinct for _, c in node.aggregations):
+            return None
+        src = node.source
+        keys = list(node.keys)
+        plain = [(s, c) for s, c in node.aggregations if not c.distinct]
+        dgroups: Dict[tuple, list] = {}
+        for s, c in node.aggregations:
+            if c.distinct:
+                dgroups.setdefault((tuple(c.args), c.filter), []).append((s, c))
+
+        branches = []          # (node, agg_output_syms)
+        if plain:
+            branches.append((AggregationNode(src, keys, plain),
+                             [s for s, _ in plain]))
+        for (args, filt), calls in dgroups.items():
+            dd_keys = list(keys)
+            for a in list(args) + ([filt] if filt is not None else []):
+                if a not in dd_keys:
+                    dd_keys.append(a)
+            dedup = AggregationNode(src, dd_keys, [])
+            calls2 = [(s, dataclasses.replace(c, distinct=False))
+                      for s, c in calls]
+            branches.append((AggregationNode(dedup, keys, calls2),
+                             [s for s, _ in calls2]))
+
+        # NULL-key note: this engine's aggregation outputs carry no null masks
+        # on key columns (NULL keys group with their zero data value — the
+        # same conflation in EVERY branch), so the value join below loses no
+        # groups relative to the engine's own grouping semantics; when
+        # null-distinct grouping lands, these criteria must become
+        # IS NOT DISTINCT FROM.
+        result, _ = branches[0]
+        for br, br_aggs in branches[1:]:
+            if keys:
+                fresh = [Symbol(f"{k.name}$dd{next(_DISTINCT_CTR)}", k.type)
+                         for k in keys]
+                proj = ProjectNode(br, [
+                    (fk, SymbolRef(k.type, k.name))
+                    for fk, k in zip(fresh, keys)
+                ] + [(s, SymbolRef(s.type, s.name)) for s in br_aggs])
+                result = JoinNode("inner", result, proj,
+                                  list(zip(keys, fresh)))
+            else:
+                result = JoinNode("inner", result, br, [])
+        return ProjectNode(
+            result, [(s, SymbolRef(s.type, s.name)) for s in node.outputs()])
+
+    return rewrite_plan(plan, fn)

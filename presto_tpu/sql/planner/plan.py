@@ -121,6 +121,8 @@ class AggregationCall:
     args: Tuple[Symbol, ...]      # pre-projected inputs ((), for count(*))
     distinct: bool = False
     filter: Optional[Symbol] = None  # boolean mask symbol (FILTER / mark-distinct)
+    # literal (non-column) parameters, e.g. approx_percentile's fraction
+    params: Tuple[object, ...] = ()
 
 
 PARTIAL, FINAL, SINGLE = "partial", "final", "single"

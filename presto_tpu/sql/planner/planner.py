@@ -620,8 +620,9 @@ class LogicalPlanner:
             if a in ast_subst:
                 continue
             name = a.name.lower()
+            params, value_args = _extract_agg_params(name, list(a.args), tr)
             arg_syms, arg_types = [], []
-            for arg in a.args:
+            for arg in value_args:
                 ae = tr.translate(arg)
                 arg_syms.append(pre_project(ae, _name_of(arg, j)))
                 arg_types.append(ae.type)
@@ -631,7 +632,8 @@ class LogicalPlanner:
             out_t = aggregate_output_type(name, arg_types)
             asym = self.symbols.new_symbol(name, out_t)
             aggregations.append(
-                (asym, AggregationCall(name, tuple(arg_syms), a.distinct, filt)))
+                (asym, AggregationCall(name, tuple(arg_syms), a.distinct, filt,
+                                       params)))
             marker = f"$cagg{j}"
             ast_subst[a] = t.Identifier(marker)
             post_fields.append(Field(marker, asym, None))
@@ -858,9 +860,10 @@ class LogicalPlanner:
             if a in ast_subst:
                 continue
             name = a.name.lower()
+            params, value_args = _extract_agg_params(name, list(a.args), tr)
             arg_syms = []
             arg_types = []
-            for arg in a.args:
+            for arg in value_args:
                 ae = tr.translate(arg)
                 arg_syms.append(pre_project(ae, _name_of(arg, j)))
                 arg_types.append(ae.type)
@@ -871,7 +874,8 @@ class LogicalPlanner:
             out_t = aggregate_output_type(name, arg_types)
             sym = self.symbols.new_symbol(name, out_t)
             aggregations.append(
-                (sym, AggregationCall(name, tuple(arg_syms), a.distinct, filt)))
+                (sym, AggregationCall(name, tuple(arg_syms), a.distinct, filt,
+                                      params)))
             marker = f"$agg{j}"
             ast_subst[a] = t.Identifier(marker)
             post_fields.append(Field(marker, sym, None))
@@ -1097,3 +1101,23 @@ def _find_grouping_calls(ast: t.Node) -> List[t.FunctionCall]:
 
     walk(ast)
     return out
+
+
+def _extract_agg_params(name: str, value_args: list, tr) -> Tuple[Tuple, list]:
+    """Pull literal (non-column) aggregate parameters out of the argument list
+    (approx_percentile's fraction), validating at ANALYSIS time so users get a
+    SemanticError rather than an internal error from the exchange planner."""
+    if name != "approx_percentile":
+        return (), value_args
+    if len(value_args) != 2:
+        raise SemanticError("approx_percentile takes (value, fraction)")
+    frac = tr.translate(value_args[1])
+    if not isinstance(frac, Constant) or frac.value is None:
+        raise SemanticError("approx_percentile fraction must be a literal")
+    v = frac.value
+    if isinstance(frac.type, DecimalType):
+        v = v / 10 ** frac.type.scale
+    v = float(v)
+    if not 0.0 < v <= 1.0:
+        raise SemanticError("approx_percentile fraction must be in (0, 1]")
+    return (v,), value_args[:1]

@@ -143,8 +143,8 @@ class SqliteOracle:
             f"INSERT INTO {table} VALUES ({', '.join('?' * len(names))})",
             rows)
 
-    def query(self, sql: str) -> List[tuple]:
-        return self.conn.execute(sql).fetchall()
+    def query(self, sql: str, params: tuple = ()) -> List[tuple]:
+        return self.conn.execute(sql, params).fetchall()
 
 
 def normalize_value(v: Any) -> Any:

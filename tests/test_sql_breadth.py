@@ -131,3 +131,56 @@ def test_ntile_more_buckets_than_rows(runner, oracle):
     _check(runner, oracle,
            "select n_nationkey, ntile(40) over (order by n_nationkey) "
            "from nation order by 1")
+
+
+@pytest.fixture(scope="module")
+def orders_oracle():
+    o = SqliteOracle()
+    o.load_tpch(0.01, ["orders"])
+    return o
+
+
+def test_count_distinct_global(runner, orders_oracle):
+    _check(runner, orders_oracle,
+           "select count(distinct o_custkey) from orders")
+
+
+def test_count_distinct_grouped(runner, orders_oracle):
+    _check(runner, orders_oracle,
+           "select o_orderstatus, count(distinct o_custkey) from orders "
+           "group by 1 order by 1")
+
+
+def test_mixed_distinct_and_plain_aggregates(runner, orders_oracle):
+    _check(runner, orders_oracle,
+           "select o_orderstatus, count(distinct o_custkey), count(*), "
+           "sum(o_totalprice), sum(distinct o_shippriority) from orders "
+           "group by 1 order by 1")
+
+
+def test_approx_distinct_accuracy(runner, orders_oracle):
+    # HLL m=2048 -> ~2.3% standard error; 5% is a generous determinism bound
+    got = runner.execute(
+        "select approx_distinct(o_custkey) from orders").rows[0][0]
+    (exact,), = orders_oracle.query(
+        "select count(distinct o_custkey) from orders")
+    assert abs(got - exact) / exact < 0.05
+
+
+def test_approx_percentile(runner, orders_oracle):
+    got = runner.execute(
+        "select approx_percentile(o_totalprice, 0.5) from orders").rows[0][0]
+    vals = sorted(v for (v,) in orders_oracle.query(
+        "select o_totalprice from orders"))
+    exact = float(vals[len(vals) // 2])
+    assert abs(float(got) - exact) / exact < 0.10  # log-bucket sketch ~4% rel
+
+    grouped = runner.execute(
+        "select o_orderstatus, approx_percentile(o_totalprice, 0.9) "
+        "from orders group by 1 order by 1").rows
+    for status, got90 in grouped:
+        sv = sorted(v for (v,) in orders_oracle.query(
+            "select o_totalprice from orders where o_orderstatus = ?",
+            (status,)))
+        exact90 = float(sv[int(0.9 * len(sv))])
+        assert abs(float(got90) - exact90) / exact90 < 0.10
