@@ -75,3 +75,33 @@ def test_q64(runner, oracle):
 def test_q72(runner, oracle):
     res = check(runner, oracle, Q72, ordered=True)
     assert len(res.rows) > 0, "Q72 returned no rows — data correlation too thin"
+
+
+@pytest.mark.parametrize("qid", [3, 7, 19, 25, 42, 52, 55])
+def test_breadth_query(runner, oracle, qid):
+    from presto_tpu.models.tpcds_sql import QUERIES
+
+    check(runner, oracle, QUERIES[qid], ordered=True)
+
+
+def test_q36_rollup(runner, oracle):
+    """Q36's ROLLUP + grouping() — sqlite has no ROLLUP, so the oracle runs
+    the manual union desugaring of the same query."""
+    from presto_tpu.models.tpcds_sql import Q36
+
+    got = runner.execute(Q36).rows
+    base = """
+      from store_sales, date_dim, item, store
+      where d_year = 1999 and d_date_sk = ss_sold_date_sk
+        and i_item_sk = ss_item_sk and s_store_sk = ss_store_sk"""
+    exp = oracle.query(f"""
+      select * from (
+        select sum(ss_net_profit), i_category_id, i_class_id, 0, count(*)
+          {base} group by i_category_id, i_class_id
+        union all
+        select sum(ss_net_profit), i_category_id, null, 1, count(*)
+          {base} group by i_category_id
+        union all
+        select sum(ss_net_profit), null, null, 2, count(*) {base})
+      order by 4 desc, 2, 3 limit 100""")
+    assert_rows_equal(got, exp, ordered=True)
