@@ -1,10 +1,13 @@
-"""File connector: directory-backed tables in the PCOL columnar format.
+"""File connector: directory-backed tables in PCOL or PARQUET.
 
 The engine's presto-hive analogue, radically narrowed: a catalog roots at a
-directory, `<base>/<schema>/<table>/*.pcol` are the table's files. Reads are
-native-mmap scans with header-stats SPLIT PRUNING (the ORC stripe-skipping
-pattern) plus libpcol range pre-filters; writes (CTAS/INSERT) produce new
-immutable pcol files — one per writer sink, the classic append-only layout.
+directory, `<base>/<schema>/<table>/*.pcol` (or `*.parquet`) are the table's
+files. PCOL reads are native-mmap scans with header-stats SPLIT PRUNING (the
+ORC stripe-skipping pattern) plus libpcol range pre-filters; PARQUET reads go
+through the engine's own reader (formats/parquet.py — the presto-parquet
+analogue) with one split per row group, pruned by row-group statistics.
+Writes (CTAS/INSERT) produce new immutable pcol files — one per writer sink,
+the classic append-only layout.
 
 Dictionary handling: each table exposes ONE unioned dictionary per varchar
 column (built from all files' persisted dictionaries); per-file codes remap
@@ -24,7 +27,9 @@ from typing import Dict, Iterator, List, Optional, Sequence, Tuple
 import numpy as np
 
 from ...block import Block, Dictionary, Page
+from ...formats.parquet import ParquetFile
 from ...formats.pcol import PcolFile, write_pcol
+from ...types import is_string
 from ...spi.connector import (ColumnHandle, ColumnMetadata, ColumnStatistics,
                               Connector, ConnectorMetadata,
                               ConnectorPageSink, ConnectorPageSinkProvider,
@@ -77,7 +82,7 @@ class FileMetadata(ConnectorMetadata):
         if not os.path.isdir(d):
             return []
         return sorted(os.path.join(d, f) for f in os.listdir(d)
-                      if f.endswith(".pcol"))
+                      if f.endswith(".pcol") or f.endswith(".parquet"))
 
     def _load(self, name: SchemaTableName) -> Optional[_TableInfo]:
         files = self._files_of(name)
@@ -88,6 +93,13 @@ class FileMetadata(ConnectorMetadata):
             cached = self._cache.get(name)
             if cached is not None and cached.signature == sig:
                 return cached
+        has_parquet = any(f.endswith(".parquet") for f in files)
+        if has_parquet:
+            if not all(f.endswith(".parquet") for f in files):
+                raise RuntimeError(
+                    f"table {name} mixes parquet and pcol files — "
+                    f"unsupported (parquet tables are read-only)")
+            return self._load_parquet(name, files, sig)
         headers = []
         rows = 0
         for f in files:
@@ -115,6 +127,49 @@ class FileMetadata(ConnectorMetadata):
                 e["name"], _type_from_tag(e["type"], e["scale"]),
                 dictionary=d))
         info = _TableInfo(TableMetadata(name, tuple(cols)), files, rows, sig)
+        with self._lock:
+            self._cache[name] = info
+        return info
+
+    def _load_parquet(self, name: SchemaTableName, files: List[str],
+                      sig) -> _TableInfo:
+        """Schema from the first parquet file. Varchar columns get ONE
+        table-wide SORTED Dictionary built at load by decoding every file's
+        string values once (dictionary-encoded parquet pages make this a
+        near-metadata read) — plan-time string predicates need the complete
+        code space (reference: hive table dictionaries from ORC metadata)."""
+        rows = 0
+        schema = None
+        string_values: Dict[str, set] = {}
+        for f in files:
+            pf = ParquetFile(f)
+            if schema is None:
+                schema = pf.schema
+            rows += pf.num_rows
+            str_cols = [n for n, t in pf.schema if is_string(t)]
+            for n in str_cols:
+                vals_set = string_values.setdefault(n, set())
+                # cheap path: union the files' own dictionary pages
+                distinct = pf.column_distinct_strings(n)
+                if distinct is not None:
+                    vals_set.update(distinct)
+                    continue
+                # PLAIN-encoded fallback: decode the column once
+                for gi in range(pf.n_row_groups):
+                    if pf.row_group_rows(gi) == 0:
+                        continue
+                    vals, nulls = pf.read_row_group(gi, [n])[n]
+                    if nulls is not None:
+                        vals = vals[~nulls]
+                    vals_set.update(np.unique(vals.astype(str)).tolist())
+            pf.close()
+        cols = tuple(
+            ColumnMetadata(
+                n, t,
+                dictionary=Dictionary(sorted(string_values.get(n, ())))
+                if is_string(t) else None)
+            for n, t in schema)
+        info = _TableInfo(TableMetadata(name, cols), files, rows, sig)
         with self._lock:
             self._cache[name] = info
         return info
@@ -155,6 +210,11 @@ class FileMetadata(ConnectorMetadata):
         write_pcol(os.path.join(d, "00000000.pcol"), names, types, dicts, [])
 
     def begin_insert(self, table: TableHandle):
+        files = self._files_of(table.schema_table)
+        if any(f.endswith(".parquet") for f in files):
+            raise RuntimeError(
+                f"table {table.schema_table} is parquet-backed and read-only "
+                f"(writes produce pcol files, which cannot mix with parquet)")
         return table
 
     def finish_insert(self, handle, fragments) -> None:
@@ -184,6 +244,8 @@ class FileSplitManager(ConnectorSplitManager):
     def get_splits(self, table: TableHandle, constraint: Constraint,
                    desired_splits: int) -> List[Split]:
         info = self._metadata.table_info(table)
+        if info.files and info.files[0].endswith(".parquet"):
+            return self._parquet_splits(table, info, constraint)
         splits = []
         for b, f in enumerate(info.files):
             pf = PcolFile(f)
@@ -207,6 +269,38 @@ class FileSplitManager(ConnectorSplitManager):
                                     bucket=b))
         return splits  # [] = every file pruned: the scan yields no pages
 
+    def _parquet_splits(self, table: TableHandle, info: _TableInfo,
+                        constraint: Constraint) -> List[Split]:
+        """One split per row group, pruned by row-group min/max statistics
+        (the reference's OrcPredicate stripe/row-group skipping)."""
+        splits = []
+        b = 0
+        for f in info.files:
+            pf = ParquetFile(f)
+            try:
+                for g in range(pf.n_row_groups):
+                    keep = pf.row_group_rows(g) > 0
+                    if keep and constraint.domains:
+                        for col, dom in constraint.domains.items():
+                            lo, hi = dom if isinstance(dom, tuple) else (None, None)
+                            stats = pf.row_group_stats(g, col)
+                            if stats is None or stats[0] is None or \
+                                    isinstance(stats[0], str):
+                                continue
+                            mn, mx = stats
+                            if (hi is not None and mn > hi) or \
+                                    (lo is not None and mx < lo):
+                                keep = False
+                                break
+                    if keep:
+                        splits.append(Split(self.connector_id,
+                                            payload=(table.schema_table, f, g),
+                                            bucket=b))
+                    b += 1
+            finally:
+                pf.close()
+        return splits
+
 
 class FilePageSource(ConnectorPageSource):
     def __init__(self, metadata: FileMetadata, split: Split,
@@ -219,6 +313,9 @@ class FilePageSource(ConnectorPageSource):
         self.constraint = constraint
 
     def __iter__(self) -> Iterator[Page]:
+        if len(self.split.payload) == 3:
+            yield from self._iter_parquet()
+            return
         name, path = self.split.payload
         info = self._metadata._load(name)
         table_dicts = {c.name: c.dictionary for c in info.metadata.columns}
@@ -264,6 +361,70 @@ class FilePageSource(ConnectorPageSource):
                 yield Page(tuple(blocks), mask)
         finally:
             pf.close()
+
+    def _iter_parquet(self) -> Iterator[Page]:
+        name, path, group = self.split.payload
+        info = self._metadata._load(name)
+        table_dicts = {c.name: c.dictionary for c in info.metadata.columns}
+        types = {c.name: c.type for c in info.metadata.columns}
+        names = [c.name for c in self.columns]
+        pf = ParquetFile(path)
+        try:
+            data = pf.read_row_group(group, names)
+        finally:
+            pf.close()
+        n = pf.row_group_rows(group)
+        from ...utils.batching import clamp_capacity
+        cap = clamp_capacity(n, self.capacity)
+        cols = {}
+        for cname in names:
+            vals, nulls = data[cname]
+            d = table_dicts.get(cname)
+            if d is not None:
+                # re-encode into the table dictionary built at load; python
+                # work is per-DISTINCT value, not per row. Null slots carry a
+                # placeholder code 0 under their null flag.
+                strs = np.asarray([u"" if v is None else v for v in vals],
+                                  dtype=object)
+                uniq, inv = np.unique(strs.astype(str), return_inverse=True)
+                index = d.index()
+                nl = data[cname][1]
+                umap = np.empty(len(uniq), dtype=np.int32)
+                for ui, u in enumerate(uniq):
+                    code = index.get(u)
+                    if code is None:
+                        if nl is not None and u == "":
+                            # null placeholder under the null flag; -1 is the
+                            # dictionary's absent sentinel (lookup -> None)
+                            code = -1
+                        else:
+                            raise RuntimeError(
+                                f"{path}: value {u!r} missing from the "
+                                f"table dictionary of {cname} — stale "
+                                f"metadata cache? (file changed in place)")
+                    umap[ui] = code
+                vals = umap[inv]
+            cols[cname] = (vals, nulls)
+        for lo in range(0, max(n, 1), cap):
+            hi = min(lo + cap, n)
+            n_rows = hi - lo
+            blocks = []
+            for cname in names:
+                vals, nulls = cols[cname]
+                tt = types[cname]
+                seg = np.asarray(vals[lo:hi]).astype(tt.np_dtype, copy=False)
+                if n_rows < cap:
+                    seg = np.concatenate(
+                        [seg, np.zeros(cap - n_rows, dtype=seg.dtype)])
+                nseg = None
+                if nulls is not None:
+                    nseg = np.zeros(cap, dtype=bool)
+                    nseg[:n_rows] = nulls[lo:hi]
+                blocks.append(Block(tt, seg, nseg, table_dicts.get(cname)))
+            mask = np.arange(cap) < n_rows
+            yield Page(tuple(blocks), mask)
+            if n == 0:
+                break
 
     def _native_prefilter(self, pf: PcolFile) -> Optional[np.ndarray]:
         """AND together pushed-down ranges via libpcol's native scan kernels

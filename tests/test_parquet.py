@@ -1,0 +1,184 @@
+"""Parquet ingest: the engine's own reader (formats/parquet.py) + the file
+connector's parquet tables, verified against pyarrow-written files and the
+sqlite oracle.
+
+Reference analogue: presto-parquet reader + presto-hive page sources; pyarrow
+appears here ONLY as the fixture writer — the read path under test is the
+engine's own decoder (footer thrift, PLAIN/RLE_DICTIONARY pages, codecs)."""
+import decimal
+import os
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from presto_tpu.connectors.file import FileConnector
+from presto_tpu.connectors.tpch import generator as g
+from presto_tpu.formats.parquet import ParquetFile, snappy_decompress
+from presto_tpu.metadata import CatalogManager, Session
+from presto_tpu.runner import LocalQueryRunner
+from presto_tpu.utils.testing import SqliteOracle, assert_rows_equal
+
+
+# ---------------------------------------------------------------- reader unit
+
+@pytest.mark.parametrize("codec,v2", [("snappy", False), ("zstd", False),
+                                      ("gzip", False), ("none", False),
+                                      ("snappy", True)])
+def test_reader_matrix(tmp_path, codec, v2):
+    n = 4000
+    rng = np.random.default_rng(0)
+    tbl = pa.table({
+        "a_i64": pa.array(rng.integers(-2**40, 2**40, n)),
+        "a_i32": pa.array(rng.integers(-2**30, 2**30, n), type=pa.int32()),
+        "a_f64": pa.array(rng.standard_normal(n)),
+        "a_bool": pa.array(rng.integers(0, 2, n).astype(bool)),
+        "a_str": pa.array([f"v{int(x)}" for x in rng.integers(0, 40, n)]),
+        "a_dec": pa.array([decimal.Decimal(int(x)) / 100
+                           for x in rng.integers(-10**6, 10**6, n)],
+                          type=pa.decimal128(12, 2)),
+        "a_null": pa.array([None if i % 7 == 0 else i for i in range(n)]),
+    })
+    path = str(tmp_path / "t.parquet")
+    pq.write_table(tbl, path, compression=codec,
+                   data_page_version="2.0" if v2 else "1.0",
+                   row_group_size=1500)
+    pf = ParquetFile(path)
+    assert pf.num_rows == n
+    off = 0
+    for gi in range(pf.n_row_groups):
+        rows = pf.row_group_rows(gi)
+        got = pf.read_row_group(gi, [nm for nm, _ in pf.schema])
+        sl = slice(off, off + rows)
+        assert np.array_equal(got["a_i64"][0], tbl["a_i64"].to_numpy()[sl])
+        assert np.array_equal(got["a_i32"][0], tbl["a_i32"].to_numpy()[sl])
+        assert np.array_equal(got["a_f64"][0], tbl["a_f64"].to_numpy()[sl])
+        assert np.array_equal(got["a_bool"][0], tbl["a_bool"].to_numpy()[sl])
+        assert list(got["a_str"][0]) == tbl["a_str"].to_pylist()[sl]
+        dec = np.array([int(d * 100) for d in tbl["a_dec"].to_pylist()[sl]])
+        assert np.array_equal(got["a_dec"][0], dec)
+        nulls = got["a_null"][1]
+        assert np.array_equal(
+            nulls, np.array([i % 7 == 0 for i in range(off, off + rows)]))
+        off += rows
+    pf.close()
+
+
+def test_snappy_roundtrip_python():
+    # own decoder vs pyarrow-written snappy pages is covered above; this pins
+    # the raw-format decoder on crafted streams (literals + overlapping copy)
+    import pyarrow as _pa
+
+    data = b"abcdefgh" * 500 + os.urandom(128) + b"x" * 1000
+    comp = _pa.compress(data, codec="snappy", asbytes=True)
+    assert snappy_decompress(comp) == data
+
+
+def test_row_group_stats_pruning(tmp_path):
+    tbl = pa.table({"k": pa.array(np.arange(10000)),
+                    "v": pa.array(np.arange(10000) * 2)})
+    path = str(tmp_path / "t.parquet")
+    pq.write_table(tbl, path, row_group_size=1000)
+    pf = ParquetFile(path)
+    assert pf.n_row_groups == 10
+    assert pf.row_group_stats(0, "k") == (0, 999)
+    assert pf.row_group_stats(9, "k") == (9000, 9999)
+    pf.close()
+
+
+# ----------------------------------------------------------- connector + SQL
+
+def _tpch_parquet_catalog(tmp_path) -> CatalogManager:
+    """Export tiny TPC-H (lineitem/orders/customer) to parquet files through
+    pyarrow, rooted for the file connector."""
+    base = str(tmp_path / "warehouse")
+    sf = 0.01
+    orders_n = g.TPCH_TABLES["orders"].row_count(sf)
+
+    def arrow_col(name, arr, ctype, cdict):
+        from presto_tpu.types import DecimalType, DateType, is_string
+
+        if cdict is not None:
+            return pa.array([str(v) for v in cdict.lookup(
+                np.asarray(arr, dtype=np.int64))])
+        if isinstance(ctype, DecimalType):
+            q = decimal.Decimal(1).scaleb(-ctype.scale)
+            return pa.array(
+                [decimal.Decimal(int(v)).scaleb(-ctype.scale) for v in arr],
+                type=pa.decimal128(max(ctype.precision, 18), ctype.scale))
+        if isinstance(ctype, DateType):
+            return pa.array(np.asarray(arr, dtype="datetime64[D]"))
+        return pa.array(np.asarray(arr))
+
+    def export(table, cols):
+        d = os.path.join(base, "default", table)
+        os.makedirs(d)
+        info = {c.name: c for c in g.TPCH_TABLES[table].columns} \
+            if table != "lineitem" else None
+        if table == "lineitem":
+            data = g.lineitem_for_orders(0, orders_n, sf, cols)
+            meta = {n: (t, dd) for (n, t, dd) in g.LINEITEM_COLUMNS}
+        else:
+            n = g.TPCH_TABLES[table].row_count(sf)
+            data = g.generate_rows(table, 0, n, sf, cols)
+            meta = {c.name: (c.type, c.dictionary)
+                    for c in g.TPCH_TABLES[table].columns}
+        arrays = {}
+        for c in cols:
+            t, dd = meta[c]
+            arrays[c] = arrow_col(c, data[c], t, dd)
+        pq.write_table(pa.table(arrays),
+                       os.path.join(d, "part0.parquet"),
+                       compression="snappy", row_group_size=20000)
+
+    export("lineitem", ["l_orderkey", "l_quantity", "l_extendedprice",
+                        "l_discount", "l_tax", "l_returnflag", "l_linestatus",
+                        "l_shipdate"])
+    export("orders", ["o_orderkey", "o_custkey", "o_orderdate",
+                      "o_shippriority"])
+    export("customer", ["c_custkey", "c_mktsegment"])
+    cat = CatalogManager()
+    cat.register("files", FileConnector("files", base))
+    return cat
+
+
+@pytest.fixture(scope="module")
+def pq_runner(tmp_path_factory):
+    cat = _tpch_parquet_catalog(tmp_path_factory.mktemp("pq"))
+    return LocalQueryRunner(
+        session=Session(catalog="files", schema="default"), catalogs=cat)
+
+
+@pytest.fixture(scope="module")
+def oracle():
+    o = SqliteOracle()
+    o.load_tpch(0.01, ["lineitem", "orders", "customer"])
+    return o
+
+
+@pytest.mark.parametrize("qid", [1, 3, 6])
+def test_parquet_tpch_query(pq_runner, oracle, qid):
+    """The VERDICT bar: TPC-H loaded from parquet files, Q1/Q3/Q6 matching
+    the oracle through the FULL SQL path."""
+    from test_sql_e2e import to_sqlite
+    from presto_tpu.models.tpch_sql import QUERIES
+
+    got = pq_runner.execute(QUERIES[qid]).rows
+    exp = oracle.query(to_sqlite(QUERIES[qid]))
+    assert_rows_equal(got, exp, ordered=True)
+
+
+def test_parquet_split_pruning_via_sql(tmp_path):
+    base = str(tmp_path / "w")
+    os.makedirs(os.path.join(base, "default", "seq"))
+    tbl = pa.table({"k": pa.array(np.arange(50000))})
+    pq.write_table(tbl, os.path.join(base, "default", "seq", "p.parquet"),
+                   row_group_size=5000)
+    cat = CatalogManager()
+    cat.register("files", FileConnector("files", base))
+    r = LocalQueryRunner(session=Session(catalog="files", schema="default"),
+                         catalogs=cat)
+    out = r.execute("select count(*), min(k), max(k) from seq "
+                    "where k between 12000 and 13000")
+    assert out.rows == [[1001, 12000, 13000]]
