@@ -1,7 +1,7 @@
 """presto_tpu — a TPU-native distributed SQL query engine.
 
 A ground-up re-design of the reference engine (frankzye/presto, Presto 0.220) for TPU
-hardware: columnar pages as dense JAX arrays, physical operators as jitted XLA/Pallas
+hardware: columnar pages as dense JAX arrays, physical operators as jitted XLA
 kernels, distributed exchange as ICI-mesh collectives under shard_map, and a Python
 control plane (parser/analyzer/planner/scheduler) where the reference uses latency-
 tolerant Java coordinator code.

@@ -40,13 +40,29 @@ class ClusterQueryRunner:
     def __init__(self, session: Optional[Session] = None,
                  catalogs: Optional[CatalogManager] = None,
                  min_workers: int = 1,
-                 worker_wait_s: float = 30.0):
+                 worker_wait_s: float = 30.0,
+                 cluster_memory_limit_bytes: Optional[int] = None):
         self.local = LocalQueryRunner(session, catalogs)
         self.nodes = DiscoveryNodeManager()
         self.detector = HeartbeatFailureDetector(self.nodes).start()
         self.min_workers = min_workers
         self.worker_wait_s = worker_wait_s
         self._ids = itertools.count(1)
+        self._schedulers: Dict[str, SqlQueryScheduler] = {}
+        self.memory_manager = None
+        if cluster_memory_limit_bytes is not None:
+            from .memory_manager import ClusterMemoryManager
+
+            self.memory_manager = ClusterMemoryManager(
+                self.nodes, kill_query=self._kill_query,
+                limit_bytes=cluster_memory_limit_bytes).start()
+
+    def _kill_query(self, query_id: str) -> None:
+        """OOM-killer target: abort every task of the victim query
+        (ClusterMemoryManager -> LowMemoryKiller -> fail query)."""
+        sched = self._schedulers.get(query_id)
+        if sched is not None:
+            sched.abort()
 
     @property
     def metadata(self):
@@ -84,6 +100,9 @@ class ClusterQueryRunner:
 
     def execute(self, sql: str) -> QueryResult:
         stmt = self.local.parser.parse(sql)
+        # access control is enforced at the coordinator for EVERY statement
+        # (the local engine re-checks the ones it executes itself)
+        self.local._check_access(stmt)
         if not isinstance(stmt, t.Query):
             # DDL/DML/EXPLAIN/SHOW run on the coordinator's local engine
             return self.local.execute(sql)
@@ -92,6 +111,7 @@ class ClusterQueryRunner:
         query_id = f"cq{next(self._ids)}_{int(time.time())}"
         scheduler = SqlQueryScheduler(query_id, sub, nodes,
                                       self.local.session)
+        self._schedulers[query_id] = scheduler
         scheduler.schedule()
         try:
             return self._pull_results(scheduler, sub)
@@ -99,6 +119,7 @@ class ClusterQueryRunner:
             scheduler.abort()
             raise
         finally:
+            self._schedulers.pop(query_id, None)
             # free finished tasks' buffers/state on the workers
             for task in scheduler.all_tasks():
                 task.cancel(abort=False)

@@ -1,0 +1,91 @@
+"""Cluster memory manager: cross-worker memory accounting + OOM killing.
+
+Analogue of memory/ClusterMemoryManager.java:92 (coordinator polls every
+worker's memory state through its status endpoint) and the
+TotalReservationLowMemoryKiller policy: when the cluster's total reserved
+bytes stay over the limit for `grace_polls` consecutive polls, the query
+with the LARGEST total reservation across workers is killed — freeing the
+most memory with one victim, exactly the reference policy's choice.
+
+Workers report {query_id: bytes} via /v1/status (see worker.py); the kill
+action is injected so the coordinator wires its own task cancellation and
+tests wire a recorder.
+"""
+from __future__ import annotations
+
+import json
+import threading
+import urllib.request
+from typing import Callable, Dict, List, Optional
+
+
+class ClusterMemoryManager:
+    def __init__(self, nodes, kill_query: Callable[[str], None],
+                 limit_bytes: int = 32 << 30,
+                 poll_period_s: float = 1.0,
+                 grace_polls: int = 2,
+                 fetch_status: Optional[Callable[[str], Dict]] = None):
+        """`nodes` provides active_nodes() (DiscoveryNodeManager); a custom
+        `fetch_status(uri)` replaces the HTTP GET in tests."""
+        self.nodes = nodes
+        self.kill_query = kill_query
+        self.limit_bytes = limit_bytes
+        self.poll_period_s = poll_period_s
+        self.grace_polls = grace_polls
+        self._fetch = fetch_status or self._http_status
+        self._over_count = 0
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop,
+                                        name="cluster-memory", daemon=True)
+        self.last_total = 0
+        self.last_by_query: Dict[str, int] = {}
+        self.killed: List[str] = []
+
+    # ------------------------------------------------------------------ api
+
+    def start(self) -> "ClusterMemoryManager":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def poll_once(self) -> Optional[str]:
+        """One poll + policy step; returns the killed query id, if any."""
+        by_query: Dict[str, int] = {}
+        total = 0
+        for node in self.nodes.active_nodes():
+            try:
+                status = self._fetch(node.uri)
+            except Exception:  # noqa: BLE001 - dead nodes are the detector's job
+                continue
+            for qid, b in (status.get("queryMemory") or {}).items():
+                by_query[qid] = by_query.get(qid, 0) + int(b)
+                total += int(b)
+        self.last_total = total
+        self.last_by_query = by_query
+        if total <= self.limit_bytes or not by_query:
+            self._over_count = 0
+            return None
+        self._over_count += 1
+        if self._over_count < self.grace_polls:
+            return None  # transient spike: give revocation/spill a chance
+        victim = max(by_query.items(), key=lambda kv: kv[1])[0]
+        self._over_count = 0
+        self.killed.append(victim)
+        try:
+            self.kill_query(victim)
+        except Exception:  # noqa: BLE001 - kill is best-effort; retried next poll
+            pass
+        return victim
+
+    # ------------------------------------------------------------- internal
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.poll_period_s):
+            self.poll_once()
+
+    @staticmethod
+    def _http_status(uri: str) -> Dict:
+        with urllib.request.urlopen(f"{uri}/v1/status", timeout=2.0) as resp:
+            return json.loads(resp.read())

@@ -124,12 +124,22 @@ class _WorkerHandler(BaseHTTPRequestHandler):
             return self._send_codec(task.info())
         if path.rstrip("/") == "/v1/status":
             import json
-            active = sum(1 for t in self.worker.tasks.tasks.values()
-                         if t.state not in DONE_STATES)
+            active = 0
+            query_mem = {}
+            for t in self.worker.tasks.tasks.values():
+                if t.state in DONE_STATES:
+                    continue
+                active += 1
+                qid = t.request.query_id
+                query_mem[qid] = query_mem.get(qid, 0) + \
+                    t.output.retained_bytes()
             return self._send(json.dumps({
                 "nodeId": self.worker.node_id,
                 "state": self.worker.state,
                 "activeTasks": active,
+                # per-query reserved bytes — the ClusterMemoryManager's feed
+                # (memory/RemoteNodeMemory.java analogue)
+                "queryMemory": query_mem,
                 "uptime": round(time.time() - self.worker.start_time, 1),
             }).encode(), 200, [("Content-Type", "application/json")])
         self._send(b"not found", 404)

@@ -94,8 +94,65 @@ class LocalQueryRunner:
     def explain(self, sql: str) -> str:
         return plan_to_text(self.plan_sql(sql))
 
+    # access control: optional AccessControl attached by the server layer
+    # (security/AccessControlManager.java checkCanSelectFromColumns analogue —
+    # every referenced table is checked before planning; DDL/DML check their
+    # write privilege)
+    access_control = None
+
+    def _check_access(self, stmt) -> None:
+        ac = self.access_control
+        if ac is None:
+            return
+        from .security import AccessDeniedException  # noqa: F401  (re-raise type)
+        from .sql.analyzer import _ast_children
+
+        user = self.session.user
+
+        def resolve(name_parts):
+            qname = self.metadata.resolve_table_name(
+                self.session, tuple(p.lower() for p in name_parts))
+            return qname
+
+        def walk(node, cte_names=frozenset()):
+            if isinstance(node, t.Query) and node.with_ is not None:
+                names = set(cte_names)
+                for cte_name, cte_query in node.with_.queries:
+                    walk(cte_query, frozenset(names))  # body checked too
+                    names.add(cte_name.lower())
+                walk(node.body, frozenset(names))
+                for c in _ast_children(node):
+                    if c is not node.body and c is not node.with_:
+                        walk(c, frozenset(names))
+                return
+            if isinstance(node, t.Table):
+                # single-part names matching an in-scope CTE are not tables
+                if len(node.name) == 1 and node.name[0].lower() in cte_names:
+                    return
+                q = resolve(node.name)
+                ac.check_can_select(user, q.catalog, q.schema, q.table)
+                return
+            for c in _ast_children(node):
+                walk(c, cte_names)
+
+        if isinstance(stmt, t.CreateTableAsSelect):
+            q = resolve(stmt.name)
+            ac.check_can_write(user, q.catalog, q.schema, q.table, "create")
+            walk(stmt.query)
+        elif isinstance(stmt, t.Insert):
+            q = resolve(stmt.name)
+            ac.check_can_write(user, q.catalog, q.schema, q.table, "insert")
+            if stmt.query is not None:
+                walk(stmt.query)
+        elif isinstance(stmt, t.DropTable):
+            q = resolve(stmt.name)
+            ac.check_can_write(user, q.catalog, q.schema, q.table, "drop")
+        else:
+            walk(stmt)
+
     def execute(self, sql: str) -> QueryResult:
         stmt = self.parser.parse(sql)
+        self._check_access(stmt)
         if isinstance(stmt, t.Explain):
             inner = stmt.statement
             if not isinstance(inner, t.Query):

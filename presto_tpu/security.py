@@ -1,0 +1,85 @@
+"""Access control: system-level authorization hooks.
+
+Analogue of security/AccessControlManager.java + the file-based system
+access control plugin (FileBasedSystemAccessControl): every query checks
+can-execute; every table touch checks can-select (or create/insert/drop for
+DDL/DML) against an ordered rule list. First matching rule wins; no match =
+deny (the reference's file rules behave the same way). Default manager is
+allow-all, so embedding the engine stays zero-config.
+"""
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import List, Optional, Sequence
+
+
+class AccessDeniedException(Exception):
+    pass
+
+
+@dataclasses.dataclass
+class AccessRule:
+    """One file rule: user/catalog/schema/table regexes -> allowed privileges."""
+    user_regex: str = ".*"
+    catalog_regex: str = ".*"
+    schema_regex: str = ".*"
+    table_regex: str = ".*"
+    privileges: Sequence[str] = ("select", "insert", "create", "drop",
+                                 "execute")
+
+    def matches(self, user: str, catalog: str = "", schema: str = "",
+                table: str = "") -> bool:
+        return bool(re.fullmatch(self.user_regex, user or "")
+                    and re.fullmatch(self.catalog_regex, catalog or "")
+                    and re.fullmatch(self.schema_regex, schema or "")
+                    and re.fullmatch(self.table_regex, table or ""))
+
+
+class AccessControl:
+    """SPI surface (spi/security/SystemAccessControl.java, narrowed)."""
+
+    def check_can_execute_query(self, user: str) -> None:
+        pass
+
+    def check_can_select(self, user: str, catalog: str, schema: str,
+                         table: str) -> None:
+        pass
+
+    def check_can_write(self, user: str, catalog: str, schema: str,
+                        table: str, privilege: str) -> None:
+        """privilege in {insert, create, drop}."""
+
+
+class AllowAllAccessControl(AccessControl):
+    pass
+
+
+class FileBasedAccessControl(AccessControl):
+    """Ordered-rule authorization (FileBasedSystemAccessControl analogue)."""
+
+    def __init__(self, rules: Sequence[AccessRule]):
+        self.rules = list(rules)
+
+    def _check(self, privilege: str, user: str, catalog: str = "",
+               schema: str = "", table: str = "") -> None:
+        for rule in self.rules:
+            if rule.matches(user, catalog, schema, table):
+                if privilege in rule.privileges:
+                    return
+                break  # first match wins, even when it denies
+        target = ".".join(p for p in (catalog, schema, table) if p)
+        raise AccessDeniedException(
+            f"Access Denied: user {user!r} cannot {privilege}"
+            + (f" on {target}" if target else ""))
+
+    def check_can_execute_query(self, user: str) -> None:
+        self._check("execute", user)
+
+    def check_can_select(self, user: str, catalog: str, schema: str,
+                         table: str) -> None:
+        self._check("select", user, catalog, schema, table)
+
+    def check_can_write(self, user: str, catalog: str, schema: str,
+                        table: str, privilege: str) -> None:
+        self._check(privilege, user, catalog, schema, table)

@@ -75,7 +75,9 @@ class _Handler(BaseHTTPRequestHandler):
         if not sql:
             return self._send_json(
                 {"error": {"message": "empty statement"}}, status=400)
-        info = self.manager.submit(sql)
+        info = self.manager.submit(
+            sql, user=self.headers.get("X-Presto-User", ""),
+            source=self.headers.get("X-Presto-Source", ""))
         self._send_json(self.manager.results_payload(info, 0, self._base_uri()))
 
     def do_GET(self) -> None:  # noqa: N802
@@ -141,11 +143,27 @@ class _Handler(BaseHTTPRequestHandler):
 class PrestoTpuServer:
     """Server handle: serve() blocks, start() runs on a daemon thread."""
 
-    def __init__(self, runner=None, port: int = 8080, page_rows: int = 1000):
+    def __init__(self, runner=None, port: int = 8080, page_rows: int = 1000,
+                 resource_groups=None, listeners=None, access_control=None,
+                 transactions=True):
         if runner is None:
             from ..runner import LocalQueryRunner
             runner = LocalQueryRunner()
-        self.manager = QueryManager(runner, page_rows=page_rows)
+        monitor = None
+        if listeners:
+            from ..spi.eventlistener import QueryMonitor
+            monitor = QueryMonitor(list(listeners))
+        tx_manager = None
+        if transactions and getattr(runner, "catalogs", None) is not None:
+            from ..transaction import TransactionManager
+            tx_manager = TransactionManager(runner.catalogs)
+        if access_control is not None:
+            runner.access_control = access_control
+        self.manager = QueryManager(runner, page_rows=page_rows,
+                                    resource_groups=resource_groups,
+                                    monitor=monitor,
+                                    access_control=access_control,
+                                    transactions=tx_manager)
         handler = type("BoundHandler", (_Handler,), {"manager": self.manager})
         self.httpd = ThreadingHTTPServer(("0.0.0.0", port), handler)
         self.port = self.httpd.server_address[1]

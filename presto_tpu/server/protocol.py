@@ -43,6 +43,8 @@ class QueryInfo:
     create_time: float = dataclasses.field(default_factory=time.time)
     end_time: Optional[float] = None
     row_count: int = 0
+    user: str = ""
+    source: str = ""
 
     def done(self) -> bool:
         return self.state in _DONE
@@ -56,24 +58,36 @@ class QueryManager:
     engine — StatementResource's async pattern)."""
 
     def __init__(self, runner, page_rows: int = 1000,
-                 max_done_queries: int = 100):
+                 max_done_queries: int = 100,
+                 resource_groups=None, monitor=None, access_control=None,
+                 transactions=None):
         self.runner = runner
         self.page_rows = page_rows
         # completed-query history is bounded (SqlQueryManager's expiration):
         # oldest done queries are evicted, their materialized rows with them
         self.max_done_queries = max_done_queries
+        # service subsystems, all optional (None = allow-all / no-op):
+        self.resource_groups = resource_groups   # ResourceGroupManager
+        self.monitor = monitor                   # QueryMonitor (events)
+        self.access_control = access_control     # AccessControl
+        self.transactions = transactions         # TransactionManager
         self._queries: Dict[str, QueryInfo] = {}
         self._ids = itertools.count(1)
         self._lock = threading.Lock()
 
     # ----------------------------------------------------------------- api
 
-    def submit(self, sql: str) -> QueryInfo:
+    def submit(self, sql: str, user: str = "", source: str = "") -> QueryInfo:
         with self._lock:
             qid = f"q{next(self._ids)}_{int(time.time())}"
-            info = QueryInfo(qid, sql)
+            info = QueryInfo(qid, sql, user=user, source=source)
             self._queries[qid] = info
             self._expire_locked()
+        if self.monitor is not None:
+            from ..spi.eventlistener import QueryCreatedEvent
+
+            self.monitor.query_created(
+                QueryCreatedEvent(qid, sql, user=user, source=source))
         threading.Thread(target=self._run, args=(info,), daemon=True).start()
         return info
 
@@ -106,13 +120,33 @@ class QueryManager:
     # ------------------------------------------------------------- execute
 
     def _run(self, info: QueryInfo) -> None:
-        with self._lock:
-            if info.state != QUEUED:  # canceled before the thread started
-                return
-            info.state = RUNNING
+        ticket = None
+        tx = None
+        t0 = time.monotonic()
         try:
+            if self.access_control is not None:
+                self.access_control.check_can_execute_query(info.user)
+            if self.resource_groups is not None:
+                # may QUEUE the query (blocks this thread) or reject
+                ticket = self.resource_groups.submit(
+                    info.query_id, info.user, info.source)
+            with self._lock:
+                if info.state != QUEUED:  # canceled before the thread started
+                    return
+                info.state = RUNNING
+            if self.transactions is not None:
+                tx = self.transactions.begin(info.query_id)
+                # conservative join: every registered catalog (hooks are
+                # no-ops for connectors without transaction support), so any
+                # connector the query touches gets its commit/rollback —
+                # qualified cross-catalog writes included
+                for cat in self.transactions.catalog_names():
+                    self.transactions.join(tx, cat)
             result = self.runner.execute(info.sql)
             rows = [self._to_json_row(r) for r in result.rows]
+            if tx is not None:
+                self.transactions.commit(tx)
+                tx = None
             with self._lock:
                 if info.state == CANCELED:
                     return
@@ -131,6 +165,19 @@ class QueryManager:
                 }
                 info.state = FAILED
                 info.end_time = time.time()
+        finally:
+            if tx is not None:
+                self.transactions.abort(tx)
+            if ticket is not None:
+                self.resource_groups.finish(
+                    ticket, cpu_seconds=time.monotonic() - t0)
+            if self.monitor is not None:
+                from ..spi.eventlistener import QueryCompletedEvent
+
+                self.monitor.query_completed(QueryCompletedEvent(
+                    info.query_id, info.sql, state=info.state, user=info.user,
+                    row_count=info.row_count,
+                    wall_seconds=time.monotonic() - t0, error=info.error))
 
     @staticmethod
     def _type_name(result, i: int) -> str:

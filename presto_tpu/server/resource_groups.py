@@ -1,0 +1,239 @@
+"""Resource groups: admission control for the query manager.
+
+Analogue of execution/resourceGroups/InternalResourceGroup.java:78 and the
+file-backed configuration manager
+(presto-resource-group-managers/.../FileResourceGroupConfigurationManager.java):
+a tree of groups, each bounding concurrent running queries and queued
+queries, with weighted-fair dequeueing among sibling subgroups and per-
+(user, source) selector routing. CPU limits gate admission the way the
+reference's cpuQuota does (a group over its soft CPU limit admits nothing
+until usage decays).
+
+Narrowings: no per-group memory quota (the cluster memory manager owns
+memory), decay is linear per-second refund rather than a scheduler tick.
+"""
+from __future__ import annotations
+
+import dataclasses
+import re
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+
+@dataclasses.dataclass
+class GroupSpec:
+    """One group's configuration (file config analogue)."""
+    name: str
+    hard_concurrency_limit: int = 100
+    max_queued: int = 1000
+    scheduling_weight: int = 1
+    # CPU seconds per second of wall (refill rate); None = unlimited
+    cpu_quota_per_s: Optional[float] = None
+    sub_groups: List["GroupSpec"] = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
+class SelectorSpec:
+    """Routes (user, source) to a group path ('root.etl' style)."""
+    group: str
+    user_regex: Optional[str] = None
+    source_regex: Optional[str] = None
+
+    def matches(self, user: str, source: str) -> bool:
+        if self.user_regex and not re.fullmatch(self.user_regex, user or ""):
+            return False
+        if self.source_regex and not re.fullmatch(self.source_regex,
+                                                  source or ""):
+            return False
+        return True
+
+
+class QueryRejected(Exception):
+    """Admission refused (queue full) — maps to the client error."""
+
+
+class _Group:
+    def __init__(self, spec: GroupSpec, parent: Optional["_Group"]):
+        self.spec = spec
+        self.parent = parent
+        self.name = spec.name if parent is None else \
+            f"{parent.name}.{spec.name}"
+        self.children: Dict[str, _Group] = {}
+        self.running = 0           # queries running in THIS subtree
+        self.queue: List["_Ticket"] = []  # queued directly on this group
+        self.cpu_tokens = 0.0
+        self.cpu_updated = time.monotonic()
+        self._rr = 0               # weighted round-robin position
+        for sub in spec.sub_groups:
+            child = _Group(sub, self)
+            self.children[sub.name] = child
+
+    # -- cpu quota ----------------------------------------------------------
+
+    def _refill(self) -> None:
+        if self.spec.cpu_quota_per_s is None:
+            return
+        now = time.monotonic()
+        self.cpu_tokens = min(
+            self.spec.cpu_quota_per_s,  # burst bound: 1s worth
+            self.cpu_tokens + (now - self.cpu_updated) * self.spec.cpu_quota_per_s)
+        self.cpu_updated = now
+
+    def cpu_blocked(self) -> bool:
+        self._refill()
+        return self.spec.cpu_quota_per_s is not None and self.cpu_tokens <= 0
+
+    def charge_cpu(self, seconds: float) -> None:
+        g: Optional[_Group] = self
+        while g is not None:
+            if g.spec.cpu_quota_per_s is not None:
+                g._refill()
+                g.cpu_tokens -= seconds
+            g = g.parent
+
+    # -- admission ----------------------------------------------------------
+
+    def can_run(self) -> bool:
+        g: Optional[_Group] = self
+        while g is not None:
+            if g.running >= g.spec.hard_concurrency_limit or g.cpu_blocked():
+                return False
+            g = g.parent
+        return True
+
+    def start(self) -> None:
+        g: Optional[_Group] = self
+        while g is not None:
+            g.running += 1
+            g = g.parent
+
+    def finish(self) -> None:
+        g: Optional[_Group] = self
+        while g is not None:
+            g.running -= 1
+            g = g.parent
+
+    def eligible_queued(self) -> Optional["_Ticket"]:
+        """Next queued ticket in this subtree per weighted round-robin over
+        children, FIFO within a group (InternalResourceGroup's
+        internalGetWaitingQueuedQueries + weighted scheduling policy)."""
+        if self.queue and self.can_run():
+            return self.queue[0]
+        kids = [c for c in self.children.values()]
+        if not kids:
+            return None
+        # weighted RR: repeat each child proportionally to its weight
+        order: List[_Group] = []
+        for c in kids:
+            order.extend([c] * max(c.spec.scheduling_weight, 1))
+        n = len(order)
+        for i in range(n):
+            c = order[(self._rr + i) % n]
+            t = c.eligible_queued()
+            if t is not None:
+                self._rr = (self._rr + i + 1) % n
+                return t
+        return None
+
+
+class _Ticket:
+    def __init__(self, group: _Group, query_id: str):
+        self.group = group
+        self.query_id = query_id
+        self.admitted = threading.Event()
+        self.start_time = time.monotonic()
+
+
+class ResourceGroupManager:
+    """Admission controller: every query acquires a ticket before running.
+
+    submit() either admits immediately, queues (blocking the caller's worker
+    thread until capacity frees — the reference parks the query in QUEUED
+    state the same way), or rejects when the group's queue is full.
+    """
+
+    def __init__(self, root_spec: Optional[GroupSpec] = None,
+                 selectors: Sequence[SelectorSpec] = ()):
+        self.root = _Group(root_spec or GroupSpec("root", 1 << 30, 1 << 30),
+                           None)
+        self.selectors = list(selectors)
+        self._lock = threading.Lock()
+
+    def _resolve(self, user: str, source: str) -> _Group:
+        path = None
+        for sel in self.selectors:
+            if sel.matches(user, source):
+                path = sel.group
+                break
+        if path is None:
+            return self.root
+        g = self.root
+        for part in path.split(".")[1:]:  # path starts with root's name
+            g = g.children.get(part) or g
+        return g
+
+    def submit(self, query_id: str, user: str = "", source: str = "",
+               timeout_s: float = 300.0) -> _Ticket:
+        with self._lock:
+            group = self._resolve(user, source)
+            ticket = _Ticket(group, query_id)
+            if group.can_run():
+                group.start()
+                ticket.admitted.set()
+                return ticket
+            if len(group.queue) >= group.spec.max_queued:
+                raise QueryRejected(
+                    f"Too many queued queries for {group.name!r} "
+                    f"(max_queued {group.spec.max_queued})")
+            group.queue.append(ticket)
+        deadline = time.monotonic() + timeout_s
+        while not ticket.admitted.wait(min(1.0, timeout_s)):
+            # periodic re-promotion: cpu quotas refill with TIME, not only on
+            # query completion — without this tick a cpu-gated group whose
+            # last finish() ran while tokens were negative would starve its
+            # queue until timeout
+            with self._lock:
+                self._promote_locked()
+            if ticket.admitted.is_set():
+                break
+            if time.monotonic() > deadline:
+                with self._lock:
+                    if ticket.admitted.is_set():
+                        break
+                    try:
+                        ticket.group.queue.remove(ticket)
+                    except ValueError:
+                        pass
+                raise QueryRejected(
+                    f"Query exceeded queued time limit in {group.name!r}")
+        return ticket
+
+    def _promote_locked(self) -> None:
+        while True:
+            nxt = self.root.eligible_queued()
+            if nxt is None:
+                return
+            nxt.group.queue.remove(nxt)
+            nxt.group.start()
+            nxt.admitted.set()
+
+    def finish(self, ticket: _Ticket, cpu_seconds: float = 0.0) -> None:
+        with self._lock:
+            if cpu_seconds:
+                ticket.group.charge_cpu(cpu_seconds)
+            ticket.group.finish()
+            self._promote_locked()
+
+    def stats(self) -> Dict[str, Tuple[int, int]]:
+        """group name -> (running, queued), for /v1/resourceGroup."""
+        out: Dict[str, Tuple[int, int]] = {}
+
+        def walk(g: _Group):
+            out[g.name] = (g.running, len(g.queue))
+            for c in g.children.values():
+                walk(c)
+
+        with self._lock:
+            walk(self.root)
+        return out
