@@ -98,14 +98,14 @@ class ClusterQueryRunner:
                     f"(need {self.min_workers})")
             time.sleep(0.1)
 
-    def execute(self, sql: str) -> QueryResult:
+    def execute(self, sql: str, user=None) -> QueryResult:
         stmt = self.local.parser.parse(sql)
         # access control is enforced at the coordinator for EVERY statement
         # (the local engine re-checks the ones it executes itself)
-        self.local._check_access(stmt)
+        self.local._check_access(stmt, user)
         if not isinstance(stmt, t.Query):
             # DDL/DML/EXPLAIN/SHOW run on the coordinator's local engine
-            return self.local.execute(sql)
+            return self.local.execute(sql, user=user)
         sub = self.plan_sql(sql)
         nodes = self._wait_for_workers()
         query_id = f"cq{next(self._ids)}_{int(time.time())}"

@@ -175,8 +175,13 @@ class LocalExecutionPlan:
         ONCE per fragment and shared by every worker, so jitted kernels compile
         once; per-worker state (splits, lookup slots, sinks) is keyed off the
         worker index."""
-        return [Driver([f.create_operator(worker) for f in chain])
-                for chain in self.pipelines]
+        drivers = []
+        for chain in self.pipelines:
+            k = getattr(chain[0], "parallel_drivers", 1)
+            for _ in range(k):
+                drivers.append(
+                    Driver([f.create_operator(worker) for f in chain]))
+        return drivers
 
 
 class LocalExecutionPlanner:
@@ -228,7 +233,7 @@ class LocalExecutionPlanner:
         else:
             sink = PageConsumerFactory(next(self._ids),
                                        [s.type for s in chain.symbols])
-        self.pipelines.append(chain.factories + [sink])
+        self._add_pipeline(chain.factories + [sink])
         mem = getattr(self, "_memory_ctx", None)
         if mem is not None:
             check = getattr(self, "_revoke_check", None)
@@ -239,6 +244,68 @@ class LocalExecutionPlanner:
         return LocalExecutionPlan(self.pipelines, sink, root.column_names,
                                   [s.type for s in chain.symbols],
                                   list(chain.dicts), self.remote_slots)
+
+    # --------------------------------------------------- driver parallelism
+
+    def _add_pipeline(self, factories: List) -> None:
+        """Append a pipeline, splitting its stateless scan prefix into N
+        parallel drivers behind a local exchange when profitable
+        (reference parallelism axis #4: N Drivers per pipeline, fed by split
+        assignment; AddLocalExchanges + LocalExchange.java:52).
+
+        Split rule: the chain starts with a multi-split table scan, the
+        prefix of {scan, filter/project, lookup-join probe} is followed by at
+        least one stateful operator, and task_concurrency allows > 1 driver.
+        Producers run the prefix per split-group; the stateful tail runs as
+        ONE consumer driver downstream of the exchange."""
+        from ..ops.filter_project import FilterProjectOperatorFactory
+        from ..ops.hash_join import LookupJoinOperatorFactory
+        from ..ops.local_exchange import (LocalExchangeFactory,
+                                          LocalExchangeSinkFactory,
+                                          LocalExchangeSourceFactory)
+        from ..ops.scan import TableScanOperatorFactory
+
+        # driver_parallelism AUTO engages only off-CPU: XLA-CPU kernels already
+        # use every host core, so extra driver threads just contend; on TPU the
+        # extra drivers overlap host generation/upload with device compute
+        setting = self.session.get("driver_parallelism")
+        if setting in (None, "AUTO", "auto"):
+            import jax
+
+            conc = int(self.session.get("task_concurrency")) \
+                if jax.default_backend() != "cpu" else 1
+        else:
+            conc = int(setting)
+        head = factories[0]
+        n_sources = getattr(getattr(head, "_sources_fn", None),
+                            "sources_per_worker", 1)
+        n = min(conc, n_sources)
+        if n <= 1 or not isinstance(head, TableScanOperatorFactory) or \
+                getattr(head, "_prefetch", True) is False:
+            self.pipelines.append(factories)
+            return
+        def prefix_safe(f) -> bool:
+            if isinstance(f, FilterProjectOperatorFactory):
+                return True
+            if isinstance(f, LookupJoinOperatorFactory):
+                # FULL joins emit unmatched BUILD rows at probe finish — that
+                # pass must run exactly once, so such probes stay single-driver
+                return f.join_type != FULL
+            return False
+
+        cut = 1
+        while cut < len(factories) and prefix_safe(factories[cut]):
+            cut += 1
+        if cut >= len(factories) - 1:
+            self.pipelines.append(factories)   # nothing stateful before sink
+            return
+        head.set_parallelism(n)
+        head.parallel_drivers = n
+        lx = LocalExchangeFactory(n_producers=n)
+        sink = LocalExchangeSinkFactory(next(self._ids), lx, [])
+        source = LocalExchangeSourceFactory(next(self._ids), lx, [])
+        self.pipelines.append(factories[:cut] + [sink])
+        self.pipelines.append([source] + factories[cut:])
 
     # ------------------------------------------------------------ dispatch
 
@@ -320,6 +387,8 @@ class LocalExecutionPlanner:
                 provider.create_page_source(s, cols, self.page_capacity,
                                             constraint)
                 for s in mine)]
+        for_worker.sources_per_worker = max(
+            1, -(-len(splits) // max(count, 1)))
         return for_worker
 
     def visit_TableScanNode(self, node: TableScanNode) -> Chain:
@@ -407,7 +476,7 @@ class LocalExecutionPlanner:
             next(self._ids), build_key_ch, payload_ch, payload_meta,
             strategy="sorted", unique=unique,
             track_unmatched=node.type == "full")
-        self.pipelines.append(build_chain.factories + [build_fac])
+        self._add_pipeline(build_chain.factories + [build_fac])
 
         probe_out_ch = [probe_chain.channel(s.name) for s in probe_out]
         probe_meta = probe_chain.meta([s.name for s in probe_out])
@@ -446,7 +515,7 @@ class LocalExecutionPlanner:
             next(self._ids), [right.channel(ck_r.name)], payload_ch,
             payload_meta, strategy="sorted",
             unique=isinstance(node.right, EnforceSingleRowNode))
-        self.pipelines.append(right.factories + [build_fac])
+        self._add_pipeline(right.factories + [build_fac])
         probe_out_ch = [left.channel(s.name) for s in probe_out]
         probe_meta = left.meta([s.name for s in probe_out])
         probe_fac = LookupJoinOperatorFactory(
@@ -496,7 +565,7 @@ class LocalExecutionPlanner:
         build_fac = JoinBuildOperatorFactory(
             next(self._ids), [filt.channel(node.filtering_key.name)],
             payload_ch, payload_meta, strategy="sorted", unique=False)
-        self.pipelines.append(filt.factories + [build_fac])
+        self._add_pipeline(filt.factories + [build_fac])
         out_ch = list(range(len(src.symbols)))
         meta = src.meta([s.name for s in src.symbols])
         jt = ANTI if node.negated else SEMI
@@ -673,7 +742,7 @@ class LocalExecutionPlanner:
                             "UNION across distinct dictionaries requires a "
                             "re-encode pass (planned rev)")
             buf = PageConsumerFactory(next(self._ids), [m.type for m in mapping])
-            self.pipelines.append(chain.factories + [buf])
+            self.pipelines.append(chain.factories + [buf])  # union: keep 1 driver (replay ordering)
             buffers.append(buf)
 
         class _ReplaySource(ConnectorPageSource):

@@ -28,6 +28,10 @@ class Session:
     DEFAULTS = {
         "page_capacity": 1 << 16,
         "task_concurrency": 4,
+        # intra-pipeline driver parallelism: AUTO = task_concurrency on
+        # accelerators, 1 on the CPU backend (XLA-CPU already uses all cores);
+        # an integer forces that many drivers per eligible pipeline
+        "driver_parallelism": "AUTO",
         "join_distribution_type": "AUTOMATIC",   # BROADCAST | PARTITIONED | AUTOMATIC
         # AUTOMATIC broadcasts a build side whose estimated row count is below
         # this (join-distribution CBO; the reference bounds replicated size via

@@ -1,0 +1,171 @@
+"""Local exchange: intra-task page hand-off between pipelines.
+
+Analogue of operator/exchange/LocalExchange.java:52 (+ LocalExchangeSink/
+SourceOperator): N producer drivers push pages into a shared buffer; M
+consumer drivers pull. This is what lets ONE pipeline run as SEVERAL drivers
+(intra-pipeline driver parallelism, reference parallelism axis #4) with the
+stateful tail running downstream of the exchange.
+
+TPU framing: the payload is device-array pages — the exchange moves HANDLES,
+never data; its job is scheduling (overlapping several scans' host
+generation/upload against the consumer's device compute), not transport.
+
+The buffer is unbounded by design: callers that drive pipelines sequentially
+(tests, the mesh runner's per-fragment loops) must never deadlock on a full
+buffer; device memory stays bounded by the scan prefetch depth upstream and
+the consumer draining concurrently under the task executor in the live
+paths."""
+from __future__ import annotations
+
+import threading
+from typing import List, Optional
+
+from ..block import Page
+from ..types import Type
+from .operator import Operator, OperatorContext, OperatorFactory, timed
+
+
+class LocalExchangeBuffer:
+    """Shared page queue with producer completion tracking."""
+
+    def __init__(self, n_producers: int):
+        self._pages: List[Page] = []
+        self._lock = threading.Lock()
+        self._open_producers = n_producers
+        self.rows_in = 0
+
+    def put(self, page: Page) -> None:
+        with self._lock:
+            self._pages.append(page)
+
+    def producer_finished(self) -> None:
+        with self._lock:
+            self._open_producers -= 1
+
+    def poll(self) -> Optional[Page]:
+        with self._lock:
+            if self._pages:
+                return self._pages.pop(0)
+            return None
+
+    def is_done(self) -> bool:
+        with self._lock:
+            return not self._pages and self._open_producers <= 0
+
+    def has_output(self) -> bool:
+        with self._lock:
+            return bool(self._pages) or self._open_producers <= 0
+
+
+class LocalExchangeSink(Operator):
+    """Tail of a producer driver: pages go into the shared buffer."""
+
+    def __init__(self, context: OperatorContext, buffer: LocalExchangeBuffer,
+                 types: List[Type]):
+        super().__init__(context)
+        self.buffer = buffer
+        self._types = types
+        self._closed_buffer = False
+
+    @property
+    def output_types(self) -> List[Type]:
+        return self._types
+
+    @timed("add_input_ns")
+    def add_input(self, page: Page) -> None:
+        self.context.record_input(page, page.capacity)
+        self.buffer.put(page)
+
+    def get_output(self) -> Optional[Page]:
+        return None
+
+    def finish(self) -> None:
+        if not self._finishing and not self._closed_buffer:
+            self.buffer.producer_finished()
+            self._closed_buffer = True
+        super().finish()
+
+    def close(self) -> None:
+        self.finish()
+        super().close()
+
+    def is_finished(self) -> bool:
+        return self._finishing
+
+
+class LocalExchangeSource(Operator):
+    """Head of the consumer driver: pulls from the shared buffer; blocked
+    while producers are still running and no page is ready."""
+
+    def __init__(self, context: OperatorContext, buffer: LocalExchangeBuffer,
+                 types: List[Type]):
+        super().__init__(context)
+        self.buffer = buffer
+        self._types = types
+
+    @property
+    def output_types(self) -> List[Type]:
+        return self._types
+
+    def needs_input(self) -> bool:
+        return False
+
+    def add_input(self, page: Page) -> None:
+        raise RuntimeError("local exchange source takes no input")
+
+    def is_blocked(self):
+        if self.buffer.has_output():
+            return None
+        return self.buffer.has_output  # poll-able future
+
+    @timed("get_output_ns")
+    def get_output(self) -> Optional[Page]:
+        page = self.buffer.poll()
+        if page is not None:
+            self.context.record_output(page, page.capacity)
+        return page
+
+    def is_finished(self) -> bool:
+        return self._finishing or self.buffer.is_done()
+
+
+class LocalExchangeFactory:
+    """One per pipeline cut; builds per-worker buffers shared by the sink and
+    source factories (a worker's producers feed only that worker's consumer)."""
+
+    def __init__(self, n_producers: int):
+        self.n_producers = n_producers
+        self._buffers = {}
+        self._lock = threading.Lock()
+
+    def buffer(self, worker: int) -> LocalExchangeBuffer:
+        with self._lock:
+            b = self._buffers.get(worker)
+            if b is None:
+                b = LocalExchangeBuffer(self.n_producers)
+                self._buffers[worker] = b
+            return b
+
+
+class LocalExchangeSinkFactory(OperatorFactory):
+    def __init__(self, operator_id: int, exchange: LocalExchangeFactory,
+                 types: List[Type]):
+        super().__init__(operator_id, "LocalExchangeSink")
+        self.exchange = exchange
+        self.types = types
+
+    def create_operator(self, worker: int = 0) -> Operator:
+        return LocalExchangeSink(self.context(worker),
+                                 self.exchange.buffer(worker), self.types)
+
+
+class LocalExchangeSourceFactory(OperatorFactory):
+    def __init__(self, operator_id: int, exchange: LocalExchangeFactory,
+                 types: List[Type]):
+        super().__init__(operator_id, "LocalExchangeSource")
+        self.exchange = exchange
+        self.types = types
+
+    def create_operator(self, worker: int = 0) -> Operator:
+        return LocalExchangeSource(self.context(worker),
+                                   self.exchange.buffer(worker), self.types)

@@ -208,6 +208,25 @@ class TableScanOperatorFactory(OperatorFactory):
         else:
             self._process_fn = jax.jit(_widen_page)
 
+    def set_parallelism(self, n: int) -> None:
+        """Re-deal each worker's sources into `n` groups so `n` drivers can
+        each scan a share (intra-pipeline driver parallelism: the reference
+        feeds N Drivers from split assignment, SqlTaskExecution.java:1013)."""
+        inner = self._sources_fn
+
+        def dealt(w: int):
+            from ..exec.local_planner import _ConcatPageSource
+
+            srcs = []
+            for s in inner(w):
+                srcs.extend(s.sources if isinstance(s, _ConcatPageSource)
+                            else [s])
+            groups = [[srcs[i] for i in range(g, len(srcs), n)]
+                      for g in range(n)]
+            return [_ConcatPageSource(g) for g in groups]
+
+        self._sources_fn = dealt
+
     def create_operator(self, worker: int = 0) -> Operator:
         if worker not in self._remaining:
             self._remaining[worker] = list(self._sources_fn(worker))

@@ -100,14 +100,13 @@ class LocalQueryRunner:
     # write privilege)
     access_control = None
 
-    def _check_access(self, stmt) -> None:
+    def _check_access(self, stmt, user: "Optional[str]" = None) -> None:
         ac = self.access_control
         if ac is None:
             return
-        from .security import AccessDeniedException  # noqa: F401  (re-raise type)
         from .sql.analyzer import _ast_children
 
-        user = self.session.user
+        user = user if user is not None else self.session.user
 
         def resolve(name_parts):
             qname = self.metadata.resolve_table_name(
@@ -150,9 +149,9 @@ class LocalQueryRunner:
         else:
             walk(stmt)
 
-    def execute(self, sql: str) -> QueryResult:
+    def execute(self, sql: str, user: Optional[str] = None) -> QueryResult:
         stmt = self.parser.parse(sql)
-        self._check_access(stmt)
+        self._check_access(stmt, user)
         if isinstance(stmt, t.Explain):
             inner = stmt.statement
             if not isinstance(inner, t.Query):

@@ -158,7 +158,10 @@ class PrestoTpuServer:
             from ..transaction import TransactionManager
             tx_manager = TransactionManager(runner.catalogs)
         if access_control is not None:
-            runner.access_control = access_control
+            # table-level checks live on the LOCAL engine (the cluster
+            # coordinator delegates its checks to runner.local)
+            target = getattr(runner, "local", runner)
+            target.access_control = access_control
         self.manager = QueryManager(runner, page_rows=page_rows,
                                     resource_groups=resource_groups,
                                     monitor=monitor,

@@ -74,6 +74,13 @@ class QueryManager:
         self._queries: Dict[str, QueryInfo] = {}
         self._ids = itertools.count(1)
         self._lock = threading.Lock()
+        import inspect
+
+        try:
+            self._execute_takes_user = "user" in inspect.signature(
+                runner.execute).parameters
+        except (TypeError, ValueError):
+            self._execute_takes_user = False
 
     # ----------------------------------------------------------------- api
 
@@ -123,15 +130,20 @@ class QueryManager:
         ticket = None
         tx = None
         t0 = time.monotonic()
+        t_run = t0
         try:
+            with self._lock:
+                if info.state != QUEUED:  # canceled before the thread started
+                    return
             if self.access_control is not None:
                 self.access_control.check_can_execute_query(info.user)
             if self.resource_groups is not None:
                 # may QUEUE the query (blocks this thread) or reject
                 ticket = self.resource_groups.submit(
                     info.query_id, info.user, info.source)
+            t_run = time.monotonic()  # cpu charge excludes queue wait
             with self._lock:
-                if info.state != QUEUED:  # canceled before the thread started
+                if info.state != QUEUED:  # canceled while queued
                     return
                 info.state = RUNNING
             if self.transactions is not None:
@@ -142,7 +154,10 @@ class QueryManager:
                 # qualified cross-catalog writes included
                 for cat in self.transactions.catalog_names():
                     self.transactions.join(tx, cat)
-            result = self.runner.execute(info.sql)
+            if self._execute_takes_user:
+                result = self.runner.execute(info.sql, user=info.user)
+            else:
+                result = self.runner.execute(info.sql)
             rows = [self._to_json_row(r) for r in result.rows]
             if tx is not None:
                 self.transactions.commit(tx)
@@ -170,7 +185,7 @@ class QueryManager:
                 self.transactions.abort(tx)
             if ticket is not None:
                 self.resource_groups.finish(
-                    ticket, cpu_seconds=time.monotonic() - t0)
+                    ticket, cpu_seconds=time.monotonic() - t_run)
             if self.monitor is not None:
                 from ..spi.eventlistener import QueryCompletedEvent
 

@@ -83,7 +83,7 @@ class TransactionManager:
                 # (the already-committed ones cannot be undone — same partial
                 # outcome as the reference's multi-connector commit)
                 failed = e
-                for rest in tx.joined[i + 1:]:
+                for rest in tx.joined[i:]:
                     rb = getattr(self._get_connector(rest),
                                  "rollback_transaction", None)
                     if rb is not None:
