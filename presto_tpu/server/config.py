@@ -1,0 +1,132 @@
+"""etc/-style configuration: config.properties + catalog/*.properties.
+
+Analogue of the reference's airlift bootstrap config system
+(etc/config.properties -> @Config classes, metadata/CatalogManager loading
+etc/catalog/*.properties via PluginManager-registered connector factories,
+server/PluginManager.java:138). A catalog file names its connector with
+`connector.name=` and passes every other key to the factory:
+
+    etc/
+      config.properties          # http-server.http.port=8080, node.id=...
+      catalog/
+        tpch.properties          # connector.name=tpch
+        warehouse.properties     # connector.name=file
+                                 # file.base-dir=/data/warehouse
+
+Factories register in FACTORIES (the PluginManager registry analogue);
+embedding code can add its own with register_connector_factory().
+"""
+from __future__ import annotations
+
+import os
+from typing import Callable, Dict, Optional
+
+from ..metadata import CatalogManager, Session
+
+
+def parse_properties(path: str) -> Dict[str, str]:
+    """Java-style .properties subset: key=value lines, # comments."""
+    out: Dict[str, str] = {}
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line or line.startswith(("#", "!")):
+                continue
+            if "=" not in line:
+                raise ValueError(f"{path}: malformed line {line!r}")
+            k, _, v = line.partition("=")
+            out[k.strip()] = v.strip()
+    return out
+
+
+def _file_factory(catalog: str, config: Dict[str, str]):
+    from ..connectors.file import FileConnector
+
+    base = config.get("file.base-dir")
+    if not base:
+        raise ValueError(f"catalog {catalog}: file.base-dir is required")
+    return FileConnector(catalog, base)
+
+
+def _memory_factory(catalog: str, config: Dict[str, str]):
+    from ..connectors.memory import MemoryConnector
+
+    return MemoryConnector(catalog)
+
+
+def _blackhole_factory(catalog: str, config: Dict[str, str]):
+    from ..connectors.blackhole import BlackholeConnector
+
+    return BlackholeConnector(catalog)
+
+
+def _tpch_factory(catalog: str, config: Dict[str, str]):
+    from ..connectors.tpch.connector import TpchConnectorFactory
+
+    return TpchConnectorFactory().create(catalog, config)
+
+
+def _tpcds_factory(catalog: str, config: Dict[str, str]):
+    from ..connectors.tpcds.connector import TpcdsConnectorFactory
+
+    return TpcdsConnectorFactory().create(catalog, config)
+
+
+FACTORIES: Dict[str, Callable] = {
+    "tpch": _tpch_factory,
+    "tpcds": _tpcds_factory,
+    "memory": _memory_factory,
+    "blackhole": _blackhole_factory,
+    "file": _file_factory,
+}
+
+
+def register_connector_factory(name: str, factory: Callable) -> None:
+    """Plugin hook: factory(catalog_name, config) -> Connector."""
+    FACTORIES[name] = factory
+
+
+def load_catalogs(etc_dir: str) -> CatalogManager:
+    """Build a CatalogManager from etc/catalog/*.properties."""
+    catalogs = CatalogManager()
+    cat_dir = os.path.join(etc_dir, "catalog")
+    if not os.path.isdir(cat_dir):
+        return catalogs
+    for fname in sorted(os.listdir(cat_dir)):
+        if not fname.endswith(".properties"):
+            continue
+        catalog = fname[: -len(".properties")]
+        props = parse_properties(os.path.join(cat_dir, fname))
+        name = props.pop("connector.name", None)
+        if name is None:
+            raise ValueError(f"{fname}: missing connector.name")
+        factory = FACTORIES.get(name)
+        if factory is None:
+            raise ValueError(
+                f"{fname}: unknown connector {name!r} "
+                f"(registered: {sorted(FACTORIES)})")
+        catalogs.register(catalog, factory(catalog, props))
+    return catalogs
+
+
+def load_config(etc_dir: str) -> Dict[str, str]:
+    path = os.path.join(etc_dir, "config.properties")
+    return parse_properties(path) if os.path.isfile(path) else {}
+
+
+def session_from_config(config: Dict[str, str]) -> Session:
+    """config.properties session defaults -> Session (session.* keys become
+    session properties; the SystemSessionProperties defaults fill the rest)."""
+    props = {}
+    for k, v in config.items():
+        if not k.startswith("session.") or k in ("session.catalog",
+                                                 "session.schema"):
+            continue
+        key = k[len("session."):].replace("-", "_")
+        props[key] = int(v) if v.lstrip("-").isdigit() else v
+    return Session(user=config.get("node.user", "user"),
+                   catalog=config.get("session.catalog", None) or
+                   config.get("default-catalog", None),
+                   schema=config.get("session.schema", None) or
+                   config.get("default-schema", None),
+                   properties=props)

@@ -197,24 +197,40 @@ def main(argv=None) -> None:
                          "processes (start them with python -m "
                          "presto_tpu.cluster.worker --coordinator URI)")
     ap.add_argument("--min-workers", type=int, default=1)
+    ap.add_argument("--etc", default=None,
+                    help="config directory (config.properties + "
+                         "catalog/*.properties; the reference's etc/ layout)")
     args = ap.parse_args(argv)
 
     from ..metadata import Session
-    session = Session(catalog="tpch", schema=args.schema)
+    catalogs = None
+    port = args.port
+    if args.etc:
+        from .config import load_catalogs, load_config, session_from_config
+
+        conf = load_config(args.etc)
+        catalogs = load_catalogs(args.etc)
+        session = session_from_config(conf)
+        if session.catalog is None:
+            session = Session(catalog="tpch", schema=args.schema,
+                              properties=session.properties)
+        port = int(conf.get("http-server.http.port", args.port))
+    else:
+        session = Session(catalog="tpch", schema=args.schema)
     if args.cluster:
         from ..cluster import ClusterQueryRunner
-        runner = ClusterQueryRunner(session=session,
+        runner = ClusterQueryRunner(session=session, catalogs=catalogs,
                                     min_workers=args.min_workers)
         mode = "cluster-coordinator"
     elif args.distributed:
         from ..parallel.runner import DistributedQueryRunner
-        runner = DistributedQueryRunner(session=session)
+        runner = DistributedQueryRunner(session=session, catalogs=catalogs)
         mode = "distributed"
     else:
         from ..runner import LocalQueryRunner
-        runner = LocalQueryRunner(session=session)
+        runner = LocalQueryRunner(session=session, catalogs=catalogs)
         mode = "local"
-    server = PrestoTpuServer(runner, port=args.port)
+    server = PrestoTpuServer(runner, port=port)
     print(f"presto-tpu server listening on :{server.port} "
           f"({mode}, schema={args.schema})")
     server.serve()

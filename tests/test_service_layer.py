@@ -249,3 +249,64 @@ def test_memory_manager_tolerates_dead_worker():
                                limit_bytes=1 << 30, fetch_status=fetch)
     assert mgr.poll_once() is None
     assert mgr.last_total == 10
+
+
+# ------------------------------------------------------------- config system
+
+def test_etc_config_and_catalog_loading(tmp_path):
+    """etc/config.properties + catalog/*.properties (airlift bootstrap +
+    CatalogManager/PluginManager analogue)."""
+    from presto_tpu.server.config import (load_catalogs, load_config,
+                                          parse_properties,
+                                          session_from_config)
+
+    etc = tmp_path / "etc"
+    (etc / "catalog").mkdir(parents=True)
+    (etc / "config.properties").write_text(
+        "# the coordinator\n"
+        "http-server.http.port=9090\n"
+        "session.catalog=gen\n"
+        "session.schema=tiny\n"
+        "session.task-concurrency=2\n")
+    (etc / "catalog" / "gen.properties").write_text(
+        "connector.name=tpch\ntpch.splits-per-node=4\n")
+    (etc / "catalog" / "store.properties").write_text(
+        f"connector.name=file\nfile.base-dir={tmp_path}/warehouse\n")
+
+    conf = load_config(str(etc))
+    assert conf["http-server.http.port"] == "9090"
+    catalogs = load_catalogs(str(etc))
+    assert sorted(catalogs.names()) == ["gen", "store"]
+    session = session_from_config(conf)
+    assert session.catalog == "gen" and session.schema == "tiny"
+    assert session.properties["task_concurrency"] == 2
+
+    r = LocalQueryRunner(session=session, catalogs=catalogs)
+    assert r.execute("select count(*) from nation").rows == [[25]]
+
+    with pytest.raises(ValueError, match="unknown connector"):
+        (etc / "catalog" / "bad.properties").write_text("connector.name=nope\n")
+        load_catalogs(str(etc))
+
+
+def test_register_connector_factory(tmp_path):
+    from presto_tpu.server import config as C
+
+    calls = []
+
+    def factory(name, props):
+        calls.append((name, dict(props)))
+        from presto_tpu.connectors.blackhole import BlackholeConnector
+        return BlackholeConnector(name)
+
+    C.register_connector_factory("custom", factory)
+    try:
+        etc = tmp_path / "etc"
+        (etc / "catalog").mkdir(parents=True)
+        (etc / "catalog" / "c1.properties").write_text(
+            "connector.name=custom\nmy.flag=on\n")
+        cats = C.load_catalogs(str(etc))
+        assert cats.names() == ["c1"]
+        assert calls == [("c1", {"my.flag": "on"})]
+    finally:
+        C.FACTORIES.pop("custom", None)
