@@ -257,10 +257,18 @@ def main(argv=None) -> None:
     ap.add_argument("--node-id", default=None)
     ap.add_argument("--coordinator", default=None,
                     help="coordinator URI to announce to")
+    ap.add_argument("--etc", default=None,
+                    help="config directory with catalog/*.properties — every "
+                         "node must load the same catalog set")
     args = ap.parse_args(argv)
+    catalogs = None
+    if args.etc:
+        from ..server.config import load_catalogs
+
+        catalogs = load_catalogs(args.etc)
     server = WorkerServer(port=args.port, coordinator_uri=args.coordinator,
                           host=args.host, announce_host=args.announce_host,
-                          node_id=args.node_id)
+                          node_id=args.node_id, catalogs=catalogs)
     if server._announcer:
         server._announcer.start()
     print(f"presto-tpu worker {server.node_id} listening on :{server.port}")

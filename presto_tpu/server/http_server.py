@@ -212,7 +212,8 @@ def main(argv=None) -> None:
         catalogs = load_catalogs(args.etc)
         session = session_from_config(conf)
         if session.catalog is None:
-            session = Session(catalog="tpch", schema=args.schema,
+            session = Session(catalog="tpch",
+                              schema=session.schema or args.schema,
                               properties=session.properties)
         port = int(conf.get("http-server.http.port", args.port))
     else:
