@@ -151,37 +151,40 @@ def bench_q1_kernel(sf: float, seconds_budget: float = 60.0, quick: bool = False
     return resident_rps, batch_rows, step_ms, stream
 
 
-def bench_hand_query(builder_name: str, schema: str, seconds_budget: float,
-                     escalate_to: str = None, escalate_budget_s: float = 30.0,
-                     escalate_ratio: float = 100.0):
-    """One rung of the hand-pipeline ladder (presto-benchmark
-    AbstractOperatorBenchmark pattern): run the operator pipeline end to end,
-    count source rows processed per second of wall time.
+def bench_sql_query(query_id: int, schema: str, seconds_budget: float,
+                    escalate_to: str = None, escalate_budget_s: float = 30.0,
+                    escalate_ratio: float = 100.0):
+    """One rung of the SQL ladder: the FULL engine path (parse -> plan ->
+    optimize -> drivers), the presto-benchmark BenchmarkSuite pattern run
+    through LocalQueryRunner rather than hand-built pipelines — rung numbers
+    measure what users get.
 
     The rung first runs at `schema`; if the measured warm wall extrapolated to
     `escalate_to` (x escalate_ratio rows) fits `escalate_budget_s`, it re-runs
     there and reports that instead — a slow build never blows the round's time
     budget but a fast one still gets measured at full scale.
     """
+    from presto_tpu.metadata import Session
     from presto_tpu.models import hand_queries as hq
+    from presto_tpu.models.tpch_sql import QUERIES
+    from presto_tpu.runner import LocalQueryRunner
 
-    def once(sch):
-        if builder_name == "q3":
-            return len(hq.run_q3(sch))
-        return len(hq.run_query(getattr(hq, f"build_{builder_name}"), sch))
+    sql = QUERIES[query_id]
 
     def measure(sch):
+        runner = LocalQueryRunner(
+            session=Session(catalog="tpch", schema=sch))
         t0 = time.time()
-        rows0 = once(sch)  # warm-up run compiles every kernel in the pipeline
+        rows0 = len(runner.execute(sql).rows)  # warm-up compiles every kernel
         compile_wall = time.time() - t0
         runs, t0 = 0, time.time()
         while True:
-            once(sch)
+            runner.execute(sql)
             runs += 1
             if time.time() - t0 > seconds_budget or runs >= 3:
                 break
         wall = (time.time() - t0) / runs
-        src_rows = hq.source_rows(builder_name, sch)
+        src_rows = hq.source_rows(f"q{query_id}", sch)
         return {"schema": sch,
                 "rows_per_sec": round(src_rows / wall),
                 "source_rows": src_rows,
@@ -246,16 +249,15 @@ def main():
     detail = DETAIL
     detail["platform"] = platform
 
-    # ladder rungs: start small (tiny = sf0.01), escalate to sf1 only when the
-    # extrapolated sf1 wall fits the budget; failures recorded, not fatal
+    # ladder rungs: the full SQL engine at tiny (sf0.01), escalating to sf1
+    # only when the extrapolated wall fits the budget; failures recorded
     rung_budget = 5.0 if args.quick else 15.0
-    for rung, kw in (("q6", {"builder_name": "q6"}),
-                     ("q3", {"builder_name": "q3"})):
+    for rung, qid in (("q6", 6), ("q3", 3)):
         try:
-            detail[rung] = bench_hand_query(
-                schema="tiny", seconds_budget=rung_budget,
+            detail[rung] = bench_sql_query(
+                qid, schema="tiny", seconds_budget=rung_budget,
                 escalate_to=None if args.quick else "sf1",
-                escalate_budget_s=30.0, **kw)
+                escalate_budget_s=60.0)
         except Exception as e:
             detail[rung] = {"error": repr(e)[:300]}
 
