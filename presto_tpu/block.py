@@ -19,6 +19,7 @@ spi/block/Block.java:23 and its 64 concrete block classes), re-designed for TPU:
 from __future__ import annotations
 
 import dataclasses
+import itertools
 from typing import Any, Iterable, List, Optional, Sequence, Tuple, Union
 
 import jax
@@ -41,7 +42,9 @@ class Dictionary:
     the same role in the reference: spi/block/DictionaryBlock.java).
     """
 
-    __slots__ = ("values", "_index", "_ranks", "_order", "_sorted")
+    __slots__ = ("values", "_index", "_ranks", "_order", "_sorted", "_token")
+
+    _next_token = itertools.count()
 
     def __init__(self, values: Sequence[str]):
         self.values = np.asarray(values, dtype=object)
@@ -49,6 +52,17 @@ class Dictionary:
         self._ranks = None
         self._order = None
         self._sorted = None
+        # monotonic identity for the kernel cache: unlike id(), never reused
+        # after GC (utils/kernel_cache.dict_key)
+        self._token = next(Dictionary._next_token)
+
+    def token(self) -> int:
+        # lazy: virtual-dictionary subclasses skip super().__init__
+        t = getattr(self, "_token", None)
+        if t is None:
+            t = next(Dictionary._next_token)
+            self._token = t
+        return t
 
     def __len__(self):
         return len(self.values)

@@ -93,6 +93,8 @@ def encode(obj: Any) -> Any:
     """Python object -> JSON-compatible structure."""
     if obj is None or isinstance(obj, (bool, int, float, str)):
         return obj
+    if isinstance(obj, np.bool_):
+        return bool(obj)
     if isinstance(obj, (np.integer,)):
         return int(obj)
     if isinstance(obj, (np.floating,)):

@@ -39,6 +39,11 @@ from ...spi.connector import (ColumnHandle, ColumnMetadata, ColumnStatistics,
                               TableMetadata, TableStatistics)
 
 
+# plan-time bound on a varchar column's materialized distinct-value set
+# (the PLAIN-encoded parquet fallback decodes whole columns to build it)
+MAX_VARCHAR_DICTIONARY = 1 << 21
+
+
 class _TableInfo:
     def __init__(self, metadata: TableMetadata, files: List[str],
                  rows: int, signature):
@@ -154,7 +159,10 @@ class FileMetadata(ConnectorMetadata):
                 if distinct is not None:
                     vals_set.update(distinct)
                     continue
-                # PLAIN-encoded fallback: decode the column once
+                # PLAIN-encoded fallback: decode the column once, with a hard
+                # cardinality bound — an unbounded high-cardinality column
+                # would materialize every distinct string in memory at PLAN
+                # time; fail with a clear message instead of an OOM
                 for gi in range(pf.n_row_groups):
                     if pf.row_group_rows(gi) == 0:
                         continue
@@ -162,6 +170,12 @@ class FileMetadata(ConnectorMetadata):
                     if nulls is not None:
                         vals = vals[~nulls]
                     vals_set.update(np.unique(vals.astype(str)).tolist())
+                    if len(vals_set) > MAX_VARCHAR_DICTIONARY:
+                        raise ValueError(
+                            f"varchar column {n!r} of {name} exceeds "
+                            f"{MAX_VARCHAR_DICTIONARY} distinct values; "
+                            "re-encode the parquet files with dictionary "
+                            "encoding (or drop the column from the table)")
             pf.close()
         cols = tuple(
             ColumnMetadata(

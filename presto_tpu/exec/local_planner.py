@@ -110,6 +110,18 @@ class _ConcatPageSource(ConnectorPageSource):
         for s in self.sources:
             yield from s
 
+    @property
+    def cache_token(self):
+        """Deterministic iff every child is; token = tuple of child tokens."""
+        toks = tuple(getattr(s, "cache_token", None) for s in self.sources)
+        if any(t is None for t in toks):
+            return None
+        return ("concat",) + toks
+
+    def close(self) -> None:
+        for s in self.sources:
+            s.close()
+
 
 @dataclasses.dataclass
 class Chain:
@@ -301,7 +313,9 @@ class LocalExecutionPlanner:
             return
         head.set_parallelism(n)
         head.parallel_drivers = n
-        lx = LocalExchangeFactory(n_producers=n)
+        # bounded: these pipelines always run under the task executor, so a
+        # full buffer parks producers (BLOCKED) instead of growing HBM
+        lx = LocalExchangeFactory(n_producers=n, max_pages=2 * n + 2)
         sink = LocalExchangeSinkFactory(next(self._ids), lx, [])
         source = LocalExchangeSourceFactory(next(self._ids), lx, [])
         self.pipelines.append(factories[:cut] + [sink])
@@ -536,6 +550,7 @@ class LocalExecutionPlanner:
         # cols...] and evaluate per candidate (source,filtering) pair — the
         # JoinFilterFunctionCompiler analogue wired into _emit_semi_expanded
         filter_fn = None
+        filter_key = None
         filter_probe_ch: List[int] = []
         filter_build_ch: List[int] = []
         payload_ch: List[int] = []
@@ -561,6 +576,9 @@ class LocalExecutionPlanner:
                 [d for _, d in payload_meta])
             resolved = resolve_symbols(node.residual, mapping)
             filter_fn = ExpressionCompiler(layout).compile(resolved)
+            from ..utils import kernel_cache as kc
+            filter_key = (kc.expr_key(resolved),
+                          kc.layout_key(layout.types, layout.dictionaries))
 
         build_fac = JoinBuildOperatorFactory(
             next(self._ids), [filt.channel(node.filtering_key.name)],
@@ -578,7 +596,7 @@ class LocalExecutionPlanner:
             [src.channel(node.source_key.name)], out_ch, meta, [], [], jt,
             semi_output_channel=semi_mark, null_aware=node.null_aware,
             filter_fn=filter_fn, filter_probe_channels=filter_probe_ch,
-            filter_build_channels=filter_build_ch)
+            filter_build_channels=filter_build_ch, filter_key=filter_key)
         return Chain(src.factories + [fac], list(src.symbols), list(src.dicts))
 
     @staticmethod

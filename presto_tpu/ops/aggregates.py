@@ -62,6 +62,11 @@ class AggregateFunction:
     # splittable: state columns can ride pages between PARTIAL and FINAL steps
     # (vector states cannot — the exchange planner keeps those single-phase)
     splittable: bool = True
+    # canonical resolve-time identity (name, arg type names, distinct, params)
+    # for the global kernel cache (utils/kernel_cache.agg_call_key) — set by
+    # resolve_aggregate; two functions with equal fingerprints compile to
+    # behaviorally identical contributions
+    fingerprint: tuple = ()
 
 
 def _ones_i64(args, mask):
@@ -76,6 +81,17 @@ def resolve_aggregate(name: str, arg_types: Sequence[Type],
 
     `params` carries literal (non-column) arguments extracted by the planner —
     e.g. approx_percentile's fraction."""
+    fn = _resolve_aggregate(name, arg_types, distinct, params)
+    # the resolve arguments fully determine the function's behavior, so they
+    # ARE its kernel-cache identity
+    fn.fingerprint = (name.lower(), tuple(t.name for t in arg_types),
+                      bool(distinct), tuple(params))
+    return fn
+
+
+def _resolve_aggregate(name: str, arg_types: Sequence[Type],
+                       distinct: bool = False,
+                       params: Sequence[object] = ()) -> AggregateFunction:
     name = name.lower()
     if name == "count":
         if not arg_types:  # count(*)

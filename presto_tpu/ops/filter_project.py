@@ -30,13 +30,25 @@ class PageProcessor:
 
     def __init__(self, layout: InputLayout, filter_expr: Optional[RowExpression],
                  projections: Sequence[RowExpression], compact_output: bool = False):
+        from ..utils import kernel_cache as kc
+
         compiler = ExpressionCompiler(layout)
         self.filter = compiler.compile(filter_expr) if filter_expr is not None else None
         self.projections = [compiler.compile(p) for p in projections]
         self.output_types_ = [p.type for p in self.projections]
         self.output_dicts = [p.dictionary for p in self.projections]
         self.compact_output = compact_output
-        self._jitted = jax.jit(self._process)
+        # global kernel cache (PageFunctionCompiler.java:97's expression cache):
+        # equal (layout, exprs) compile to behaviorally identical closures, so
+        # repeated queries share one jitted kernel instead of re-tracing +
+        # re-compiling per plan (~0.5s/query host overhead otherwise)
+        self.cache_key = ("page-processor",
+                          kc.layout_key(layout.types, layout.dictionaries),
+                          kc.expr_key(filter_expr),
+                          tuple(kc.expr_key(p) for p in projections),
+                          compact_output)
+        self._jitted = kc.get_or_install(self.cache_key,
+                                         lambda: jax.jit(self._process))
 
     def _process(self, page: Page) -> Page:
         datas = tuple(b.data for b in page.blocks)

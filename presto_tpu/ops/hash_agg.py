@@ -32,9 +32,31 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..block import Block, Dictionary, Page
-from ..types import BIGINT, Type, is_string
+from ..types import BIGINT, BOOLEAN, Type, is_string
+from ..utils import kernel_cache
 from .aggregates import MAX, MIN, SUM, AggregateCall
 from .operator import Operator, OperatorContext, OperatorFactory, timed
+
+
+def _builder_key(tag: str, b, page: "Page" = None) -> tuple:
+    """Kernel-cache identity of a builder's static config: everything its
+    jitted kernel reads from `self` (channels, call fingerprints, domains)
+    PLUS the input page's dictionary versions — _call_contributions embeds
+    `d.sort_keys()` as a trace constant for min/max over unsorted
+    dictionaries, and Dictionary.extend mutates IN PLACE (same identity), so
+    the (token, len) version must be part of the key or an INSERT-extended
+    dictionary would replay a stale kernel."""
+    dicts = ()
+    if page is not None:
+        dicts = tuple(kernel_cache.dict_key(blk.dictionary)
+                      for blk in page.blocks)
+    return ("agg", tag,
+            tuple(t.name for t in getattr(b, "key_types", ())),
+            getattr(b, "_key_channels", None),
+            tuple(getattr(b, "domains", ())),
+            b.from_intermediate,
+            dicts,
+            tuple(kernel_cache.agg_call_key(c) for c in b.calls))
 
 
 def _segment_reduce(kind: str, values, seg_ids, num_segments: int):
@@ -85,6 +107,26 @@ def _where_valid(gvalid, s, ident):
 
 def _fill(shape, dtype, value):
     return jnp.full(shape, value, dtype=dtype)
+
+
+def _null_safe_keys(page: Page, key_channels) -> Tuple:
+    """Interleaved (value, is_null) arrays per key channel.
+
+    SQL groups NULL as its OWN key (reference: MultiChannelGroupByHash over
+    nullable blocks), so the null flag joins the sort key and the value lane is
+    zeroed under NULL — two NULL rows always collide, and never with value 0."""
+    out = []
+    for c in key_channels:
+        b = page.blocks[c]
+        if b.nulls is not None:
+            flag = b.nulls
+            data = jnp.where(flag, jnp.zeros((), dtype=b.data.dtype), b.data)
+        else:
+            flag = jnp.zeros(page.mask.shape, dtype=jnp.bool_)
+            data = b.data
+        out.append(data)
+        out.append(flag)
+    return tuple(out)
 
 
 def _call_contributions(calls, page: Page, from_intermediate: bool):
@@ -184,7 +226,11 @@ class GroupedAggregationBuilder:
     def __init__(self, key_types: Sequence[Type], key_dicts: Sequence[Optional[Dictionary]],
                  calls: Sequence[AggregateCall], page_capacity: int,
                  max_groups: int = 1 << 20, from_intermediate: bool = False):
-        self.key_types = list(key_types)
+        self.user_key_types = list(key_types)
+        # internal key signature interleaves a BOOLEAN null-flag column per key
+        # (_null_safe_keys): every internal loop over key arrays (fold, spill
+        # merge, finish) then handles NULL groups with no special cases
+        self.key_types = [x for t in key_types for x in (t, BOOLEAN)]
         self.key_dicts = list(key_dicts)
         self.calls = list(calls)
         self.max_groups = max_groups
@@ -204,7 +250,9 @@ class GroupedAggregationBuilder:
         self._acc = None            # (keys, states, valid) compact table, <= max_groups
         self._pending: List = []    # list of (keys, states, mask) partials
         self._pending_rows = 0
-        self._page_kernel = jax.jit(self._page_partial, static_argnames=("out_groups",))
+        # installed lazily (set_channels runs after __init__) via the global
+        # kernel cache so equal-config builders across queries share one compile
+        self._page_kernel = None
         # spilled partial tables on HOST RAM (numpy) — the TPU analogue of the
         # reference's disk spill (SpillableHashAggregationBuilder): device HBM
         # holds at most max_groups live groups; overflow and revocation move
@@ -219,9 +267,8 @@ class GroupedAggregationBuilder:
     # --- per page ---------------------------------------------------------
 
     def _page_partial(self, page: Page, out_groups: int):
-        datas = tuple(b.data for b in page.blocks)
         mask = page.mask
-        keys = tuple(datas[c] for c in self._key_channels)
+        keys = _null_safe_keys(page, self._key_channels)
         contribs = _call_contributions(self.calls, page, self.from_intermediate)
         return sort_group_reduce(keys, mask, tuple(contribs), self.kinds,
                                  self.identities, out_groups, self.widths)
@@ -237,6 +284,10 @@ class GroupedAggregationBuilder:
         self._page_kernel = donor._page_kernel
 
     def add_page(self, page: Page) -> None:
+        if self._page_kernel is None:
+            self._page_kernel = kernel_cache.get_or_install(
+                _builder_key("sort", self, page), lambda: jax.jit(
+                    self._page_partial, static_argnames=("out_groups",)))
         cap = page.capacity
         out_groups = cap if self._wide_cap is None else min(cap, self._wide_cap)
         gkeys, gstates, gvalid, ng = self._page_kernel(page, out_groups)
@@ -374,8 +425,17 @@ class GroupedAggregationBuilder:
             if self._pending:
                 self._fold()
         if self._spilled:
-            return self._merge_spilled()
-        return self._acc
+            out = self._merge_spilled()
+        else:
+            out = self._acc
+        # drop device references: the first builder per cache key stays alive
+        # in the kernel cache (its jitted bound method), so lingering state
+        # would pin the final group tables in HBM past the query's end
+        self._acc = None
+        self._pending = []
+        self._spilled = []
+        self._table_size = None
+        return out
 
 
 @functools.partial(jax.jit, static_argnames=("kinds", "identities",
@@ -401,16 +461,19 @@ class DirectAggregationBuilder:
                  from_intermediate: bool = False):
         self.key_types = list(key_types)
         self.key_dicts = list(key_dicts)
-        self.domains = list(domains)
+        # one extra slot per key for its NULL group (code == base domain):
+        # SQL groups NULL as its own key even in the dense-domain strategy
+        self.base_domains = [int(d) for d in domains]
+        self.domains = [int(d) + 1 for d in domains]
         self.calls = list(calls)
         self.from_intermediate = from_intermediate
-        self.D = int(np.prod(domains))
+        self.D = int(np.prod(self.domains))
         self.kinds = tuple(col.reduce for c in calls for col in c.function.state)
         self.identities = tuple(col.identity for c in calls for col in c.function.state)
         self.widths = _state_widths(calls)
         self._table = None  # tuple of (D,) / (D, width) state arrays
         self._seen = None   # (D,) bool: group occurred
-        self._kernel = jax.jit(self._accumulate)
+        self._kernel = None  # lazy: set_channels runs after __init__
 
     def set_channels(self, key_channels):
         self._key_channels = tuple(key_channels)
@@ -423,9 +486,13 @@ class DirectAggregationBuilder:
         datas = tuple(b.data for b in page.blocks)
         mask = page.mask
         gid = jnp.zeros(page.mask.shape[0], dtype=jnp.int32)
-        for ch, dom in zip(self._key_channels, self.domains):
-            gid = gid * dom + jnp.clip(datas[ch].astype(jnp.int32), 0, dom - 1)
-        gid = jnp.where(mask, gid, self.D)
+        for ch, base, dom in zip(self._key_channels, self.base_domains,
+                                 self.domains):
+            code = jnp.clip(datas[ch].astype(jnp.int32), 0, base - 1)
+            if page.blocks[ch].nulls is not None:
+                code = jnp.where(page.blocks[ch].nulls, base, code)
+            gid = gid * dom + code
+        gid = jnp.where(mask, gid, self.D)  # dead rows -> trash segment
         contribs = _call_contributions(self.calls, page, self.from_intermediate)
         new_table = []
         for c, kind, ident, w, t in zip(contribs, self.kinds, self.identities,
@@ -442,6 +509,10 @@ class DirectAggregationBuilder:
         return tuple(new_table), new_seen
 
     def add_page(self, page: Page) -> None:
+        if self._kernel is None:
+            self._kernel = kernel_cache.get_or_install(
+                _builder_key("direct", self, page),
+                lambda: jax.jit(self._accumulate))
         if self._table is None:
             self._table = tuple(
                 _fill((self.D, col.width) if col.width > 1 else (self.D,),
@@ -452,19 +523,27 @@ class DirectAggregationBuilder:
 
     def finish(self):
         if self._table is None:
-            z = tuple(jnp.zeros(0, dtype=t.np_dtype) for t in self.key_types)
+            z = tuple(x for t in self.key_types
+                      for x in (jnp.zeros(0, dtype=t.np_dtype),
+                                jnp.zeros(0, dtype=jnp.bool_)))
             s = tuple(jnp.zeros(0, dtype=np.float64) for _ in self.kinds)
             return z, s, jnp.zeros(0, dtype=jnp.bool_)
-        # decode linear gid back to key columns
+        # decode linear gid back to interleaved (value, null_flag) key columns
         D = self.D
         idx = jnp.arange(D, dtype=jnp.int32)
-        keys = []
+        pairs = []
         rem = idx
-        for dom, t in zip(reversed(self.domains), reversed(self.key_types)):
-            keys.append((rem % dom).astype(t.np_dtype))
+        for base, dom, t in zip(reversed(self.base_domains),
+                                reversed(self.domains),
+                                reversed(self.key_types)):
+            code = rem % dom
+            flag = code == base
+            pairs.append((jnp.where(flag, 0, code).astype(t.np_dtype), flag))
             rem = rem // dom
-        keys = tuple(reversed(keys))
-        return keys, self._table, self._seen
+        keys = tuple(x for v, f in reversed(pairs) for x in (v, f))
+        table, seen = self._table, self._seen
+        self._table = self._seen = None  # see GroupedAggregationBuilder.finish
+        return keys, table, seen
 
 
 class GlobalAggregationBuilder:
@@ -477,7 +556,7 @@ class GlobalAggregationBuilder:
         self.identities = tuple(col.identity for c in calls for col in c.function.state)
         self.widths = _state_widths(calls)
         self._state = None
-        self._kernel = jax.jit(self._accumulate)
+        self._kernel = None  # lazy: keyed on the first page's dict versions
 
     def set_channels(self, key_channels):
         return self
@@ -516,6 +595,10 @@ class GlobalAggregationBuilder:
             for c in self.calls for col in c.function.state)
 
     def add_page(self, page: Page) -> None:
+        if self._kernel is None:
+            self._kernel = kernel_cache.get_or_install(
+                _builder_key("global", self, page),
+                lambda: jax.jit(self._accumulate))
         if self._state is None:
             self._state = self._identity_state()
         self._state = self._kernel(page, self._state)
@@ -526,6 +609,7 @@ class GlobalAggregationBuilder:
         keys = ()
         states = tuple(jnp.reshape(s, (1, -1) if s.ndim else (1,))
                        for s in self._state)
+        self._state = None  # see GroupedAggregationBuilder.finish
         return keys, states, jnp.ones(1, dtype=jnp.bool_)
 
 
@@ -612,8 +696,11 @@ class HashAggregationOperator(Operator):
         cap = self.output_capacity
         # final transform per aggregate
         out_cols: List[Tuple] = []  # (type, data, dictionary, nulls)
-        for t, k, d in zip(self.key_types, keys, self.key_dicts):
-            out_cols.append((t, k, d, None))
+        # builders return interleaved (value, null_flag) arrays per key column
+        for i, (t, d) in enumerate(zip(self.key_types, self.key_dicts)):
+            kv, kf = keys[2 * i], keys[2 * i + 1]
+            nulls = kf if bool(np.asarray(kf).any()) else None
+            out_cols.append((t, kv, d, nulls))
         si = 0
         for call in self.calls:
             ncols = len(call.function.state)

@@ -528,9 +528,24 @@ class LookupJoinOperator(Operator):
         offsets = jnp.cumsum(emit)
         any_match = jnp.zeros(cap, dtype=jnp.bool_)
         if self.f._semi_kernel is None:
-            # jitted once per FACTORY (the closure reads only factory config),
-            # shared by every worker's probe operators
-            self.f._semi_kernel = jax.jit(self.f._semi_chunk)
+            # jitted once per filter CONFIG (a detached holder: the cached
+            # closure must pin only the compiled filter, never the factory and
+            # its lookup sources/build tables), shared by every worker's probe
+            # operators — and across queries when the planner supplied a
+            # filter fingerprint
+            f = self.f
+            cfg = _SemiFilterKernel(f.filter_fn, f.filter_probe_channels,
+                                    f.filter_build_channels)
+            if f.filter_key is not None:
+                from ..utils import kernel_cache as kc
+
+                self.f._semi_kernel = kc.get_or_install(
+                    ("join-semi", f.filter_key,
+                     tuple(f.filter_probe_channels),
+                     tuple(f.filter_build_channels)),
+                    lambda: jax.jit(cfg.chunk))
+            else:
+                self.f._semi_kernel = jax.jit(cfg.chunk)
         for c in range(max(0, -(-total // cap))):
             any_match = self.f._semi_kernel(
                 page, tuple(probe_keys), lo, offsets, src.sorted_row,
@@ -576,19 +591,12 @@ class LookupJoinOperator(Operator):
                 sel = page.select_channels(self.f.probe_output_channels)
                 self._push(Page(sel.blocks, page.mask & keep))
             return
-        out_mask = page.mask & (matched if jt == INNER else jnp.ones_like(matched))
-        safe_row = jnp.where(matched, row, 0)
-        blocks = [page.blocks[c] for c in self.f.probe_output_channels]
-        for bi, (t, d) in zip(self.f.build_output_channels,
-                              _payload_meta_selected(src, self.f)):
-            arr = src.payload[bi][safe_row]
-            bn = src.payload_nulls[bi] if bi < len(src.payload_nulls) else None
-            nulls = bn[safe_row] if bn is not None else None
-            if jt in (LEFT, FULL):
-                unmatched = ~matched  # unmatched probe rows -> null build columns
-                nulls = unmatched if nulls is None else (nulls | unmatched)
-            blocks.append(Block(t, arr, nulls, d))
-        self._push(Page(tuple(blocks), out_mask))
+        self._push(_emit_unique_kernel(
+            page, row, tuple(src.payload), tuple(src.payload_nulls),
+            tuple(self.f.probe_output_channels),
+            tuple(self.f.build_output_channels),
+            tuple(_payload_meta_selected(src, self.f)),
+            jt == INNER, jt in (LEFT, FULL)))
 
     def _emit_expanded(self, page: Page, probe_keys, probe_mask) -> None:
         src = self._source
@@ -722,6 +730,29 @@ def _payload_meta_selected(src: LookupSource, f) -> List[Tuple[Type, Optional[Di
     return [src.payload_meta[i] for i in f.build_output_channels]
 
 
+@functools.partial(jax.jit, static_argnames=("probe_channels", "build_channels",
+                                             "meta", "inner", "left_outer"))
+def _emit_unique_kernel(page: Page, row, payload, payload_nulls,
+                        probe_channels, build_channels, meta,
+                        inner: bool, left_outer: bool) -> Page:
+    """Unique-build join output as ONE fused kernel: probe-channel passthrough
+    plus a gather per build column (eagerly this was ~15 separate dispatches
+    per page — measurable host overhead on short queries)."""
+    matched = row >= 0
+    out_mask = page.mask & (matched if inner else jnp.ones_like(matched))
+    safe_row = jnp.where(matched, row, 0)
+    blocks = [page.blocks[c] for c in probe_channels]
+    for bi, (t, d) in zip(build_channels, meta):
+        arr = payload[bi][safe_row]
+        bn = payload_nulls[bi] if bi < len(payload_nulls) else None
+        nulls = bn[safe_row] if bn is not None else None
+        if left_outer:
+            unmatched = ~matched  # unmatched probe rows -> null build columns
+            nulls = unmatched if nulls is None else (nulls | unmatched)
+        blocks.append(Block(t, arr, nulls, d))
+    return Page(tuple(blocks), out_mask)
+
+
 @jax.jit
 def _mark_rows(visited, row, mask):
     """OR build rows matched by this probe page into the visited set."""
@@ -806,8 +837,12 @@ class LookupJoinOperatorFactory(OperatorFactory):
                  join_type: str = INNER, semi_output_channel: Optional[int] = None,
                  null_aware: bool = False, filter_fn=None,
                  filter_probe_channels: Optional[List[int]] = None,
-                 filter_build_channels: Optional[List[int]] = None):
+                 filter_build_channels: Optional[List[int]] = None,
+                 filter_key: Optional[tuple] = None):
         super().__init__(operator_id, f"LookupJoin({join_type})")
+        # global kernel-cache identity of the compiled join filter (expression
+        # + layout fingerprint from the local planner); None -> per-factory jit
+        self.filter_key = filter_key
         # join filter: compiled expression over [filter_probe_channels... page
         # channels, filter_build_channels... payload columns] evaluated per
         # candidate (probe,build) pair — JoinFilterFunctionCompiler analogue
@@ -836,11 +871,24 @@ class LookupJoinOperatorFactory(OperatorFactory):
     def create_operator(self, worker: int = 0) -> LookupJoinOperator:
         return LookupJoinOperator(self.context(worker), self)
 
-    def _semi_chunk(self, page, probe_keys, lo, offsets, sorted_row, key_arrays,
-                    payload, payload_nulls, out_base, total, any_match):
-        """One output chunk of the verified semi/anti probe (a FACTORY method so
-        the shared jit captures only factory config, never an operator instance
-        and its build-side arrays)."""
+
+class _SemiFilterKernel:
+    """Join-filter config holder for the cached semi/anti probe kernel.
+
+    Deliberately detached from the operator factory: the kernel cache keeps
+    the jitted bound method alive for the process lifetime, and a factory
+    would drag its LookupSourceFactory (the build-side hash tables in HBM)
+    along with it."""
+
+    def __init__(self, filter_fn, filter_probe_channels, filter_build_channels):
+        self.filter_fn = filter_fn
+        self.filter_probe_channels = list(filter_probe_channels)
+        self.filter_build_channels = list(filter_build_channels)
+
+    def chunk(self, page, probe_keys, lo, offsets, sorted_row, key_arrays,
+              payload, payload_nulls, out_base, total, any_match):
+        """One output chunk of the verified semi/anti probe: range-positions
+        -> candidate build rows -> exact key check -> filter -> OR per probe."""
         cap = page.mask.shape[0]
         j = jnp.arange(cap, dtype=jnp.int32) + out_base
         live = j < total

@@ -26,17 +26,30 @@ from .operator import Operator, OperatorContext, OperatorFactory, timed
 
 
 class LocalExchangeBuffer:
-    """Shared page queue with producer completion tracking."""
+    """Shared page queue with producer completion tracking.
 
-    def __init__(self, n_producers: int):
+    `max_pages` > 0 bounds the queue (the reference LocalExchange's
+    maxBufferedBytes analogue): producers observe `has_room` and park as
+    BLOCKED until the consumer drains. The bound is only enabled when the
+    pipelines run under the task executor — a sequentially-driven producer
+    with no concurrent consumer must never deadlock on a full buffer."""
+
+    def __init__(self, n_producers: int, max_pages: int = 0):
         self._pages: List[Page] = []
         self._lock = threading.Lock()
         self._open_producers = n_producers
+        self.max_pages = max_pages
         self.rows_in = 0
 
     def put(self, page: Page) -> None:
         with self._lock:
             self._pages.append(page)
+
+    def has_room(self) -> bool:
+        if self.max_pages <= 0:
+            return True
+        with self._lock:
+            return len(self._pages) < self.max_pages
 
     def producer_finished(self) -> None:
         with self._lock:
@@ -70,6 +83,14 @@ class LocalExchangeSink(Operator):
     @property
     def output_types(self) -> List[Type]:
         return self._types
+
+    def needs_input(self) -> bool:
+        return super().needs_input() and self.buffer.has_room()
+
+    def is_blocked(self):
+        if self.buffer.has_room():
+            return None
+        return self.buffer.has_room  # poll-able: consumer drain frees a slot
 
     @timed("add_input_ns")
     def add_input(self, page: Page) -> None:
@@ -133,8 +154,12 @@ class LocalExchangeFactory:
     """One per pipeline cut; builds per-worker buffers shared by the sink and
     source factories (a worker's producers feed only that worker's consumer)."""
 
-    def __init__(self, n_producers: int):
+    def __init__(self, n_producers: int, max_pages: int = 0):
         self.n_producers = n_producers
+        # soft bound on buffered pages (0 = unbounded): pass e.g.
+        # 2 * n_producers when the pipelines run under the task executor so N
+        # fast producers cannot grow HBM-resident pages without limit
+        self.max_pages = max_pages
         self._buffers = {}
         self._lock = threading.Lock()
 
@@ -142,7 +167,7 @@ class LocalExchangeFactory:
         with self._lock:
             b = self._buffers.get(worker)
             if b is None:
-                b = LocalExchangeBuffer(self.n_producers)
+                b = LocalExchangeBuffer(self.n_producers, self.max_pages)
                 self._buffers[worker] = b
             return b
 

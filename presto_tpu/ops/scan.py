@@ -33,6 +33,62 @@ from .operator import Operator, OperatorContext, OperatorFactory, timed
 _SENTINEL = object()
 
 
+class _ResidentPageCache:
+    """Bounded LRU of UPLOADED device pages per page-source cache token.
+
+    The warm-scan analogue of the reference's LocalQueryRunner benchmarks
+    (pages live in memory across queries): a source that declares itself
+    deterministic+immutable (ConnectorPageSource.cache_token) has its device
+    pages kept resident, so repeat scans skip host generation AND the
+    host→HBM upload entirely. Eviction drops whole streams LRU-first; freeing
+    the last reference releases the HBM."""
+
+    def __init__(self, max_bytes: int = 6 << 30):
+        self.max_bytes = max_bytes
+        self._pages = {}
+        self._order: list = []
+        self._bytes = 0
+        self._lock = threading.Lock()
+
+    @staticmethod
+    def _page_bytes(page: Page) -> int:
+        n = sum(b.data.nbytes + (b.nulls.nbytes if b.nulls is not None else 0)
+                for b in page.blocks)
+        return n + page.mask.nbytes
+
+    def get(self, token):
+        with self._lock:
+            hit = self._pages.get(token)
+            if hit is not None:
+                self._order.remove(token)
+                self._order.append(token)
+            return hit
+
+    def put(self, token, pages) -> None:
+        size = sum(self._page_bytes(p) for p in pages)
+        if size > self.max_bytes:
+            return
+        with self._lock:
+            if token in self._pages:
+                return
+            while self._bytes + size > self.max_bytes and self._order:
+                old = self._order.pop(0)
+                self._bytes -= sum(self._page_bytes(p)
+                                   for p in self._pages.pop(old))
+            self._pages[token] = list(pages)
+            self._order.append(token)
+            self._bytes += size
+
+    def clear(self) -> None:
+        with self._lock:
+            self._pages.clear()
+            self._order.clear()
+            self._bytes = 0
+
+
+RESIDENT_CACHE = _ResidentPageCache()
+
+
 def _widen_page(page: Page) -> Page:
     """Device-side upcast of narrow wire blocks to their declared dtypes."""
     blocks = []
@@ -41,6 +97,11 @@ def _widen_page(page: Page) -> Page:
         data = b.data if b.data.dtype == want else b.data.astype(want)
         blocks.append(Block(b.type, data, b.nulls, b.dictionary))
     return Page(tuple(blocks), page.mask.astype(jnp.bool_))
+
+
+# module-level singleton: page types/dictionaries are pytree aux data, so one
+# jit object handles every schema (retracing per treedef, never per query)
+_widen_jit = jax.jit(_widen_page)
 
 
 class _Prefetcher:
@@ -115,6 +176,18 @@ class TableScanOperator(Operator):
         self._prefetch_enabled = prefetch
         self._prefetcher: Optional[_Prefetcher] = None
         self._iter: Optional[Iterator[Page]] = None
+        # device-resident replay: a deterministic source's uploaded pages are
+        # cached across queries (see _ResidentPageCache)
+        self._cache_token = getattr(source, "cache_token", None)
+        self._replay: Optional[Iterator[Page]] = None
+        self._collected: Optional[List[Page]] = None
+        self._collected_bytes = 0
+        if self._cache_token is not None:
+            hit = RESIDENT_CACHE.get(self._cache_token)
+            if hit is not None:
+                self._replay = iter(hit)
+            else:
+                self._collected = []
 
     def is_blocked(self):
         """A replay scan (union buffer) blocks until its producers finish —
@@ -138,17 +211,37 @@ class TableScanOperator(Operator):
         raise RuntimeError("table scan takes no input")
 
     def _next_uploaded(self) -> Optional[Page]:
+        if self._replay is not None:
+            return next(self._replay, None)
         if self._prefetch_enabled:
             if self._prefetcher is None:
                 self._prefetcher = _Prefetcher(self.source, self.device)
-            return self._prefetcher.next()
-        if self._iter is None:
-            self._iter = iter(self.source)
-        try:
-            page = next(self._iter)
-        except StopIteration:
-            return None
-        return jax.tree.map(lambda a: jax.device_put(a, self.device), page)
+            page = self._prefetcher.next()
+        else:
+            if self._iter is None:
+                self._iter = iter(self.source)
+            try:
+                page = next(self._iter)
+            except StopIteration:
+                page = None
+            if page is not None:
+                page = jax.tree.map(
+                    lambda a: jax.device_put(a, self.device), page)
+        if self._collected is not None:
+            if page is None:
+                # stream exhausted without error: install for future scans
+                RESIDENT_CACHE.put(self._cache_token, self._collected)
+                self._collected = None
+            else:
+                # bound collection AS WE GO: a stream too big for the cache
+                # must not pin its pages live until exhaustion — abandoning
+                # restores pure streaming (prefetch depth bounds memory)
+                self._collected_bytes += _ResidentPageCache._page_bytes(page)
+                if self._collected_bytes > RESIDENT_CACHE.max_bytes // 2:
+                    self._collected = None
+                else:
+                    self._collected.append(page)
+        return page
 
     @timed("get_output_ns")
     def get_output(self) -> Optional[Page]:
@@ -201,12 +294,18 @@ class TableScanOperatorFactory(OperatorFactory):
         self._remaining = {}
         self._prefetch = prefetch
         # one shared jit for widen+filter+project: a single kernel per page,
-        # shared across all drivers/workers of this factory (one compile)
+        # shared across all drivers/workers of this factory — and, via the
+        # global kernel cache, across repeated queries with the same processor
+        # fingerprint (one compile per distinct scan kernel, ever)
         if processor is not None:
-            self._process_fn = jax.jit(
-                lambda p: processor._process(_widen_page(p)))
+            from ..utils import kernel_cache as kc
+
+            self._process_fn = kc.get_or_install(
+                ("scan-fused", processor.cache_key),
+                lambda: jax.jit(
+                    lambda p: processor._process(_widen_page(p))))
         else:
-            self._process_fn = jax.jit(_widen_page)
+            self._process_fn = _widen_jit
 
     def set_parallelism(self, n: int) -> None:
         """Re-deal each worker's sources into `n` groups so `n` drivers can

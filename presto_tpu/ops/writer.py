@@ -18,7 +18,7 @@ from .operator import Operator, OperatorContext, OperatorFactory, timed
 
 class TableWriterOperator(Operator):
     def __init__(self, context: OperatorContext, sink: ConnectorPageSink,
-                 remaps=None, column_dicts=None):
+                 remaps=None, column_dicts=None, casts=None):
         super().__init__(context)
         self.sink = sink
         # per-column dictionary-code remap arrays (None = pass through) and
@@ -26,6 +26,9 @@ class TableWriterOperator(Operator):
         # reference the table's (possibly extended) private dictionaries
         self.remaps = remaps
         self.column_dicts = column_dicts
+        # per-column target Type (None = pass through): INSERT of typeless
+        # NULL literals (UNKNOWN) retypes the block to the table's column
+        self.casts = casts
         self._rows = 0
         self._emitted = False
 
@@ -37,6 +40,15 @@ class TableWriterOperator(Operator):
     def add_input(self, page: Page) -> None:
         self.context.record_input(page, page.capacity)
         self._rows += int(np.asarray(page.mask).sum())
+        if self.casts is not None and any(c is not None for c in self.casts):
+            blocks = []
+            for b, t in zip(page.blocks, self.casts):
+                if t is None:
+                    blocks.append(b)
+                else:
+                    data = np.asarray(b.data).astype(t.np_dtype)
+                    blocks.append(Block(t, data, b.nulls, b.dictionary))
+            page = Page(tuple(blocks), page.mask)
         if self.remaps is not None or self.column_dicts is not None:
             blocks = []
             mask_np = np.asarray(page.mask)
@@ -76,16 +88,18 @@ class TableWriterOperatorFactory(OperatorFactory):
     metadata commit — TableFinishOperator's role)."""
 
     def __init__(self, operator_id: int, sink_provider, insert_handle,
-                 remaps=None, column_dicts=None):
+                 remaps=None, column_dicts=None, casts=None):
         super().__init__(operator_id, "TableWriter")
         self._provider = sink_provider
         self._handle = insert_handle
         self._remaps = remaps
         self._column_dicts = column_dicts
+        self._casts = casts
         self.sinks: List[ConnectorPageSink] = []
 
     def create_operator(self, worker: int = 0) -> TableWriterOperator:
         sink = self._provider.create_page_sink(self._handle)
         self.sinks.append(sink)
         return TableWriterOperator(self.context(worker), sink,
-                                   self._remaps, self._column_dicts)
+                                   self._remaps, self._column_dicts,
+                                   self._casts)

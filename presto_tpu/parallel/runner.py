@@ -198,22 +198,23 @@ def _exchange_program(mesh, kind: str, key_idx: Optional[Tuple[int, ...]],
         if kind == REPARTITION:
             keys = [jnp.where(arrays[ncols + i], 0, arrays[i]).astype(jnp.int64)
                     for i in key_idx]
-            out, m, _dropped = repartition(list(arrays), mask,
-                                           combined_key(keys), W, L)
-            return tuple(out), m
+            out, m, dropped = repartition(list(arrays), mask,
+                                          combined_key(keys), W, L)
+            return tuple(out), m, dropped.reshape(1)
         if kind == BROADCAST:
             out, m = broadcast_gather(list(arrays), mask)
-            return tuple(out), m
-        if kind == GATHER:
+        elif kind == GATHER:
             out, m = gather_to_single(list(arrays), mask)
-            return tuple(out), m
-        raise AssertionError(kind)
+        else:
+            raise AssertionError(kind)
+        return tuple(out), m, jnp.zeros(1, dtype=jnp.int32)
 
     n_arrays = 2 * ncols
     smapped = shard_map(
         stage, mesh=mesh,
         in_specs=(tuple(P(WORKER_AXIS) for _ in range(n_arrays)), P(WORKER_AXIS)),
-        out_specs=(tuple(P(WORKER_AXIS) for _ in range(n_arrays)), P(WORKER_AXIS)))
+        out_specs=(tuple(P(WORKER_AXIS) for _ in range(n_arrays)),
+                   P(WORKER_AXIS), P(WORKER_AXIS)))
     return jax.jit(smapped)
 
 
@@ -251,7 +252,16 @@ def run_exchange(mesh: MeshContext, kind: str, key_idx: Optional[List[int]],
     program = _exchange_program(
         mesh.mesh, kind, tuple(key_idx) if key_idx is not None else None,
         ncols, W, L)
-    out_arrays, out_mask = program(tuple(dev_arrays), dev_mask)
+    out_arrays, out_mask, dropped = program(tuple(dev_arrays), dev_mask)
+    n_dropped = int(np.asarray(dropped).sum())
+    if n_dropped:
+        # the send buffers are sized to the fullest worker's live rows, so a
+        # drop means a sizing bug upstream — corrupt results must fail loudly
+        # (the reference's OutputBuffer applies backpressure instead; see
+        # parallel/exchange.py repartition docstring)
+        raise RuntimeError(
+            f"repartition exchange dropped {n_dropped} rows "
+            f"(capacity {L} per peer, {W} workers)")
 
     # split back per worker, compact, and re-page at the standard page capacity
     # (standard-shaped pages let every downstream operator reuse the kernels it

@@ -257,8 +257,17 @@ class LocalQueryRunner:
                     f"INSERT has {len(exec_plan.output_types)} columns, "
                     f"table {qname} has {len(tcols)}")
             remaps: List[Optional[object]] = []
+            casts: List[Optional[object]] = []
+            from .types import UNKNOWN as _UNKNOWN
             for c, st, sd in zip(tcols, exec_plan.output_types,
                                  exec_plan.output_dicts):
+                if st is _UNKNOWN or st.name == "unknown":
+                    # typeless NULL literal column: retype to the table column
+                    # at write time (writer cast), nulls ride along
+                    casts.append(c.type)
+                    remaps.append(None)
+                    continue
+                casts.append(None)
                 if c.type.name != st.name:
                     raise ValueError(
                         f"INSERT type mismatch on {c.name}: {st.name} vs "
@@ -285,7 +294,8 @@ class LocalQueryRunner:
         if sink_provider is None:
             raise ValueError(f"catalog {qname.catalog} is not writable")
         insert_handle = meta.begin_insert(handle)
-        if isinstance(stmt, t.Insert) and any(r is not None for r in remaps):
+        is_insert = isinstance(stmt, t.Insert)
+        if is_insert and any(r is not None for r in remaps):
             # INSERT re-encodes into the table's dictionaries; CTAS pages keep
             # their source dictionaries (codes match the copies by construction,
             # and file sinks materialize virtual dictionaries from the blocks)
@@ -293,7 +303,10 @@ class LocalQueryRunner:
             column_dicts = [c.dictionary for c in target_meta.columns]
             writer_fac = TableWriterOperatorFactory(
                 9000, sink_provider, insert_handle,
-                remaps=remaps, column_dicts=column_dicts)
+                remaps=remaps, column_dicts=column_dicts, casts=casts)
+        elif is_insert and any(c is not None for c in casts):
+            writer_fac = TableWriterOperatorFactory(
+                9000, sink_provider, insert_handle, casts=casts)
         else:
             writer_fac = TableWriterOperatorFactory(9000, sink_provider,
                                                     insert_handle)

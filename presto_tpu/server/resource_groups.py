@@ -170,7 +170,16 @@ class ResourceGroupManager:
             return self.root
         g = self.root
         for part in path.split(".")[1:]:  # path starts with root's name
-            g = g.children.get(part) or g
+            child = g.children.get(part)
+            if child is None:
+                # a selector naming a nonexistent subgroup is a config bug:
+                # silently falling back to an ancestor would bypass the
+                # intended admission limits (the reference validates resource
+                # group config up front the same way)
+                raise ValueError(
+                    f"resource group selector names unknown group {path!r} "
+                    f"(missing subgroup {part!r})")
+            g = child
         return g
 
     def submit(self, query_id: str, user: str = "", source: str = "",

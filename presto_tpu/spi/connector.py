@@ -116,6 +116,17 @@ class ConnectorPageSource(abc.ABC):
     def completed_bytes(self) -> int:
         return 0
 
+    @property
+    def cache_token(self) -> Optional[tuple]:
+        """Hashable identity of a DETERMINISTIC, IMMUTABLE page stream, or None.
+
+        A non-None token lets the scan keep the uploaded device pages resident
+        and replay them for later scans with the same token (the reference's
+        LocalQueryRunner benchmark pattern: repeated queries read in-memory
+        pages, not the generator). Mutable sources (memory connector tables,
+        files that can change) must return None."""
+        return None
+
 
 class FixedPageSource(ConnectorPageSource):
     def __init__(self, pages: Sequence[Page]):
