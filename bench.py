@@ -235,6 +235,69 @@ def bench_sql_query(query_id: int, schema: str, seconds_budget: float,
     return out
 
 
+def bench_pcol_scan(sf: float, seconds_budget: float = 30.0,
+                    materialize_budget_s: float = 240.0) -> dict:
+    """Materialized-warehouse rung: Q6 over PCOL files via the file connector
+    (mmap -> host view -> device upload -> fused filter+agg), the production
+    shape where data is ingested once and scanned many times (the reference
+    benchmarks run on materialized ORC, presto-benchto-benchmarks/tpch.yaml).
+    The dataset materializes ONCE into .bench_data/ and is reused by every
+    later bench run — the generator is out of the measured loop entirely.
+    """
+    from presto_tpu.connectors.file import FileConnector
+    from presto_tpu.connectors.tpch.connector import TpchConnector
+    from presto_tpu.metadata import CatalogManager, Session
+    from presto_tpu.runner import LocalQueryRunner
+
+    base = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        ".bench_data", "warehouse")
+    sfs = str(sf).replace(".", "_")
+    table = f"lineitem_sf{sfs}"
+    catalogs = CatalogManager()
+    catalogs.register("tpch", TpchConnector("tpch"))
+    catalogs.register("warehouse", FileConnector("warehouse", base))
+    runner = LocalQueryRunner(
+        session=Session(catalog="warehouse", schema="bench"),
+        catalogs=catalogs)
+    out = {}
+    schema = "sf1" if sf <= 1 else f"sf{int(sf)}"
+    exists = runner.metadata.get_table_handle(
+        runner.session,
+        runner.metadata.resolve_table_name(
+            runner.session, ("warehouse", "bench", table))) is not None
+    if not exists:
+        t0 = time.time()
+        runner.execute(
+            f"create table warehouse.bench.{table} as "
+            f"select l_quantity, l_extendedprice, l_discount, l_shipdate "
+            f"from tpch.{schema}.lineitem")
+        out["materialize_s"] = round(time.time() - t0, 1)
+        if out["materialize_s"] > materialize_budget_s:
+            out["note"] = "materialization over budget; scan still measured"
+    import glob as _glob
+    files = _glob.glob(os.path.join(base, "bench", table, "*"))
+    out["file_bytes"] = sum(os.path.getsize(f) for f in files)
+    q6 = (f"select sum(l_extendedprice * l_discount) as revenue "
+          f"from warehouse.bench.{table} where l_shipdate >= date '1994-01-01'"
+          f" and l_shipdate < date '1995-01-01'"
+          f" and l_discount between 0.05 and 0.07 and l_quantity < 24")
+    t0 = time.time()
+    runner.execute(q6)  # compile + first mmap touch
+    out["first_run_s"] = round(time.time() - t0, 2)
+    runs, t0 = 0, time.time()
+    while True:
+        runner.execute(q6)
+        runs += 1
+        if time.time() - t0 > seconds_budget or runs >= 5:
+            break
+    wall = (time.time() - t0) / runs
+    from presto_tpu.connectors.tpch import generator as g
+    src_rows = g.table_row_count("lineitem", sf)
+    out.update({"rows": src_rows, "wall_s": round(wall, 3),
+                "rows_per_sec": round(src_rows / wall)})
+    return out
+
+
 def cpu_baseline_rows_per_sec(sample_rows: int = 2_000_000) -> float:
     """Single-node CPU reference: numpy evaluation of the same Q1 arithmetic
     (the presto-benchmark HandTpchQuery1 pattern on this host)."""
@@ -292,6 +355,13 @@ def main():
                 escalate_budget_s=60.0)
         except Exception as e:
             detail[rung] = {"error": repr(e)[:300]}
+
+    try:
+        detail["pcol_q6"] = bench_pcol_scan(
+            1.0 if args.quick else min(args.sf, 10.0),
+            seconds_budget=10.0 if args.quick else 30.0)
+    except Exception as e:
+        detail["pcol_q6"] = {"error": repr(e)[:300]}
 
     baseline = cpu_baseline_rows_per_sec()
     rps, batch_rows, step_ms, stream = bench_q1_kernel(

@@ -22,6 +22,12 @@ import jax as _jax
 # kernels deliberately stay in 32-bit — see ops/).
 _jax.config.update("jax_enable_x64", True)
 
+# CPU-backend compiles are serialized process-wide: concurrent LLVM codegen
+# from executor threads intermittently segfaults (see utils/compile_lock.py)
+from .utils import compile_lock as _compile_lock  # noqa: E402
+
+_compile_lock.install()
+
 from .types import (BIGINT, BOOLEAN, DATE, DOUBLE, INTEGER, REAL, SMALLINT,  # noqa: E402,F401
                     TIMESTAMP, VARCHAR, DecimalType, Type, parse_type)
 from .block import Block, Dictionary, Page, page_from_arrays, page_from_pylists  # noqa: E402,F401

@@ -31,17 +31,28 @@ class StatementClient:
     """One statement's lifecycle: submit -> page through results."""
 
     def __init__(self, server: str, sql: str, poll_interval_s: float = 0.05,
-                 timeout_s: float = 3600.0):
+                 timeout_s: float = 3600.0, user: Optional[str] = None,
+                 password: Optional[str] = None):
         self.server = server.rstrip("/")
         self.sql = sql
         self.poll_interval_s = poll_interval_s
         self.timeout_s = timeout_s
+        self.user = user
+        self.password = password
         self.columns: Optional[List[Column]] = None
         self.stats: dict = {}
 
     def _request(self, method: str, url: str, body: Optional[bytes] = None) -> dict:
         req = urllib.request.Request(url, data=body, method=method)
         req.add_header("Content-Type", "text/plain")
+        if self.password is not None:
+            import base64
+
+            cred = base64.b64encode(
+                f"{self.user or ''}:{self.password}".encode()).decode()
+            req.add_header("Authorization", f"Basic {cred}")
+        elif self.user:
+            req.add_header("X-Presto-User", self.user)
         with urllib.request.urlopen(req, timeout=60) as resp:
             return json.loads(resp.read().decode())
 

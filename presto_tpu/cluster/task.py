@@ -166,7 +166,12 @@ class TaskOutputOperator(Operator):
                                               for n in nulls], nrows)
             self.output.enqueue_broadcast(frame)
             return
-        if self.kind == GATHER or self.output.n_buffers == 1:
+        if self.kind == GATHER or self.output.n_buffers == 1 or \
+                self.key_idx is None:
+            # GATHER, and MERGE on the HTTP tier (key_idx is None): funnel to
+            # consumer 0, which then does the whole sort — the range-split
+            # distributed sort is an SPMD-tier feature (parallel/runner.py);
+            # the HTTP data plane keeps the reference's single-merger shape
             self._append(0, datas, nulls, None)
         else:
             keys = [np.where(nulls[i], 0, datas[i]).astype(np.int64)

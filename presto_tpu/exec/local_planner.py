@@ -206,11 +206,15 @@ class LocalExecutionPlanner:
 
     def __init__(self, metadata: MetadataManager, session: Session,
                  n_workers: int = 1,
-                 remote_dicts: Optional[Dict[int, List[Optional[Dictionary]]]] = None):
+                 remote_dicts: Optional[Dict[int, List[Optional[Dictionary]]]] = None,
+                 devices=None):
         self.metadata = metadata
         self.session = session
         self.page_capacity = int(session.get("page_capacity"))
         self.n_workers = n_workers
+        # worker -> device placement (distributed mode): scans upload worker
+        # w's pages to mesh device w so fragment chains stay device-resident
+        self.devices = devices
         # producer fragment id -> its output dictionaries (a plan-time property:
         # the runner plans fragments bottom-up and feeds each consumer the dicts
         # of its already-planned producers)
@@ -253,6 +257,11 @@ class LocalExecutionPlanner:
                 for fac in pipeline:
                     fac.memory_ctx = mem
                     fac.revoke_check = check
+        if self.devices is not None:
+            for pipeline in self.pipelines:
+                for fac in pipeline:
+                    if isinstance(fac, TableScanOperatorFactory):
+                        fac.devices = self.devices
         return LocalExecutionPlan(self.pipelines, sink, root.column_names,
                                   [s.type for s in chain.symbols],
                                   list(chain.dicts), self.remote_slots)

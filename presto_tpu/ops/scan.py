@@ -177,8 +177,11 @@ class TableScanOperator(Operator):
         self._prefetcher: Optional[_Prefetcher] = None
         self._iter: Optional[Iterator[Page]] = None
         # device-resident replay: a deterministic source's uploaded pages are
-        # cached across queries (see _ResidentPageCache)
-        self._cache_token = getattr(source, "cache_token", None)
+        # cached across queries (see _ResidentPageCache); keyed by target
+        # device too — worker w must never replay pages resident on another
+        # worker's chip
+        token = getattr(source, "cache_token", None)
+        self._cache_token = None if token is None else (token, device)
         self._replay: Optional[Iterator[Page]] = None
         self._collected: Optional[List[Page]] = None
         self._collected_bytes = 0
@@ -283,6 +286,10 @@ class TableScanOperatorFactory(OperatorFactory):
                  processor: Optional[PageProcessor] = None, ready=None,
                  prefetch: bool = True):
         super().__init__(operator_id, "TableScan")
+        # worker -> target device (set by the planner in distributed mode so
+        # worker w's pages live on mesh device w and downstream fragment
+        # chains stay device-resident; None = default device)
+        self.devices = None
         if callable(page_sources):
             self._sources_fn = page_sources
         else:
@@ -330,8 +337,11 @@ class TableScanOperatorFactory(OperatorFactory):
         if worker not in self._remaining:
             self._remaining[worker] = list(self._sources_fn(worker))
         src = self._remaining[worker].pop(0)
+        device = None
+        if self.devices:
+            device = self.devices[worker % len(self.devices)]
         return TableScanOperator(self.context(worker), src, self._types,
-                                 self._processor,
+                                 self._processor, device=device,
                                  ready=self._ready(worker) if self._ready else None,
                                  process_fn=self._process_fn,
                                  prefetch=self._prefetch)

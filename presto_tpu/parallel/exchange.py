@@ -54,8 +54,27 @@ def repartition(arrays: Sequence[jnp.ndarray], mask: jnp.ndarray, key: jnp.ndarr
     backpressure). Returns (arrays', mask', dropped) where arrays'/mask' hold the rows
     whose key hashes to THIS worker, shape (n_parts * out_cap_per_peer,).
     """
-    n = mask.shape[0]
     pid = jnp.where(mask, partition_ids(key, n_parts), n_parts)
+    return repartition_by_pid(arrays, mask, pid, n_parts, out_cap_per_peer,
+                              axis_name)
+
+
+def range_partition_ids(range_key: jnp.ndarray, splitters: jnp.ndarray,
+                        mask: jnp.ndarray, n_parts: int) -> jnp.ndarray:
+    """Row -> target partition by VALUE RANGE: worker w receives keys in
+    (splitters[w-1], splitters[w]] — the distributed-ORDER-BY routing where
+    worker order equals global order (MergeOperator's re-design; see
+    sql/planner/plan.py MERGE)."""
+    pid = jnp.searchsorted(splitters, range_key, side="left").astype(jnp.int32)
+    return jnp.where(mask, jnp.clip(pid, 0, n_parts - 1), n_parts)
+
+
+def repartition_by_pid(arrays: Sequence[jnp.ndarray], mask: jnp.ndarray,
+                       pid: jnp.ndarray, n_parts: int, out_cap_per_peer: int,
+                       axis_name: str = WORKER_AXIS):
+    """Route rows to the peers named by `pid` (n_parts = masked-off). Shared
+    tail of hash REPARTITION and range MERGE exchanges."""
+    n = mask.shape[0]
     # stable sort rows by partition; within-partition order preserved
     order = jnp.argsort(pid, stable=True)
     pid_s = pid[order]

@@ -33,8 +33,8 @@ from ..sql.planner.add_exchanges import add_exchanges
 from ..sql.planner.fragmenter import (Fragment, SINGLE_PART, SubPlan,
                                       fragment_plan)
 from ..sql.planner.optimizer import optimize
-from ..sql.planner.plan import (BROADCAST, GATHER, OutputNode, REPARTITION,
-                                RemoteSourceNode, plan_to_text)
+from ..sql.planner.plan import (BROADCAST, GATHER, MERGE, OutputNode,
+                                REPARTITION, RemoteSourceNode, plan_to_text)
 from ..sql.planner.planner import LogicalPlanner
 from ..types import Type
 from .mesh import MeshContext, WORKER_AXIS
@@ -116,7 +116,8 @@ class DistributedQueryRunner:
             # plan ONCE per fragment: every worker shares the factories (and so
             # the jit-compiled kernels); only splits/exchange pages differ
             lp = LocalExecutionPlanner(self.metadata, self.session,
-                                       n_workers=W, remote_dicts=frag_dicts)
+                                       n_workers=W, remote_dicts=frag_dicts,
+                                       devices=self.mesh.devices)
             lp.attach_memory(*query_memory)
             ep = lp.plan(root)
             for fid, slot in ep.remote_slots.items():
@@ -132,13 +133,19 @@ class DistributedQueryRunner:
                                    ep.output_types)
             per_worker = [ep.sink.pages_for(w) for w in range(W)]
             key_idx = None
+            orderings = None
+            names = [s.name for s in frag.root.outputs()]
             if frag.output_kind == REPARTITION:
-                names = [s.name for s in frag.root.outputs()]
                 key_idx = [names.index(k.name) for k in frag.output_keys]
+            elif frag.output_kind == MERGE:
+                orderings = tuple(
+                    (names.index(o.symbol.name), o.descending, o.nulls_first)
+                    for o in frag.output_orderings)
             routed[frag.id] = run_exchange(
                 self.mesh, frag.output_kind, key_idx, per_worker,
                 ep.output_types, ep.output_dicts,
-                page_capacity=int(self.session.get("page_capacity")))
+                page_capacity=int(self.session.get("page_capacity")),
+                orderings=orderings)
             frag_dicts[frag.id] = ep.output_dicts
         raise AssertionError("root fragment must terminate execution")
 
@@ -148,58 +155,137 @@ class DistributedQueryRunner:
 # page lists (the engine's entire shuffle data plane)
 # ---------------------------------------------------------------------------
 
-def _compact_worker(pages: List[Page], types: Sequence[Type]
-                    ) -> Tuple[List[np.ndarray], List[np.ndarray], int]:
-    """Concat this worker's pages and drop masked-off rows (host side).
+# observability for the multichip dryrun's "no host copies between fragments"
+# check: counts host->device uploads the exchange had to make (only the
+# zeros backfill for workers with no output pages in the resident path)
+EXCHANGE_STATS = {"host_uploads": 0, "exchanges": 0}
 
-    Compaction is what keeps exchange shapes bounded by LIVE row counts: an
-    exchange's receive buffer is W x cap, so forwarding padding would multiply
-    page capacity by W at every exchange hop."""
+# shape floor for exchange buffers: below this, padding is free but every
+# distinct capacity would compile (and cache) another XLA collective
+_MIN_EXCHANGE_CAP = 1 << 9
+
+
+@functools.lru_cache(maxsize=1)
+def _compact_pad_jit():
+    """(R,) columns + mask -> (L,) prefix-compacted columns + mask, on the
+    inputs' device. The reference materializes selected positions the same
+    way before serializing (PartitionedOutputOperator.java:380); here it is
+    one fused scatter and the result never leaves the worker's chip."""
+    import jax
+    import jax.numpy as jnp
+
+    def fn(datas, nulls, mask, L):
+        pos = jnp.cumsum(mask.astype(jnp.int32)) - 1
+        tgt = jnp.where(mask, pos, L)  # dead rows scatter out of bounds
+        out_mask = jnp.zeros(L, dtype=jnp.bool_).at[tgt].set(mask, mode="drop")
+        out_d = tuple(jnp.zeros(L, dtype=a.dtype).at[tgt].set(a, mode="drop")
+                      for a in datas)
+        out_n = tuple(jnp.zeros(L, dtype=jnp.bool_).at[tgt].set(n, mode="drop")
+                      for n in nulls)
+        return out_d, out_n, out_mask
+    return jax.jit(fn, static_argnames=("L",))
+
+
+def _worker_device_columns(pages: List[Page], types: Sequence[Type]):
+    """Concat+widen one worker's pages ON ITS DEVICE -> (datas, nulls, mask,
+    live_count). Eager jnp ops follow the pages' committed device, so a worker
+    whose pipeline ran on mesh device w compacts on device w."""
+    import jax.numpy as jnp
+
     ncols = len(types)
-    mparts = [np.asarray(p.mask) for p in pages]
-    mask = np.concatenate(mparts) if mparts else np.zeros(0, dtype=bool)
-    keep = np.flatnonzero(mask)
-    datas: List[np.ndarray] = []
-    nulls: List[np.ndarray] = []
+    masks = [jnp.asarray(p.mask) for p in pages]
+    mask = masks[0] if len(masks) == 1 else jnp.concatenate(masks)
+    datas, nulls = [], []
     for c in range(ncols):
         dt = np.dtype(types[c].np_dtype)
-        parts = [np.asarray(p.blocks[c].data) for p in pages]
-        col = np.concatenate(parts) if parts else np.zeros(0, dtype=dt)
-        datas.append(col.astype(dt, copy=False)[keep])
-        nparts = [np.asarray(p.blocks[c].nulls) if p.blocks[c].nulls is not None
-                  else np.zeros(p.capacity, dtype=bool) for p in pages]
-        nm = np.concatenate(nparts) if nparts else np.zeros(0, dtype=bool)
-        nulls.append(nm[keep])
-    return datas, nulls, len(keep)
+        parts = [jnp.asarray(p.blocks[c].data).astype(dt) for p in pages]
+        datas.append(parts[0] if len(parts) == 1 else jnp.concatenate(parts))
+        nparts = [jnp.asarray(p.blocks[c].nulls)
+                  if p.blocks[c].nulls is not None
+                  else jnp.zeros(p.capacity, dtype=jnp.bool_) for p in pages]
+        nulls.append(nparts[0] if len(nparts) == 1 else jnp.concatenate(nparts))
+    # live count stays a DEVICE scalar: the caller batches all workers'
+    # counts into one host transfer instead of W serialized syncs
+    return datas, nulls, mask, jnp.sum(mask.astype(jnp.int32))
 
 
-def _pad_to(arr: np.ndarray, length: int) -> np.ndarray:
-    pad = length - len(arr)
-    if pad <= 0:
-        return arr
-    return np.concatenate([arr, np.zeros(pad, dtype=arr.dtype)])
+def _range_key_for(data, nulls, type_, dictionary, descending: bool,
+                   nulls_first: bool):
+    """One worker's MERGE routing key (device, eager): the primary ORDER BY
+    column mapped to a monotone int64/float64 code — mirrors the local sort's
+    transform (ops/topn.py _sort_key_arrays) so range routing and the
+    per-worker sort can never disagree on order."""
+    import jax.numpy as jnp
+
+    from ..types import is_string
+
+    x = data
+    if is_string(type_) and dictionary is not None:
+        if hasattr(dictionary, "values"):
+            x = jnp.asarray(dictionary.sort_keys())[x]
+        elif not getattr(dictionary, "monotonic", False):
+            raise NotImplementedError(
+                f"distributed ORDER BY over non-monotonic virtual "
+                f"dictionary {dictionary!r}")
+    if jnp.issubdtype(x.dtype, jnp.floating):
+        key = x.astype(jnp.float64)
+        lo, hi = -jnp.inf, jnp.inf
+    else:
+        key = x.astype(jnp.int64)
+        info = np.iinfo(np.int64)
+        lo, hi = info.min + 1, info.max
+    if descending:
+        key = -key
+    if nulls is not None:
+        key = jnp.where(nulls, lo if nulls_first else hi, key)
+    return key
 
 
 @functools.lru_cache(maxsize=256)
 def _exchange_program(mesh, kind: str, key_idx: Optional[Tuple[int, ...]],
-                      ncols: int, W: int, L: int):
+                      ncols: int, W: int, L: int, out_cap: int,
+                      range_dtype: Optional[str] = None):
     """Build + jit the exchange collective ONCE per (mesh, kind, keys, shape)
     signature — repeated exchanges of the same shape reuse the compiled XLA
-    program (the reference reuses its HTTP buffer machinery similarly)."""
+    program (the reference reuses its HTTP buffer machinery similarly).
+
+    `out_cap` is the per-peer receive capacity. For REPARTITION the caller
+    sizes it from the measured max (worker, peer) send count — sizing it to L
+    (the worst case) would make every downstream page W/occupancy times
+    padding, which on an 8-way mesh was a ~10x compute blowup."""
     import jax
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
     from jax import shard_map
 
     from ..ops.hash_join import combined_key
-    from .exchange import broadcast_gather, gather_to_single, repartition
+    from .exchange import (broadcast_gather, gather_to_single,
+                           range_partition_ids, repartition,
+                           repartition_by_pid)
+
+    n_arrays = 2 * ncols
+
+    if kind == MERGE:
+        def merge_stage(arrays, mask, range_key, splitters):
+            pid = range_partition_ids(range_key, splitters, mask, W)
+            out, m, dropped = repartition_by_pid(
+                list(arrays) + [range_key], mask, pid, W, out_cap)
+            return tuple(out[:-1]), m, dropped.reshape(1)
+
+        smapped = shard_map(
+            merge_stage, mesh=mesh,
+            in_specs=(tuple(P(WORKER_AXIS) for _ in range(n_arrays)),
+                      P(WORKER_AXIS), P(WORKER_AXIS), P()),
+            out_specs=(tuple(P(WORKER_AXIS) for _ in range(n_arrays)),
+                       P(WORKER_AXIS), P(WORKER_AXIS)))
+        return jax.jit(smapped)
 
     def stage(arrays, mask):
         if kind == REPARTITION:
             keys = [jnp.where(arrays[ncols + i], 0, arrays[i]).astype(jnp.int64)
                     for i in key_idx]
             out, m, dropped = repartition(list(arrays), mask,
-                                          combined_key(keys), W, L)
+                                          combined_key(keys), W, out_cap)
             return tuple(out), m, dropped.reshape(1)
         if kind == BROADCAST:
             out, m = broadcast_gather(list(arrays), mask)
@@ -209,7 +295,6 @@ def _exchange_program(mesh, kind: str, key_idx: Optional[Tuple[int, ...]],
             raise AssertionError(kind)
         return tuple(out), m, jnp.zeros(1, dtype=jnp.int32)
 
-    n_arrays = 2 * ncols
     smapped = shard_map(
         stage, mesh=mesh,
         in_specs=(tuple(P(WORKER_AXIS) for _ in range(n_arrays)), P(WORKER_AXIS)),
@@ -221,38 +306,154 @@ def _exchange_program(mesh, kind: str, key_idx: Optional[Tuple[int, ...]],
 def run_exchange(mesh: MeshContext, kind: str, key_idx: Optional[List[int]],
                  per_worker_pages: List[List[Page]], types: Sequence[Type],
                  dicts: Sequence[Optional[Dictionary]],
-                 page_capacity: int = 1 << 14) -> List[List[Page]]:
+                 page_capacity: int = 1 << 14,
+                 orderings=None) -> List[List[Page]]:
     """Route every worker's output pages to their consumers with ONE shard_map
     collective over the mesh (REPARTITION=all_to_all, BROADCAST=all_gather,
-    GATHER=all_gather masked to worker 0)."""
+    GATHER=all_gather masked to worker 0).
+
+    DEVICE-RESIDENT end to end: each worker's pages compact on their own
+    device, the global sharded array is assembled from those per-device
+    shards (jax.make_array_from_single_device_arrays — no host gather), the
+    collective runs, and the output shards are handed to the next fragment as
+    device pages. The only host->device uploads are zero backfills for
+    workers that produced nothing (counted in EXCHANGE_STATS). The reference
+    never re-materializes pages host-side mid-query either — its data plane
+    streams serialized pages process-to-process (ExchangeClient.java)."""
     import jax
+    import jax.numpy as jnp
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     W = mesh.n_workers
     ncols = len(types)
-    flat = [_compact_worker(pages, types) for pages in per_worker_pages]
-    # bucket L (live rows of the fullest worker) to powers of two so repeated
-    # exchanges of similar volume reuse one compiled collective
-    L = max(max(f[2] for f in flat), 1)
-    L = 1 << (L - 1).bit_length()
+    EXCHANGE_STATS["exchanges"] += 1
 
-    # stack to (W*L,) global arrays, leading axis sharded over workers
-    g_datas = [np.concatenate([_pad_to(f[0][c], L) for f in flat])
-               for c in range(ncols)]
-    g_nulls = [np.concatenate([_pad_to(f[1][c], L) for f in flat])
-               for c in range(ncols)]
-    g_mask = np.concatenate(
-        [_pad_to(np.ones(f[2], dtype=bool), L) for f in flat])
+    compacted = [None] * W
+    for w, pages in enumerate(per_worker_pages):
+        if pages:
+            compacted[w] = _worker_device_columns(pages, types)
+    # ONE batched host transfer for all workers' live counts (device_get on
+    # the list issues every d2h together, not W serialized blocking syncs)
+    live_devs = [c[3] for c in compacted if c is not None]
+    live_np = iter(jax.device_get(live_devs))
+    live = [int(next(live_np)) if compacted[w] is not None else 0
+            for w in range(W)]
+    # bucket L (live rows of the fullest worker) to powers of two — with a
+    # floor — so repeated exchanges of similar volume reuse one compiled
+    # collective; every distinct (L, out_cap) is a separate XLA program, and
+    # distinct-program count is worth bounding (compile time, code memory)
+    L = max(1 << (max(max(live), 1) - 1).bit_length(), _MIN_EXCHANGE_CAP)
+
+    compact = _compact_pad_jit()
+    shard_datas: List[List] = [None] * W  # per worker: ncols data arrays
+    shard_nulls: List[List] = [None] * W
+    shard_masks: List = [None] * W
+    for w in range(W):
+        dev = mesh.devices[w]
+        if compacted[w] is None:
+            # no output on this worker: zero shards (the one host upload)
+            EXCHANGE_STATS["host_uploads"] += 1
+            shard_datas[w] = [
+                jax.device_put(np.zeros(L, dtype=types[c].np_dtype), dev)
+                for c in range(ncols)]
+            shard_nulls[w] = [jax.device_put(np.zeros(L, dtype=bool), dev)
+                              for _ in range(ncols)]
+            shard_masks[w] = jax.device_put(np.zeros(L, dtype=bool), dev)
+            continue
+        datas, nulls, mask, _ = compacted[w]
+        out_d, out_n, out_m = compact(tuple(datas), tuple(nulls), mask, L)
+        # device_put to the worker's own device is a no-op when the pipeline
+        # already ran there; otherwise a direct device-to-device move
+        shard_datas[w] = [jax.device_put(a, dev) for a in out_d]
+        shard_nulls[w] = [jax.device_put(a, dev) for a in out_n]
+        shard_masks[w] = jax.device_put(out_m, dev)
 
     sharding = NamedSharding(mesh.mesh, P(WORKER_AXIS))
-    dev_arrays = [jax.device_put(a, sharding) for a in g_datas + g_nulls]
-    dev_mask = jax.device_put(g_mask, sharding)
+
+    def assemble(shards):
+        return jax.make_array_from_single_device_arrays(
+            (W * L,), sharding, shards)
+
+    dev_arrays = [assemble([shard_datas[w][c] for w in range(W)])
+                  for c in range(ncols)]
+    dev_arrays += [assemble([shard_nulls[w][c] for w in range(W)])
+                   for c in range(ncols)]
+    dev_mask = assemble([shard_masks[w] for w in range(W)])
+
+    # per-peer receive capacity: worst case (L) for gather/broadcast; for
+    # REPARTITION/MERGE measure the true max (worker, peer) send count so
+    # output pages are sized to the data, not to the theoretical skew bound
+    out_cap = L
+    range_keys = splitters = None
+    if kind == REPARTITION:
+        from ..ops.hash_join import combined_key
+        from .exchange import partition_ids
+
+        maxes = []
+        for w in range(W):
+            if compacted[w] is None:
+                continue
+            datas, nulls_w, mask, _ = compacted[w]
+            keys = [jnp.where(nulls_w[i], 0, datas[i]).astype(jnp.int64)
+                    for i in key_idx]
+            pid = jnp.where(mask, partition_ids(combined_key(keys), W), W)
+            counts = jax.ops.segment_sum(
+                jnp.ones_like(pid), pid, num_segments=W + 1)[:W]
+            maxes.append(jnp.max(counts))
+        max_count = int(max(jax.device_get(maxes))) if maxes else 1
+        out_cap = max(1 << (max(max_count, 1) - 1).bit_length(),
+                      _MIN_EXCHANGE_CAP)
+        out_cap = min(out_cap, L)
+    elif kind == MERGE:
+        # range routing for distributed ORDER BY: per-worker routing key on
+        # each worker's device, splitters from pooled samples (control-plane
+        # scalars — the reference samples the same way for bucketed sorts)
+        from .exchange import range_partition_ids
+
+        ch, desc, nf = orderings[0]
+        range_keys = [None] * W
+        samples = []
+        for w in range(W):
+            key_w = _range_key_for(
+                jax.device_put(shard_datas[w][ch], mesh.devices[w]),
+                shard_nulls[w][ch], types[ch], dicts[ch], desc, nf)
+            range_keys[w] = jax.device_put(key_w, mesh.devices[w])
+            lw = live[w]
+            if lw:
+                stride = max(1, lw // 128)
+                samples.append(np.asarray(key_w[:lw:stride][:128]))
+        pooled = np.sort(np.concatenate(samples)) if samples else \
+            np.zeros(1, dtype=range_keys[0].dtype)
+        splitters = np.asarray(
+            [pooled[len(pooled) * i // W] for i in range(1, W)],
+            dtype=pooled.dtype)
+        maxes = []
+        for w in range(W):
+            if compacted[w] is None:
+                continue
+            pid = range_partition_ids(range_keys[w],
+                                      jax.device_put(splitters,
+                                                     mesh.devices[w]),
+                                      shard_masks[w], W)
+            counts = jax.ops.segment_sum(
+                jnp.ones_like(pid), pid, num_segments=W + 1)[:W]
+            maxes.append(jnp.max(counts))
+        max_count = int(max(jax.device_get(maxes))) if maxes else 1
+        out_cap = max(1 << (max(max_count, 1) - 1).bit_length(),
+                      _MIN_EXCHANGE_CAP)
+        out_cap = min(out_cap, L)
 
     # jax.sharding.Mesh is hashable and value-equal: safe as the cache key
     program = _exchange_program(
         mesh.mesh, kind, tuple(key_idx) if key_idx is not None else None,
-        ncols, W, L)
-    out_arrays, out_mask, dropped = program(tuple(dev_arrays), dev_mask)
+        ncols, W, L, out_cap,
+        str(range_keys[0].dtype) if kind == MERGE else None)
+    if kind == MERGE:
+        g_rangekey = assemble([range_keys[w] for w in range(W)])
+        out_arrays, out_mask, dropped = program(
+            tuple(dev_arrays), dev_mask, g_rangekey, splitters)
+    else:
+        out_arrays, out_mask, dropped = program(tuple(dev_arrays), dev_mask)
     n_dropped = int(np.asarray(dropped).sum())
     if n_dropped:
         # the send buffers are sized to the fullest worker's live rows, so a
@@ -263,29 +464,49 @@ def run_exchange(mesh: MeshContext, kind: str, key_idx: Optional[List[int]],
             f"repartition exchange dropped {n_dropped} rows "
             f"(capacity {L} per peer, {W} workers)")
 
-    # split back per worker, compact, and re-page at the standard page capacity
-    # (standard-shaped pages let every downstream operator reuse the kernels it
-    # already compiled for scan pages)
-    out_np = [np.asarray(a) for a in out_arrays]
-    mask_np = np.asarray(out_mask)
-    out_len = len(mask_np) // W
+    # hand each worker its output shard as DEVICE pages (no host round trip):
+    # prefix-compact the shard on its device, then slice into STANDARD pow2
+    # page capacities — downstream operators then reuse the kernels already
+    # compiled for scan pages instead of tracing one variant per shard length
+    # (capacity diversity compiles programs; program count is a real cost)
+    out_len = out_mask.shape[0] // W
+    # one host sync per column to decide null-mask presence (downstream
+    # kernels skip null arithmetic entirely for all-non-null columns)
+    null_cols = out_arrays[ncols:]
+    has_nulls = np.asarray(jnp.stack([jnp.any(a) for a in null_cols])) \
+        if ncols else np.zeros(0, dtype=bool)
+
+    def shards_by_worker(arr):
+        out = [None] * W
+        for sh in arr.addressable_shards:
+            start = sh.index[0].start or 0  # W=1: index is slice(None)
+            out[start // out_len] = sh.data
+        return out
+
+    data_shards = [shards_by_worker(out_arrays[c]) for c in range(ncols)]
+    nulls_shards = [shards_by_worker(null_cols[c]) for c in range(ncols)]
+    mask_shards = shards_by_worker(out_mask)
+    cap = min(max(page_capacity, _MIN_EXCHANGE_CAP), out_len)
+    out_compact = []
+    for w in range(W):
+        out_compact.append(compact(
+            tuple(data_shards[c][w] for c in range(ncols)),
+            tuple(nulls_shards[c][w] for c in range(ncols)),
+            mask_shards[w], out_len))
+    out_live = jax.device_get(
+        [jnp.sum(m.astype(jnp.int32)) for _, _, m in out_compact])
     routed: List[List[Page]] = []
     for w in range(W):
-        lo, hi = w * out_len, (w + 1) * out_len
-        keep = np.flatnonzero(mask_np[lo:hi]) + lo
-        if len(keep) == 0:
-            routed.append([])
-            continue
-        cap = min(page_capacity, 1 << (max(len(keep), 1) - 1).bit_length())
-        pages_out: List[Page] = []
-        for p0 in range(0, len(keep), cap):
-            sel = keep[p0:p0 + cap]
+        out_d, out_n, out_m = out_compact[w]
+        live_w = int(out_live[w])
+        n_pages = max(1, -(-live_w // cap))
+        pages: List[Page] = []
+        for off in range(0, n_pages * cap, cap):
             blocks = []
             for c in range(ncols):
-                nm = _pad_to(out_np[ncols + c][sel], cap)
-                blocks.append(Block(types[c], _pad_to(out_np[c][sel], cap),
-                                    nm if nm.any() else None, dicts[c]))
-            pages_out.append(Page(tuple(blocks),
-                                  _pad_to(np.ones(len(sel), dtype=bool), cap)))
-        routed.append(pages_out)
+                nm = out_n[c][off:off + cap] if has_nulls[c] else None
+                blocks.append(Block(types[c], out_d[c][off:off + cap],
+                                    nm, dicts[c]))
+            pages.append(Page(tuple(blocks), out_m[off:off + cap]))
+        routed.append(pages if live_w else [])
     return routed

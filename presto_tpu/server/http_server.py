@@ -32,11 +32,55 @@ _VERSION = "presto-tpu 0.1"
 
 class _Handler(BaseHTTPRequestHandler):
     manager: QueryManager = None  # set by serve()
+    authenticator = None          # PasswordAuthenticator (None = open server)
     protocol_version = "HTTP/1.1"
 
     # silence per-request stderr logging (the engine logs through its own path)
     def log_message(self, fmt, *args):  # noqa: A003
         pass
+
+    _principal = ""
+
+    def _authenticate(self):
+        """HTTP Basic authentication against the configured password
+        authenticator (server/security/ + presto-password-authenticators
+        analogue). Guards EVERY endpoint except /v1/info (health probe):
+        results/cancel/query-listing leak data and control without it.
+        Returns the authenticated principal (stored on self._principal), or
+        None after sending a 401/403 response. Open servers pass through."""
+        if self.authenticator is None:
+            return self.headers.get("X-Presto-User", "")
+        import base64
+
+        header = self.headers.get("Authorization", "")
+        scheme, _, payload = header.partition(" ")
+        if scheme.lower() != "basic" or not payload:
+            self.send_response(401)
+            self.send_header("WWW-Authenticate",
+                             'Basic realm="presto-tpu"')
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+            return None
+        try:
+            user, _, password = base64.b64decode(payload).decode().partition(":")
+            principal = self.authenticator.authenticate(user, password)
+        except Exception:
+            self.send_response(401)
+            self.send_header("WWW-Authenticate",
+                             'Basic realm="presto-tpu"')
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+            return None
+        claimed = self.headers.get("X-Presto-User", "")
+        if claimed and claimed != principal:
+            # no impersonation support: the session user must be the principal
+            self._send_json(
+                {"error": {"message":
+                           f"user {claimed!r} does not match authenticated "
+                           f"principal {principal!r}"}}, status=403)
+            return None
+        self._principal = principal
+        return principal
 
     # ------------------------------------------------------------- plumbing
 
@@ -59,6 +103,8 @@ class _Handler(BaseHTTPRequestHandler):
     # ------------------------------------------------------------ endpoints
 
     def do_POST(self) -> None:  # noqa: N802 - http.server naming
+        if self._authenticate() is None:
+            return
         if self.path.rstrip("/") == "/v1/announcement":
             # worker service announcement (cluster mode: discovery endpoint)
             nodes = getattr(self.manager.runner, "nodes", None)
@@ -70,17 +116,28 @@ class _Handler(BaseHTTPRequestHandler):
             return self._send_json({"announced": body["nodeId"]}, status=202)
         if self.path.rstrip("/") != "/v1/statement":
             return self._not_found()
+        user = self.headers.get("X-Presto-User", "") \
+            if self.authenticator is None else self._principal
         length = int(self.headers.get("Content-Length", 0))
         sql = self.rfile.read(length).decode().strip()
         if not sql:
             return self._send_json(
                 {"error": {"message": "empty statement"}}, status=400)
         info = self.manager.submit(
-            sql, user=self.headers.get("X-Presto-User", ""),
+            sql, user=user,
             source=self.headers.get("X-Presto-Source", ""))
         self._send_json(self.manager.results_payload(info, 0, self._base_uri()))
 
     def do_GET(self) -> None:  # noqa: N802
+        if self.path.rstrip("/") == "/v1/info":
+            # health probe stays open (load balancers / failure detector)
+            return self._send_json({
+                "nodeVersion": {"version": _VERSION},
+                "uptime": round(time.time() - _START_TIME, 1),
+                "coordinator": True,
+            })
+        if self._authenticate() is None:
+            return
         m = re.fullmatch(r"/v1/statement/([^/]+)/(\d+)", self.path)
         if m:
             info = self.manager.get(m.group(1))
@@ -88,12 +145,6 @@ class _Handler(BaseHTTPRequestHandler):
                 return self._not_found()
             return self._send_json(self.manager.results_payload(
                 info, int(m.group(2)), self._base_uri()))
-        if self.path.rstrip("/") == "/v1/info":
-            return self._send_json({
-                "nodeVersion": {"version": _VERSION},
-                "uptime": round(time.time() - _START_TIME, 1),
-                "coordinator": True,
-            })
         if self.path.rstrip("/") == "/v1/cluster":
             # ClusterStatsResource.java analogue (feeds the web UI)
             queries = self.manager.list_queries()
@@ -119,6 +170,8 @@ class _Handler(BaseHTTPRequestHandler):
         self._not_found()
 
     def do_DELETE(self) -> None:  # noqa: N802
+        if self._authenticate() is None:
+            return
         m = re.fullmatch(r"/v1/statement/([^/]+)/(\d+)", self.path)
         if m and self.manager.cancel(m.group(1)):
             self.send_response(204)
@@ -145,7 +198,7 @@ class PrestoTpuServer:
 
     def __init__(self, runner=None, port: int = 8080, page_rows: int = 1000,
                  resource_groups=None, listeners=None, access_control=None,
-                 transactions=True):
+                 transactions=True, authenticator=None):
         if runner is None:
             from ..runner import LocalQueryRunner
             runner = LocalQueryRunner()
@@ -167,7 +220,9 @@ class PrestoTpuServer:
                                     monitor=monitor,
                                     access_control=access_control,
                                     transactions=tx_manager)
-        handler = type("BoundHandler", (_Handler,), {"manager": self.manager})
+        handler = type("BoundHandler", (_Handler,),
+                       {"manager": self.manager,
+                        "authenticator": authenticator})
         self.httpd = ThreadingHTTPServer(("0.0.0.0", port), handler)
         self.port = self.httpd.server_address[1]
 
@@ -205,6 +260,7 @@ def main(argv=None) -> None:
     from ..metadata import Session
     catalogs = None
     port = args.port
+    authenticator = None
     if args.etc:
         from .config import load_catalogs, load_config, session_from_config
 
@@ -216,6 +272,30 @@ def main(argv=None) -> None:
                               schema=session.schema or args.schema,
                               properties=session.properties)
         port = int(conf.get("http-server.http.port", args.port))
+        # etc/config.properties auth wiring, mirroring the reference's
+        # http-server.authentication.type=PASSWORD + the password plugin's
+        # config file (presto-password-authenticators)
+        if conf.get("http-server.authentication.type", "").upper() == \
+                "PASSWORD":
+            from ..security import FileBasedPasswordAuthenticator
+
+            pw_file = conf.get("password-authenticator.config-file")
+            if not pw_file:
+                raise ValueError(
+                    "http-server.authentication.type=PASSWORD requires "
+                    "password-authenticator.config-file")
+            # this server has no TLS listener: Basic credentials would cross
+            # the wire in the clear. Require the explicit opt-in the
+            # reference requires before allowing password auth without HTTPS
+            # (its ServerSecurityModule refuses the same combination).
+            if conf.get("http-server.authentication.allow-insecure-over-http",
+                        "false").lower() != "true":
+                raise ValueError(
+                    "PASSWORD authentication over plain HTTP sends "
+                    "credentials in cleartext; set http-server."
+                    "authentication.allow-insecure-over-http=true to accept "
+                    "that (e.g. behind a TLS-terminating proxy)")
+            authenticator = FileBasedPasswordAuthenticator(pw_file)
     else:
         session = Session(catalog="tpch", schema=args.schema)
     if args.cluster:
@@ -231,9 +311,10 @@ def main(argv=None) -> None:
         from ..runner import LocalQueryRunner
         runner = LocalQueryRunner(session=session, catalogs=catalogs)
         mode = "local"
-    server = PrestoTpuServer(runner, port=port)
+    server = PrestoTpuServer(runner, port=port, authenticator=authenticator)
     print(f"presto-tpu server listening on :{server.port} "
-          f"({mode}, schema={args.schema})")
+          f"({mode}, schema={args.schema}"
+          f"{', password-auth' if authenticator else ''})")
     server.serve()
 
 

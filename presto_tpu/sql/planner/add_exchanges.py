@@ -26,10 +26,10 @@ from typing import List, Optional, Tuple
 from ...ops.aggregates import resolve_aggregate
 from ...ops.expressions import SymbolRef
 from .plan import (AggregationNode, BROADCAST, EnforceSingleRowNode, ExchangeNode,
-                   FilterNode, FINAL, GATHER, JoinNode, LimitNode, OutputNode,
-                   PARTIAL, PlanNode, ProjectNode, REPARTITION, SemiJoinNode,
-                   SINGLE, SortNode, Symbol, SymbolAllocator, TableScanNode,
-                   TopNNode, UnionNode, ValuesNode)
+                   FilterNode, FINAL, GATHER, JoinNode, LimitNode, MERGE,
+                   OutputNode, PARTIAL, PlanNode, ProjectNode, REPARTITION,
+                   SemiJoinNode, SINGLE, SortNode, Symbol, SymbolAllocator,
+                   TableScanNode, TopNNode, UnionNode, ValuesNode)
 
 SOURCE_DIST = "source"
 SINGLE_DIST = "single"
@@ -237,9 +237,17 @@ class ExchangePlanner:
 
     def visit_SortNode(self, node: SortNode):
         child, dist = self.visit(node.source)
-        if dist != SINGLE_DIST:
-            child = ExchangeNode(child, GATHER, [])
-        return SortNode(child, node.orderings), SINGLE_DIST
+        if dist == SINGLE_DIST:
+            return SortNode(child, node.orderings), SINGLE_DIST
+        # distributed ORDER BY (no LIMIT): range-repartition by the primary
+        # sort key so worker w holds the w-th value range, then each worker
+        # sorts its shard LOCALLY — worker-order concatenation at the final
+        # GATHER is already the global order. The sort work distributes over
+        # the mesh instead of funneling raw rows to one worker (the
+        # reference's per-node sort + MergeOperator, re-designed so the
+        # "merge" is free: range disjointness replaces the N-way heap).
+        ex = ExchangeNode(child, MERGE, [], orderings=list(node.orderings))
+        return SortNode(ex, node.orderings), "ordered"
 
     def visit_LimitNode(self, node: LimitNode):
         child, dist = self.visit(node.source)

@@ -26,8 +26,10 @@ class Fragment:
     root: PlanNode                    # subtree with RemoteSourceNodes at cuts
     partitioning: str                 # how THIS fragment executes
     # how this fragment's output is routed to its consumer (None for the root):
-    output_kind: Optional[str] = None       # REPARTITION | BROADCAST | GATHER
+    output_kind: Optional[str] = None  # REPARTITION | BROADCAST | GATHER | MERGE
     output_keys: Optional[List[Symbol]] = None
+    # MERGE (range) routing: the ORDER BY spec driving the splitters
+    output_orderings: Optional[list] = None
 
 
 @dataclasses.dataclass
@@ -57,7 +59,8 @@ class PlanFragmenter:
                 root=child,
                 partitioning=self._partitioning_of(child),
                 output_kind=node.kind,
-                output_keys=list(node.keys))
+                output_keys=list(node.keys),
+                output_orderings=list(node.orderings or ()))
             self._fragments.append(frag)
             return RemoteSourceNode(frag.id, list(node.outputs()))
         children = [self._cut(c) for c in node.children()]

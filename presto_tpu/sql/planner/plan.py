@@ -315,18 +315,25 @@ class ValuesNode(PlanNode):
 
 
 # exchange kinds (SystemPartitioningHandle.java:59-65 vocabulary, TPU mapping:
-# REPARTITION = all_to_all, BROADCAST = all_gather, GATHER = all_gather + mask)
-REPARTITION, BROADCAST, GATHER = "repartition", "broadcast", "gather"
+# REPARTITION = all_to_all, BROADCAST = all_gather, GATHER = all_gather + mask,
+# MERGE = range-repartition by the sort key (distributed ORDER BY: worker w
+# holds the w-th value range, so worker-order concatenation IS global order —
+# the TPU re-design of the reference's per-node sort + MergeOperator N-way
+# merge, operator/MergeOperator.java / MergeHashSort.java)
+REPARTITION, BROADCAST, GATHER, MERGE = \
+    "repartition", "broadcast", "gather", "merge"
 
 
 @_node
 class ExchangeNode(PlanNode):
     """plan/ExchangeNode (REMOTE scope): the distribution boundary the fragmenter
     cuts at. `keys` drive hash routing for REPARTITION (empty for BROADCAST /
-    GATHER) — AddExchanges.java:132,205-253 analogue."""
+    GATHER); `orderings` drive range routing for MERGE —
+    AddExchanges.java:132,205-253 analogue."""
     source: PlanNode
-    kind: str                      # REPARTITION | BROADCAST | GATHER
+    kind: str                      # REPARTITION | BROADCAST | GATHER | MERGE
     keys: List[Symbol]
+    orderings: Optional[List["Ordering"]] = None
 
     def outputs(self):
         return self.source.outputs()
@@ -335,7 +342,7 @@ class ExchangeNode(PlanNode):
         return [self.source]
 
     def with_children(self, children):
-        return ExchangeNode(children[0], self.kind, self.keys)
+        return ExchangeNode(children[0], self.kind, self.keys, self.orderings)
 
 
 @_node

@@ -139,3 +139,25 @@ def test_skewed_join_key(dist, local):
     sql = ("select o_custkey % 3, count(*), sum(o_totalprice) from orders "
            "where o_custkey % 10 < 9 group by 1 order by 1")
     check(dist, local, sql)
+
+
+def test_dist_order_by_no_limit(dist, local):
+    # full ORDER BY without LIMIT: MERGE (range) exchange + per-worker sort —
+    # worker-order concatenation must equal the global order (the engine's
+    # distributed-sort answer to operator/MergeOperator.java). Secondary key
+    # makes the expected order fully determined.
+    check(dist, local,
+          "select c_custkey, c_acctbal from customer "
+          "order by c_acctbal, c_custkey")
+
+
+def test_dist_order_by_desc_varchar(dist, local):
+    check(dist, local,
+          "select c_name, c_custkey from customer "
+          "order by c_name desc, c_custkey")
+
+
+def test_dist_order_by_multi_key(dist, local):
+    check(dist, local,
+          "select o_orderkey, o_orderdate, o_totalprice from orders "
+          "order by o_orderdate desc, o_totalprice, o_orderkey")

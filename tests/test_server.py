@@ -134,3 +134,73 @@ def test_cli_execute_csv(base, capsys):
     assert rc == 0
     assert out.splitlines()[0] == "n_nationkey,n_name"
     assert out.splitlines()[1] == "0,ALGERIA"
+
+
+# ---------------------------------------------------------------------------
+# password authentication (server/security/ + presto-password-authenticators)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def auth_server(tmp_path_factory):
+    from presto_tpu.security import (FileBasedPasswordAuthenticator,
+                                     hash_password)
+
+    pw_file = tmp_path_factory.mktemp("auth") / "password.db"
+    pw_file.write_text(
+        f"alice:{hash_password('wonderland')}\nbob:plain:builder\n")
+    runner = LocalQueryRunner(session=Session(catalog="tpch", schema="tiny"))
+    srv = PrestoTpuServer(
+        runner, port=0,
+        authenticator=FileBasedPasswordAuthenticator(str(pw_file)))
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+def test_unauthenticated_statement_rejected(auth_server):
+    import urllib.error
+
+    req = urllib.request.Request(
+        f"http://localhost:{auth_server.port}/v1/statement",
+        data=b"select 1", method="POST")
+    with pytest.raises(urllib.error.HTTPError) as e:
+        urllib.request.urlopen(req, timeout=30)
+    assert e.value.code == 401
+    assert e.value.headers.get("WWW-Authenticate", "").startswith("Basic")
+
+
+def test_wrong_password_rejected(auth_server):
+    import urllib.error
+
+    from presto_tpu.client import StatementClient
+
+    client = StatementClient(f"http://localhost:{auth_server.port}",
+                             "select 1", user="alice", password="nope")
+    with pytest.raises(urllib.error.HTTPError) as e:
+        list(client.rows())
+    assert e.value.code == 401
+
+
+def test_authenticated_query_runs(auth_server):
+    from presto_tpu.client import StatementClient
+
+    for user, pw in (("alice", "wonderland"), ("bob", "builder")):
+        client = StatementClient(f"http://localhost:{auth_server.port}",
+                                 "select count(*) from nation",
+                                 user=user, password=pw)
+        assert list(client.rows()) == [[25]]
+
+
+def test_principal_mismatch_rejected(auth_server):
+    import base64
+    import urllib.error
+
+    cred = base64.b64encode(b"alice:wonderland").decode()
+    req = urllib.request.Request(
+        f"http://localhost:{auth_server.port}/v1/statement",
+        data=b"select 1", method="POST")
+    req.add_header("Authorization", f"Basic {cred}")
+    req.add_header("X-Presto-User", "mallory")
+    with pytest.raises(urllib.error.HTTPError) as e:
+        urllib.request.urlopen(req, timeout=30)
+    assert e.value.code == 403
