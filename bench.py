@@ -251,16 +251,19 @@ def bench_pcol_scan(sf: float, seconds_budget: float = 30.0,
 
     base = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                         ".bench_data", "warehouse")
-    sfs = str(sf).replace(".", "_")
-    table = f"lineitem_sf{sfs}"
+    # the source schema quantizes sf (sf1/sf2/...): name the table after the
+    # schema actually materialized and report THAT schema's row count —
+    # otherwise a fractional --sf reports rows/s against the wrong row total
+    schema = "sf1" if sf <= 1 else f"sf{int(sf)}"
+    sf = 1.0 if sf <= 1 else float(int(sf))
+    table = f"lineitem_{schema}"
     catalogs = CatalogManager()
     catalogs.register("tpch", TpchConnector("tpch"))
     catalogs.register("warehouse", FileConnector("warehouse", base))
     runner = LocalQueryRunner(
         session=Session(catalog="warehouse", schema="bench"),
         catalogs=catalogs)
-    out = {}
-    schema = "sf1" if sf <= 1 else f"sf{int(sf)}"
+    out = {"schema": schema}
     exists = runner.metadata.get_table_handle(
         runner.session,
         runner.metadata.resolve_table_name(

@@ -81,7 +81,9 @@ class ClusterQueryRunner:
         planner = LogicalPlanner(self.metadata, self.session)
         plan = planner.plan(stmt)
         plan = optimize(plan, self.metadata, self.session)
-        plan = add_exchanges(plan, planner.symbols, self.metadata, self.session)
+        n = max(len(self.nodes.active_nodes()), 1)
+        plan = add_exchanges(plan, planner.symbols, self.metadata, self.session,
+                             n_workers=n)
         return fragment_plan(plan)
 
     # ------------------------------------------------------------ execution

@@ -131,8 +131,19 @@ class Announcer:
             method="POST", headers={"Content-Type": "application/json"})
         try:
             urllib.request.urlopen(req, timeout=5.0).read()
-        except Exception:
-            pass  # coordinator may not be up yet; retried next period
+            self._announce_failures = 0
+        except Exception as e:
+            # coordinator may not be up yet (retried next period) — but a
+            # PERSISTENT failure must be loud: a 401 here means the
+            # coordinator requires authentication the worker cannot supply
+            # and the node would silently never join the cluster
+            n = getattr(self, "_announce_failures", 0) + 1
+            self._announce_failures = n
+            if n in (3, 20) or n % 100 == 0:
+                import sys
+                print(f"presto_tpu worker {self.node_id}: announcement to "
+                      f"{self.coordinator_uri} failing ({n}x): {e!r}",
+                      file=sys.stderr, flush=True)
 
     def _loop(self) -> None:
         while not self._stop.wait(_ANNOUNCE_PERIOD_S):

@@ -314,9 +314,21 @@ class LocalExecutionPlanner:
                 return f.join_type != FULL
             return False
 
+        from ..ops.hash_join import JoinBuildOperatorFactory
+
         cut = 1
         while cut < len(factories) and prefix_safe(factories[cut]):
             cut += 1
+        if cut == len(factories) - 1 and \
+                isinstance(factories[-1], JoinBuildOperatorFactory):
+            # partitioned parallel hash build: the whole chain runs as n
+            # drivers, each with its OWN build accumulator; the last to
+            # finish merges and publishes the lookup source
+            # (PartitionedLookupSourceFactory, reference parallelism axis #5)
+            head.set_parallelism(n)
+            head.parallel_drivers = n
+            self.pipelines.append(factories)
+            return
         if cut >= len(factories) - 1:
             self.pipelines.append(factories)   # nothing stateful before sink
             return

@@ -262,5 +262,37 @@ order by ext_price desc, i_brand_id
 limit 100
 """
 
-QUERIES = {3: Q3, 7: Q7, 19: Q19, 25: Q25, 36: Q36, 42: Q42, 52: Q52,
-           55: Q55, 64: Q64, 72: Q72}
+Q21 = """
+select w_warehouse_name, i_item_id,
+       sum(case when d_date < date '1999-03-11'
+                then inv_quantity_on_hand else 0 end) as inv_before,
+       sum(case when d_date >= date '1999-03-11'
+                then inv_quantity_on_hand else 0 end) as inv_after
+from inventory, warehouse, item, date_dim
+where i_current_price between 0.99 and 1.49
+  and i_item_sk = inv_item_sk
+  and inv_warehouse_sk = w_warehouse_sk
+  and inv_date_sk = d_date_sk
+  and d_date between date '1999-02-10' and date '1999-04-10'
+group by w_warehouse_name, i_item_id
+order by w_warehouse_name, i_item_id
+limit 100
+"""
+
+Q82 = """
+select i_item_id, i_item_desc, i_current_price
+from item, inventory, date_dim, store_sales
+where i_current_price between 30 and 60
+  and inv_item_sk = i_item_sk
+  and d_date_sk = inv_date_sk
+  and d_date between date '1999-05-25' and date '1999-07-24'
+  and i_manufact_id in (129, 270, 821, 423, 500, 501, 502, 503)
+  and inv_quantity_on_hand between 100 and 500
+  and ss_item_sk = i_item_sk
+group by i_item_id, i_item_desc, i_current_price
+order by i_item_id
+limit 100
+"""
+
+QUERIES = {3: Q3, 7: Q7, 19: Q19, 21: Q21, 25: Q25, 36: Q36, 42: Q42,
+           52: Q52, 55: Q55, 64: Q64, 72: Q72, 82: Q82}

@@ -217,8 +217,13 @@ class JoinBuildOperator(Operator):
         if self._finishing:
             return
         super().finish()
-        self.f.lookup_factory.set(self._build(), self.context.worker)
-        self._pages = []  # consumed into the lookup source
+        # partitioned parallel build (PartitionedLookupSourceFactory
+        # analogue): N build drivers per worker ingest concurrently; the LAST
+        # one to finish merges every driver's collected pages and runs the
+        # single fused device build (one sort kernel over the union — on TPU
+        # the chip parallelizes the sort, so the drivers' job is overlapping
+        # host generation/upload/page prep, which is where build wall goes)
+        self.f._builder_done(self)
         self.context.revocable_memory.set_bytes(0)
 
     def _build(self) -> LookupSource:
@@ -405,9 +410,38 @@ class JoinBuildOperatorFactory(OperatorFactory):
         self.dense_min = dense_min
         self.dense_max = dense_max
         self.lookup_factory = LookupSourceFactory()
+        self._builders_lock = threading.Lock()
+        self._created = {}   # worker -> [JoinBuildOperator]
+        self._finished = {}  # worker -> count
 
     def create_operator(self, worker: int = 0) -> JoinBuildOperator:
-        return JoinBuildOperator(self.context(worker), self)
+        op = JoinBuildOperator(self.context(worker), self)
+        with self._builders_lock:
+            self._created.setdefault(worker, []).append(op)
+        return op
+
+    def _builder_done(self, op: JoinBuildOperator) -> None:
+        """Called by each build driver's finish(). The last finisher for the
+        worker merges every sibling's collected pages into its own state and
+        publishes the lookup source (drivers are all created before execution
+        starts, so the expected count is final before any finish)."""
+        w = op.context.worker
+        with self._builders_lock:
+            self._finished[w] = self._finished.get(w, 0) + 1
+            if self._finished[w] < len(self._created[w]):
+                return
+            siblings = [o for o in self._created[w] if o is not op]
+        for o in siblings:
+            op._pages.extend(o._pages)
+            op._host_pages.extend(o._host_pages)
+            op._null_key_pages.extend(o._null_key_pages)
+            if o._saw_null_key is not None:
+                op._saw_null_key = o._saw_null_key \
+                    if op._saw_null_key is None \
+                    else (op._saw_null_key | o._saw_null_key)
+            o._pages, o._host_pages, o._null_key_pages = [], [], []
+        self.lookup_factory.set(op._build(), w)
+        op._pages = []  # consumed into the lookup source
 
 
 # ---------------------------------------------------------------------------

@@ -73,7 +73,8 @@ class DistributedQueryRunner:
         planner = LogicalPlanner(self.metadata, self.session)
         plan = planner.plan(stmt)
         plan = optimize(plan, self.metadata, self.session)
-        plan = add_exchanges(plan, planner.symbols, self.metadata, self.session)
+        plan = add_exchanges(plan, planner.symbols, self.metadata, self.session,
+                             n_workers=self.mesh.n_workers)
         return fragment_plan(plan)
 
     def explain(self, sql: str) -> str:

@@ -299,6 +299,14 @@ def main(argv=None) -> None:
     else:
         session = Session(catalog="tpch", schema=args.schema)
     if args.cluster:
+        if authenticator is not None:
+            # workers announce over the same HTTP surface and carry no
+            # credentials; silently rejecting them would strand the cluster
+            # empty. Fail loudly until internal (worker) auth exists.
+            raise ValueError(
+                "PASSWORD authentication is not yet supported in cluster "
+                "mode: worker announcements cannot authenticate. Run the "
+                "coordinator behind an authenticating proxy instead.")
         from ..cluster import ClusterQueryRunner
         runner = ClusterQueryRunner(session=session, catalogs=catalogs,
                                     min_workers=args.min_workers)

@@ -453,12 +453,32 @@ class ExpressionTranslator:
         return SpecialForm(out_t, "COALESCE",
                            tuple(cast_to(p, out_t) for p in parts))
 
+    def _t_ArrayConstructor(self, e) -> RowExpression:
+        """ARRAY[e1..eK] -> Call("array", ArrayType(common)) — a PLAN-time
+        value only (unnest/cardinality lower it statically; see types.ArrayType)."""
+        from ..types import ArrayType
+
+        items = tuple(self.translate(i) for i in e.items)
+        if not items:
+            raise SemanticError("empty ARRAY[] requires an explicit cast")
+        elem = items[0].type
+        for it in items[1:]:
+            elem = common_type(elem, it.type)
+        return Call(ArrayType(elem), "array", items)
+
     def _t_FunctionCall(self, e: t.FunctionCall) -> RowExpression:
         name = e.name.lower()
         if name in AGGREGATE_NAMES:
             raise SemanticError(
                 f"aggregate {name}() must be planned through an Aggregation node")
         args = tuple(self.translate(a) for a in e.args)
+        if name == "cardinality":
+            # over the fixed-length constructor the length is a literal
+            if args and isinstance(args[0], Call) and args[0].name == "array":
+                return Constant(BIGINT, len(args[0].args))
+            raise SemanticError(
+                "cardinality() supports ARRAY[..] constructors (dynamic "
+                "arrays have no device representation)")
         if name in ("substr", "substring"):
             return Call(VARCHAR, "substr", args)
         if name == "abs":

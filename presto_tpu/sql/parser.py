@@ -42,7 +42,7 @@ KEYWORDS = {
     "set", "create", "table", "row", "unnest", "ordinality", "coalesce", "filter",
     "substring", "for", "count", "exists", "insert", "into", "drop",
     "over", "partition", "rows", "range", "unbounded", "preceding", "current",
-    "following", "grouping", "sets", "rollup", "cube",
+    "following", "grouping", "sets", "rollup", "cube", "array",
 }
 
 _TOKEN_RE = re.compile(r"""
@@ -51,7 +51,7 @@ _TOKEN_RE = re.compile(r"""
   | (?P<qident>"(?:[^"]|"")*")
   | (?P<string>'(?:[^']|'')*')
   | (?P<ident>[A-Za-z_][A-Za-z0-9_$]*)
-  | (?P<op><>|!=|>=|<=|\|\||[=<>+\-*/%(),.;])
+  | (?P<op><>|!=|>=|<=|\|\||[=<>+\-*/%(),.;\[\]])
 """, re.VERBOSE | re.DOTALL)
 
 
@@ -824,6 +824,18 @@ class _Parser:
                 items.append(self.parse_expr())
             self.expect_op(")")
             return t.Row(tuple(items))
+
+        if self.at_kw("array") and self.peek(1).kind == "op" and \
+                self.peek(1).text == "[":
+            self.next()  # array
+            self.next()  # [
+            items = []
+            if not self.at_op("]"):
+                items.append(self.parse_expr())
+                while self.accept_op(","):
+                    items.append(self.parse_expr())
+            self.expect_op("]")
+            return t.ArrayConstructor(tuple(items))
 
         if self.accept_op("("):
             if self.at_kw("select", "with"):

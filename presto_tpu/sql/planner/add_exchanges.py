@@ -42,10 +42,14 @@ def _hash_dist(keys) -> Tuple[str, Tuple[str, ...]]:
 class ExchangePlanner:
     """One instance per query (shares the logical planner's symbol allocator)."""
 
-    def __init__(self, symbols: SymbolAllocator, metadata=None, session=None):
+    def __init__(self, symbols: SymbolAllocator, metadata=None, session=None,
+                 n_workers: int = 8):
         self.symbols = symbols
         self.metadata = metadata
         self.session = session
+        # actual mesh width: the broadcast-vs-repartition cost comparison
+        # scales its network/memory terms with it
+        self.n_workers = n_workers
 
     # ------------------------------------------------ join distribution CBO
 
@@ -54,12 +58,14 @@ class ExchangePlanner:
             return "PARTITIONED"
         return str(self.session.get("join_distribution_type", "AUTOMATIC")).upper()
 
-    def _should_broadcast(self, build: PlanNode) -> bool:
-        """DetermineJoinDistributionType analogue: replicate the build side when
-        it is estimated small enough that shipping it to every worker is cheaper
-        than repartitioning the (large) probe side. PARTITIONED forces hash
-        repartition; BROADCAST forces replication; AUTOMATIC decides from
-        connector stats."""
+    def _should_broadcast(self, build: PlanNode,
+                          probe: Optional[PlanNode] = None) -> bool:
+        """DetermineJoinDistributionType analogue, decided BY COST: replicate
+        the build side when the broadcast's network+memory terms undercut
+        repartitioning both sides (cost.cheaper_to_broadcast), with the
+        session threshold acting as the per-worker HBM ceiling on replicated
+        builds. PARTITIONED forces hash repartition; BROADCAST forces
+        replication; AUTOMATIC decides from connector stats."""
         dist = self._distribution_type()
         if dist == "PARTITIONED":
             return False
@@ -67,9 +73,15 @@ class ExchangePlanner:
             return True
         if self.metadata is None or self.session is None:
             return False
+        from .cost import cheaper_to_broadcast
         from .optimizer import estimate_rows
-        threshold = int(self.session.get("broadcast_join_threshold_rows"))
-        return estimate_rows(build, self.metadata) <= threshold
+
+        build_rows = estimate_rows(build, self.metadata)
+        probe_rows = estimate_rows(probe, self.metadata) \
+            if probe is not None else build_rows * 8
+        limit = int(self.session.get("broadcast_join_threshold_rows"))
+        return cheaper_to_broadcast(probe_rows, build_rows, self.n_workers,
+                                    limit)
 
     def run(self, root: OutputNode) -> OutputNode:
         node, dist = self.visit(root.source)
@@ -183,7 +195,8 @@ class ExchangePlanner:
         # replicated build side as unmatched rows
         can_broadcast = node.type != "full"
         if not node.criteria or (can_broadcast and
-                                 self._should_broadcast(node.right)):
+                                 self._should_broadcast(node.right,
+                                                        probe=node.left)):
             right = ExchangeNode(right, BROADCAST, [])
             return (JoinNode(node.type, left, right, node.criteria,
                              node.residual, node.output_symbols), ldist)
@@ -203,7 +216,8 @@ class ExchangePlanner:
         # empties the result globally, so every worker needs the null bit);
         # otherwise broadcast is the CBO's call for small filtering sides.
         if (node.negated and node.null_aware) or \
-                self._should_broadcast(node.filtering_source):
+                self._should_broadcast(node.filtering_source,
+                                       probe=node.source):
             filt = ExchangeNode(filt, BROADCAST, [])
             return (SemiJoinNode(src, filt, node.source_key, node.filtering_key,
                                  node.mark, node.negated, node.null_aware,
@@ -286,5 +300,6 @@ class ExchangePlanner:
 
 
 def add_exchanges(root: OutputNode, symbols: SymbolAllocator,
-                  metadata=None, session=None) -> OutputNode:
-    return ExchangePlanner(symbols, metadata, session).run(root)
+                  metadata=None, session=None,
+                  n_workers: int = 8) -> OutputNode:
+    return ExchangePlanner(symbols, metadata, session, n_workers).run(root)

@@ -25,19 +25,27 @@ from .plan import (AggregationNode, EnforceSingleRowNode, FilterNode, JoinNode,
 FILTER_SELECTIVITY = 0.25
 SEMI_SELECTIVITY = 0.5
 
+_DISTINCT_CTR = itertools.count()
+
 
 def optimize(plan: PlanNode, metadata: MetadataManager,
              session: Session) -> PlanNode:
-    """PlanOptimizers.java pipeline (fixed order, two pushdown passes around the
-    join reorder exactly like the reference runs PredicatePushDown twice)."""
+    """PlanOptimizers.java pipeline: visitor passes (pushdown, cost-driven
+    join reorder, pruning) interleaved with the iterative rule engine
+    (iterative.py — the IterativeOptimizer.java analogue), mirroring how the
+    reference alternates visitor optimizers and rule batches."""
+    from .iterative import DEFAULT_RULES, IterativeOptimizer, RuleContext
+
+    rules = IterativeOptimizer(DEFAULT_RULES)
+    ctx = RuleContext(metadata, session)
     plan = implement_distinct_aggregations(plan)
     plan = push_down_predicates(plan)
     plan = reorder_joins(plan, metadata)
     plan = push_down_predicates(plan)
     plan = normalize_residuals(plan)
-    plan = fuse_topn(plan)
+    plan = rules.optimize(plan, ctx)   # limit/sort fusion, project merging, ...
     plan = prune_columns(plan)
-    plan = remove_identity_projects(plan)
+    plan = rules.optimize(plan, ctx)   # identity projects the pruner exposed
     return plan
 
 
@@ -348,10 +356,26 @@ def _greedy_join(relations: List[PlanNode], conjuncts: List[RowExpression],
             pending = [c for c in pending if c not in ready]
 
     apply_ready_filters()
+    # cost-driven next-join pick (ReorderJoins' cost comparator +
+    # CostCalculatorUsingExchanges terms, via cost.join_step_cost): each
+    # candidate is priced as one hash-join step — probe the current spine,
+    # build the candidate, emit the estimated output — and the cheapest
+    # joins next. Build memory weighs double (HBM is the TPU's wall).
+    from .cost import join_step_cost
+
+    spine_rows = sizes[spine_i]
     while remaining:
         connected = [i for i in remaining if equi_pairs_for(i)]
         pool = connected or list(remaining)
-        nxt = min(pool, key=lambda i: sizes[i])
+
+        def step_cost(i: int) -> float:
+            out_rows = max(spine_rows, sizes[i]) if equi_pairs_for(i) \
+                else spine_rows * sizes[i]
+            return join_step_cost(spine_rows, sizes[i], out_rows).total()
+
+        nxt = min(pool, key=step_cost)
+        spine_rows = max(spine_rows, sizes[nxt]) if equi_pairs_for(nxt) \
+            else spine_rows * sizes[nxt]
         pairs = equi_pairs_for(nxt)
         used = []
         for c in pending:
@@ -406,24 +430,6 @@ def normalize_residuals(plan: PlanNode) -> PlanNode:
 # TopN fusion (MergeLimitWithSort)
 # ---------------------------------------------------------------------------
 
-def fuse_topn(plan: PlanNode) -> PlanNode:
-    def visit(node):
-        if isinstance(node, LimitNode):
-            src = node.source
-            if isinstance(src, SortNode):
-                return TopNNode(src.source, node.count, src.orderings)
-            if isinstance(src, ProjectNode) and isinstance(src.source, SortNode):
-                inner = src.source
-                return ProjectNode(
-                    TopNNode(inner.source, node.count, inner.orderings),
-                    src.assignments)
-        return None
-    return rewrite_plan(plan, visit)
-
-
-# ---------------------------------------------------------------------------
-# column pruning (PruneUnreferencedOutputs)
-# ---------------------------------------------------------------------------
 
 def prune_columns(plan: PlanNode) -> PlanNode:
     if isinstance(plan, OutputNode):
@@ -520,16 +526,6 @@ def _prune(node: PlanNode, required: Set[str]) -> PlanNode:
 # ---------------------------------------------------------------------------
 # identity project removal
 # ---------------------------------------------------------------------------
-
-def remove_identity_projects(plan: PlanNode) -> PlanNode:
-    def visit(node):
-        if isinstance(node, ProjectNode) and node.is_identity():
-            return node.source
-        return None
-    return rewrite_plan(plan, visit)
-
-
-_DISTINCT_CTR = itertools.count()
 
 
 def implement_distinct_aggregations(plan: PlanNode) -> PlanNode:

@@ -99,7 +99,100 @@ class LogicalPlanner:
             return self.plan_values(rel)
         if isinstance(rel, t.SetOperation):
             return self.plan_set_operation(rel)
+        if isinstance(rel, t.Unnest):
+            return self.plan_unnest(rel, None)
         raise SemanticError(f"unsupported relation {type(rel).__name__}")
+
+    # --------------------------------------------------------------- UNNEST
+
+    def plan_unnest(self, rel: t.Unnest,
+                    source: Optional[RelationPlan]) -> RelationPlan:
+        """UNNEST over ARRAY[..] constructors, lowered STATICALLY (the TPU
+        re-design of operator/UnnestOperator.java): the constructor's length
+        is a plan-time constant, so
+
+          FROM UNNEST(ARRAY[c1..cK])            -> K-row ValuesNode
+          FROM src, UNNEST(ARRAY[e1..eK])       -> K-branch union of projects
+                                                   (each branch emits element i
+                                                   per source row)
+
+        No ragged array block ever reaches the device — element expressions
+        compile into the branches' projection kernels directly. Multiple
+        arrays zip with NULL padding; WITH ORDINALITY appends 1-based i."""
+        scope = source.scope if source is not None else Scope([])
+        tr = ExpressionTranslator(scope)
+        arrays = []
+        for e in rel.expressions:
+            ir = tr.translate(e)
+            if not (isinstance(ir, Call) and ir.name == "array"):
+                raise SemanticError(
+                    "UNNEST supports ARRAY[..] constructors (dynamic arrays "
+                    "have no device representation in this engine)")
+            arrays.append(ir)
+        K = max(len(a.args) for a in arrays)
+        elem_types = [a.type.element for a in arrays]
+
+        def element(a, i):
+            if i < len(a.args):
+                return a.args[i]
+            return Constant(UNKNOWN, None)  # shorter arrays pad with NULL
+
+        if source is None:
+            # element expressions must be literals (no row context exists)
+            rows = []
+            for i in range(K):
+                row = []
+                for a, et in zip(arrays, elem_types):
+                    v = element(a, i)
+                    if not isinstance(v, Constant):
+                        raise SemanticError(
+                            "standalone UNNEST requires literal array "
+                            "elements (join it to a relation otherwise)")
+                    val = v.value
+                    if isinstance(et, DecimalType) and val is not None and \
+                            isinstance(v.type, DecimalType):
+                        val = val * 10 ** (et.scale - v.type.scale)
+                    row.append(val)
+                if rel.with_ordinality:
+                    row.append(i + 1)
+                rows.append(row)
+            syms = [self.symbols.new_symbol(f"col{i}", et)
+                    for i, et in enumerate(elem_types)]
+            if rel.with_ordinality:
+                syms.append(self.symbols.new_symbol("ordinality", BIGINT))
+            fields = [Field(f"_col{i}", s, None) for i, s in enumerate(syms)]
+            return RelationPlan(ValuesNode(syms, rows), Scope(fields))
+
+        # lateral: cross-join the source ONCE to a K-row ordinality values
+        # relation, then select element i by ordinality per output row —
+        # the source subtree executes a single time (a K-branch union would
+        # re-run it K times), and every shape stays static
+        src_fields = source.scope.fields
+        ord_sym = self.symbols.new_symbol("unnest_ord", BIGINT)
+        values = ValuesNode([ord_sym], [[i + 1] for i in range(K)])
+        joined = JoinNode("inner", source.node, values, [], None)
+        ord_ref = symbol_ref(ord_sym.name, BIGINT)
+        assigns = [(f.symbol, symbol_ref(f.symbol.name, f.type))
+                   for f in src_fields]
+        fields = list(src_fields)
+        col_i = 0
+        for a, et in zip(arrays, elem_types):
+            expr: RowExpression = Constant(UNKNOWN, None)
+            for i in range(len(a.args) - 1, -1, -1):
+                cond = Call(BOOLEAN, "equal",
+                            (ord_ref, Constant(BIGINT, i + 1)))
+                expr = special("IF", et, cond, cast_to(element(a, i), et),
+                               expr)
+            s = self.symbols.new_symbol("unnest", et)
+            assigns.append((s, expr))
+            fields.append(Field(f"_col{col_i}", s, None))
+            col_i += 1
+        if rel.with_ordinality:
+            s = self.symbols.new_symbol("ordinality", BIGINT)
+            assigns.append((s, ord_ref))
+            fields.append(Field(f"_col{col_i}", s, None))
+        node = ProjectNode(joined, assigns)
+        return RelationPlan(node, Scope(fields))
 
     # ---------------------------------------------------------------- FROM
 
@@ -166,6 +259,26 @@ class LogicalPlanner:
         return RelationPlan(ValuesNode(syms, pyrows), Scope(fields))
 
     def plan_join(self, rel: t.Join) -> RelationPlan:
+        # lateral UNNEST on the right side: its array expressions may
+        # reference left columns, so it plans against the LEFT scope
+        right_rel = rel.right
+        alias, colnames = None, None
+        if isinstance(right_rel, t.AliasedRelation) and \
+                isinstance(right_rel.relation, t.Unnest):
+            alias, colnames = right_rel.alias, right_rel.column_names
+            right_rel = right_rel.relation
+        if isinstance(right_rel, t.Unnest):
+            left = self.plan_relation(rel.left)
+            plan = self.plan_unnest(right_rel, left)
+            nsrc = len(left.scope.fields)
+            fields = list(plan.scope.fields[:nsrc])
+            for i, f in enumerate(plan.scope.fields[nsrc:]):
+                name = colnames[i].lower() if colnames and i < len(colnames) \
+                    else f.name
+                fields.append(Field(name, f.symbol,
+                                    alias.lower() if alias else None))
+            return RelationPlan(plan.node, Scope(fields))
+
         left = self.plan_relation(rel.left)
         right = self.plan_relation(rel.right)
         scope = Scope(left.scope.fields + right.scope.fields)

@@ -417,3 +417,11 @@ class Insert(Statement):
 class DropTable(Statement):
     name: Tuple[str, ...] = ()
     exists_ok: bool = False
+
+
+@_dc
+class ArrayConstructor(Expression):
+    """ARRAY[e1, ..., eK] — fixed-length constructor (spi ArrayBlock's
+    constructor form; the engine lowers unnest/cardinality over it
+    statically, see sql/planner/planner.py plan_unnest)."""
+    items: Tuple[Expression, ...]

@@ -219,6 +219,30 @@ class CharType(VarcharType):
 
 
 @dataclasses.dataclass(frozen=True)
+class ArrayType(Type):
+    """ARRAY(element) — spi/type/ArrayType.java analogue.
+
+    TPU-first stance: variable-width array VALUES never materialize on device
+    (no ragged blocks); array expressions exist at PLAN time only, where
+    unnest/cardinality over the fixed-length ARRAY[..] constructor lower to
+    static unions/constants (sql/planner/planner.py). Dynamic arrays
+    (array_agg output) are future work and rejected at analysis."""
+
+    element: Type = None
+
+    def __post_init__(self):
+        object.__setattr__(self, "name", f"array({self.element.name})")
+
+    @property
+    def np_dtype(self) -> np.dtype:
+        raise NotImplementedError(
+            "array values have no device representation; unnest them")
+
+    def display_name(self) -> str:
+        return self.name
+
+
+@dataclasses.dataclass(frozen=True)
 class UnknownType(Type):
     """Type of NULL literals before coercion (spi/type/UnknownType analogue)."""
 

@@ -89,3 +89,47 @@ def test_full_join_stays_single_driver():
         "(select n_regionkey from nation where n_nationkey < 10) "
         "order by 1, 2")
     assert_rows_equal(r.execute(sql).rows, exp)
+
+
+def test_parallel_build_drivers_match_sequential():
+    # partitioned parallel hash build (PartitionedLookupSourceFactory
+    # analogue): N build drivers ingest concurrently, last finisher merges
+    # and publishes — results must match the single-driver build exactly
+    from presto_tpu.metadata import Session
+    from presto_tpu.runner import LocalQueryRunner
+
+    sql = ("select o_orderpriority, count(*) c, sum(l_quantity) q "
+           "from orders join lineitem on o_orderkey = l_orderkey "
+           "where o_orderdate < date '1996-01-01' "
+           "group by o_orderpriority order by o_orderpriority")
+    seq = LocalQueryRunner(session=Session(
+        catalog="tpch", schema="tiny",
+        properties={"driver_parallelism": 1})).execute(sql)
+    par = LocalQueryRunner(session=Session(
+        catalog="tpch", schema="tiny",
+        properties={"driver_parallelism": 4})).execute(sql)
+    assert par.rows == seq.rows
+
+
+def test_parallel_build_uses_multiple_drivers():
+    from presto_tpu.exec.local_planner import LocalExecutionPlanner
+    from presto_tpu.metadata import Session
+    from presto_tpu.ops.hash_join import JoinBuildOperatorFactory
+    from presto_tpu.runner import LocalQueryRunner
+
+    r = LocalQueryRunner(session=Session(
+        catalog="tpch", schema="tiny",
+        properties={"driver_parallelism": 4}))
+    plan = r.plan_sql("select count(*) from orders join lineitem "
+                      "on o_orderkey = l_orderkey")
+    lp = LocalExecutionPlanner(r.metadata, r.session)
+    lp.attach_memory(*r._query_memory())
+    ep = lp.plan(plan)
+    build_pipes = [p for p in ep.pipelines
+                   if isinstance(p[-1], JoinBuildOperatorFactory)]
+    assert build_pipes, "expected a build pipeline"
+    assert any(getattr(p[0], "parallel_drivers", 1) > 1 for p in build_pipes)
+    drivers = ep.create_drivers()
+    fac = next(p[-1] for p in build_pipes
+               if getattr(p[0], "parallel_drivers", 1) > 1)
+    assert len(fac._created[0]) > 1  # several build operators for worker 0

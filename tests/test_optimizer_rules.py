@@ -1,0 +1,80 @@
+"""Iterative optimizer rules + cost model (IterativeOptimizer.java and
+cost/CostCalculatorUsingExchanges analogues, via EXPLAIN shape assertions —
+the TestLogicalPlanner pattern)."""
+import pytest
+
+from presto_tpu.runner import LocalQueryRunner
+
+
+@pytest.fixture(scope="module")
+def runner():
+    return LocalQueryRunner()
+
+
+def explain(runner, sql):
+    return runner.explain(sql)
+
+
+def test_limit_sort_fuses_to_topn(runner):
+    txt = explain(runner, "select n_name from nation order by n_name limit 3")
+    assert "TopN" in txt and "Sort" not in txt
+
+
+def test_zero_limit_evaluates_to_empty(runner):
+    txt = explain(runner, "select n_name from nation limit 0")
+    assert "TableScan" not in txt and "Values" in txt
+    assert runner.execute("select n_name from nation limit 0").rows == []
+
+
+def test_trivial_filter_removed(runner):
+    txt = explain(runner, "select n_name from nation where 1 = 1")
+    assert "Filter" not in txt
+
+
+def test_false_filter_empties_plan(runner):
+    txt = explain(runner, "select n_name from nation where 1 = 2")
+    assert "TableScan" not in txt
+    assert runner.execute("select n_name from nation where 1 = 2").rows == []
+
+
+def test_adjacent_limits_merge(runner):
+    txt = explain(
+        runner, "select * from (select n_name from nation limit 10) limit 3")
+    assert txt.count("Limit") == 1 and "[3]" in txt
+
+
+def test_merged_limit_correct(runner):
+    rows = runner.execute(
+        "select * from (select n_nationkey from nation "
+        "order by n_nationkey limit 10) limit 3").rows
+    assert len(rows) == 3
+
+
+def test_cost_model_broadcast_decision():
+    from presto_tpu.sql.planner.cost import (broadcast_cost,
+                                             cheaper_to_broadcast,
+                                             join_step_cost,
+                                             repartition_cost)
+
+    # tiny build vs huge probe: replicate
+    assert cheaper_to_broadcast(6_000_000, 25, 8, 1_000_000)
+    # build comparable to probe: repartition
+    assert not cheaper_to_broadcast(6_000_000, 5_000_000, 8, 10_000_000)
+    # over the per-worker memory ceiling: never broadcast
+    assert not cheaper_to_broadcast(6_000_000, 2_000_000, 8, 1_000_000)
+    # cost arithmetic sanity
+    c = join_step_cost(100, 10, 100).plus(broadcast_cost(10, 8))
+    assert c.memory == 10 + 80 and c.network == 70
+    assert repartition_cost(100, 10).network == 110
+
+
+def test_q9_join_order_is_cost_driven(runner):
+    """The fact table must be the probe spine; the largest build (orders)
+    joins last so intermediate build memory stays minimal."""
+    import re
+
+    from presto_tpu.models.tpch_sql import QUERIES
+
+    scans = re.findall(r"TableScan tiny\.(\w+)", explain(runner, QUERIES[9]))
+    assert scans[0] == "lineitem"
+    assert scans[-1] == "orders"

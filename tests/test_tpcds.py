@@ -31,10 +31,18 @@ def oracle():
 
 def to_sqlite(sql: str) -> str:
     """Oracle dialect: dates are stored as days-since-epoch ints, so interval
-    day arithmetic becomes integer addition."""
+    day arithmetic becomes integer addition and date literals become ints."""
+    import datetime
     import re
-    return re.sub(r"([+-])\s*interval\s+'(\d+)'\s+day", r"\1 \2", sql,
-                  flags=re.I)
+
+    sql = re.sub(r"([+-])\s*interval\s+'(\d+)'\s+day", r"\1 \2", sql,
+                 flags=re.I)
+    return re.sub(
+        r"date\s+'(\d+)-(\d+)-(\d+)'",
+        lambda m: str((datetime.date(int(m.group(1)), int(m.group(2)),
+                                     int(m.group(3))) -
+                       datetime.date(1970, 1, 1)).days),
+        sql, flags=re.I)
 
 
 def check(runner, oracle, sql, ordered=False):
@@ -77,7 +85,7 @@ def test_q72(runner, oracle):
     assert len(res.rows) > 0, "Q72 returned no rows — data correlation too thin"
 
 
-@pytest.mark.parametrize("qid", [3, 7, 19, 25, 42, 52, 55])
+@pytest.mark.parametrize("qid", [3, 7, 19, 21, 25, 42, 52, 55, 82])
 def test_breadth_query(runner, oracle, qid):
     from presto_tpu.models.tpcds_sql import QUERIES
 

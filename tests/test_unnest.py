@@ -1,0 +1,80 @@
+"""ARRAY constructor + UNNEST end-to-end (operator/UnnestOperator.java and
+spi/type/ArrayType.java analogues — here lowered statically at plan time;
+see sql/planner/planner.py plan_unnest). Oracle = sqlite over equivalent
+UNION ALL formulations (sqlite has no unnest)."""
+import pytest
+
+from presto_tpu.runner import LocalQueryRunner
+from presto_tpu.utils.testing import SqliteOracle, assert_rows_equal
+
+
+@pytest.fixture(scope="module")
+def runner():
+    return LocalQueryRunner()
+
+
+@pytest.fixture(scope="module")
+def oracle():
+    o = SqliteOracle()
+    o.load_tpch(0.01, ["nation", "region"])
+    return o
+
+
+def check(runner, oracle, sql, oracle_sql, ordered=False):
+    got = runner.execute(sql).rows
+    exp = oracle.query(oracle_sql)
+    assert_rows_equal(got, exp, ordered=ordered)
+
+
+def test_standalone_unnest(runner, oracle):
+    check(runner, oracle,
+          "select x from unnest(array[1, 2, 3]) t(x) order by x",
+          "select 1 union all select 2 union all select 3 order by 1",
+          ordered=True)
+
+
+def test_unnest_with_ordinality(runner):
+    rows = runner.execute(
+        "select x, o from unnest(array[30, 10, 20]) "
+        "with ordinality t(x, o)").rows
+    assert sorted(rows) == [[10, 2], [20, 3], [30, 1]]
+
+
+def test_unnest_multiple_arrays_zip(runner):
+    rows = runner.execute(
+        "select a, b from unnest(array[1, 2, 3], array[10, 20]) t(a, b)").rows
+    assert sorted(rows, key=str) == sorted([[1, 10], [2, 20], [3, None]],
+                                           key=str)
+
+
+def test_cardinality_literal(runner):
+    assert runner.execute("select cardinality(array[5, 6, 7])").rows == [[3]]
+
+
+def test_unnest_over_table(runner, oracle):
+    sql = ("select n_name, x from nation, "
+           "unnest(array[n_nationkey, n_regionkey * 100]) t(x) "
+           "where n_regionkey = 1 order by n_name, x")
+    oracle_sql = (
+        "select n_name, x from ("
+        " select n_name, n_nationkey as x, n_regionkey from nation"
+        " union all"
+        " select n_name, n_regionkey * 100 as x, n_regionkey from nation"
+        ") where n_regionkey = 1 order by n_name, x")
+    check(runner, oracle, sql, oracle_sql, ordered=True)
+
+
+def test_unnest_feeds_aggregation(runner, oracle):
+    sql = ("select sum(x), count(*) from nation, "
+           "unnest(array[n_nationkey, n_regionkey]) t(x)")
+    oracle_sql = ("select sum(x), count(*) from ("
+                  " select n_nationkey as x from nation"
+                  " union all select n_regionkey from nation)")
+    check(runner, oracle, sql, oracle_sql)
+
+
+def test_unnest_in_subquery(runner):
+    rows = runner.execute(
+        "select count(*) from (select x from unnest(array[1,2,3,4]) t(x) "
+        "where x > 1)").rows
+    assert rows == [[3]]
