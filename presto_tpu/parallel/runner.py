@@ -157,9 +157,26 @@ class DistributedQueryRunner:
 # ---------------------------------------------------------------------------
 
 # observability for the multichip dryrun's "no host copies between fragments"
-# check: counts host->device uploads the exchange had to make (only the
-# zeros backfill for workers with no output pages in the resident path)
-EXCHANGE_STATS = {"host_uploads": 0, "exchanges": 0}
+# check: host_uploads counts PAGE DATA crossing host->device in the exchange
+# (must stay zero — fragment chains are device-resident); zero_backfills
+# counts constant all-zero shards for workers that produced nothing, which
+# are cached per (device, dtype, length) and uploaded at most once ever
+EXCHANGE_STATS = {"host_uploads": 0, "zero_backfills": 0, "exchanges": 0}
+
+_ZEROS_CACHE: dict = {}
+
+
+def _zeros_shard(dev, dtype, L: int):
+    """Cached all-zero device array (immutable, safely shared as a read-only
+    collective input)."""
+    import jax
+
+    key = (dev, np.dtype(dtype).str, L)
+    z = _ZEROS_CACHE.get(key)
+    if z is None:
+        EXCHANGE_STATS["zero_backfills"] += 1
+        z = _ZEROS_CACHE[key] = jax.device_put(np.zeros(L, dtype=dtype), dev)
+    return z
 
 # shape floor for exchange buffers: below this, padding is free but every
 # distinct capacity would compile (and cache) another XLA collective
@@ -192,6 +209,14 @@ def _worker_device_columns(pages: List[Page], types: Sequence[Type]):
     live_count). Eager jnp ops follow the pages' committed device, so a worker
     whose pipeline ran on mesh device w compacts on device w."""
     import jax.numpy as jnp
+
+    # host-sourced pages (numpy blocks — VALUES rows, or a regression that
+    # re-materialized exchange output host-side) are what the multichip
+    # dryrun's device-residency assertion exists to catch: count them
+    for p in pages:
+        if isinstance(p.mask, np.ndarray) or \
+                any(isinstance(b.data, np.ndarray) for b in p.blocks):
+            EXCHANGE_STATS["host_uploads"] += 1
 
     ncols = len(types)
     masks = [jnp.asarray(p.mask) for p in pages]
@@ -352,14 +377,12 @@ def run_exchange(mesh: MeshContext, kind: str, key_idx: Optional[List[int]],
     for w in range(W):
         dev = mesh.devices[w]
         if compacted[w] is None:
-            # no output on this worker: zero shards (the one host upload)
-            EXCHANGE_STATS["host_uploads"] += 1
-            shard_datas[w] = [
-                jax.device_put(np.zeros(L, dtype=types[c].np_dtype), dev)
-                for c in range(ncols)]
-            shard_nulls[w] = [jax.device_put(np.zeros(L, dtype=bool), dev)
+            # no output on this worker: cached constant zero shards
+            shard_datas[w] = [_zeros_shard(dev, types[c].np_dtype, L)
+                              for c in range(ncols)]
+            shard_nulls[w] = [_zeros_shard(dev, bool, L)
                               for _ in range(ncols)]
-            shard_masks[w] = jax.device_put(np.zeros(L, dtype=bool), dev)
+            shard_masks[w] = _zeros_shard(dev, bool, L)
             continue
         datas, nulls, mask, _ = compacted[w]
         out_d, out_n, out_m = compact(tuple(datas), tuple(nulls), mask, L)

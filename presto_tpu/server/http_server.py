@@ -138,6 +138,21 @@ class _Handler(BaseHTTPRequestHandler):
             })
         if self._authenticate() is None:
             return
+        if self.path.rstrip("/") in ("", "/ui"):
+            # cluster dashboard (the reference's webapp/ React SPA, served as
+            # one static page over the same /v1/cluster + /v1/query API)
+            import os
+
+            path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "webui.html")
+            with open(path, "rb") as f:
+                body = f.read()
+            self.send_response(200)
+            self.send_header("Content-Type", "text/html; charset=utf-8")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
         m = re.fullmatch(r"/v1/statement/([^/]+)/(\d+)", self.path)
         if m:
             info = self.manager.get(m.group(1))

@@ -204,3 +204,11 @@ def test_principal_mismatch_rejected(auth_server):
     with pytest.raises(urllib.error.HTTPError) as e:
         urllib.request.urlopen(req, timeout=30)
     assert e.value.code == 403
+
+
+def test_web_ui_served(base):
+    html = urllib.request.urlopen(f"{base}/ui", timeout=30).read().decode()
+    assert "presto-tpu" in html and "/v1/cluster" in html
+    # root also serves the dashboard (the reference redirects / to its UI)
+    root = urllib.request.urlopen(f"{base}/", timeout=30).read().decode()
+    assert "presto-tpu" in root
