@@ -6,8 +6,11 @@ files. PCOL reads are native-mmap scans with header-stats SPLIT PRUNING (the
 ORC stripe-skipping pattern) plus libpcol range pre-filters; PARQUET reads go
 through the engine's own reader (formats/parquet.py — the presto-parquet
 analogue) with one split per row group, pruned by row-group statistics.
-Writes (CTAS/INSERT) produce new immutable pcol files — one per writer sink,
-the classic append-only layout.
+Writes (CTAS/INSERT) produce new immutable files — one per writer sink, the
+classic append-only layout — in the connector's configured write format:
+PCOL (default, the native mmap format) or PARQUET via the engine's own
+writer (formats/parquet_writer.py), making parquet tables fully
+read-write when the catalog opts in (`file.format=parquet`).
 
 Dictionary handling: each table exposes ONE unioned dictionary per varchar
 column (built from all files' persisted dictionaries); per-file codes remap
@@ -54,9 +57,13 @@ class _TableInfo:
 
 
 class FileMetadata(ConnectorMetadata):
-    def __init__(self, connector_id: str, base_dir: str):
+    def __init__(self, connector_id: str, base_dir: str,
+                 write_format: str = "pcol"):
+        if write_format not in ("pcol", "parquet"):
+            raise ValueError(f"unknown file write format {write_format!r}")
         self.connector_id = connector_id
         self.base = base_dir
+        self.write_format = write_format
         self._cache: Dict[SchemaTableName, _TableInfo] = {}
         self._lock = threading.Lock()
 
@@ -103,7 +110,8 @@ class FileMetadata(ConnectorMetadata):
             if not all(f.endswith(".parquet") for f in files):
                 raise RuntimeError(
                     f"table {name} mixes parquet and pcol files — "
-                    f"unsupported (parquet tables are read-only)")
+                    f"unsupported (write every file through one catalog "
+                    f"with a consistent file.format)")
             return self._load_parquet(name, files, sig)
         headers = []
         rows = 0
@@ -221,14 +229,27 @@ class FileMetadata(ConnectorMetadata):
         dicts = [c.dictionary if c.dictionary is None or
                  hasattr(c.dictionary, "values") else Dictionary([])
                  for c in metadata.columns]
-        write_pcol(os.path.join(d, "00000000.pcol"), names, types, dicts, [])
+        if self.write_format == "parquet":
+            from ...formats.parquet_writer import write_parquet
+            write_parquet(os.path.join(d, "00000000.parquet"),
+                          names, types, dicts, [])
+        else:
+            write_pcol(os.path.join(d, "00000000.pcol"),
+                       names, types, dicts, [])
 
     def begin_insert(self, table: TableHandle):
         files = self._files_of(table.schema_table)
-        if any(f.endswith(".parquet") for f in files):
+        has_parquet = any(f.endswith(".parquet") for f in files)
+        if has_parquet and self.write_format != "parquet":
             raise RuntimeError(
-                f"table {table.schema_table} is parquet-backed and read-only "
-                f"(writes produce pcol files, which cannot mix with parquet)")
+                f"table {table.schema_table} is parquet-backed and this "
+                f"catalog writes pcol — formats cannot mix (set "
+                f"file.format=parquet in the catalog properties to write "
+                f"parquet tables)")
+        if not has_parquet and files and self.write_format == "parquet":
+            raise RuntimeError(
+                f"table {table.schema_table} is pcol-backed and this "
+                f"catalog writes parquet — formats cannot mix")
         return table
 
     def finish_insert(self, handle, fragments) -> None:
@@ -487,7 +508,8 @@ class FilePageSourceProvider(ConnectorPageSourceProvider):
 
 
 class FilePageSink(ConnectorPageSink):
-    """Buffers host pages; finish() writes ONE immutable pcol file."""
+    """Buffers host pages; finish() writes ONE immutable file in the
+    catalog's write format (pcol or parquet)."""
 
     def __init__(self, metadata: FileMetadata, table: TableHandle):
         self._metadata = metadata
@@ -510,8 +532,13 @@ class FilePageSink(ConnectorPageSink):
         types = [c.type for c in info.metadata.columns]
         dicts, pages = _materialize_dicts(self._pages)
         d = self._metadata._table_dir(self._table.schema_table)
-        path = os.path.join(d, f"{uuid.uuid4().hex[:12]}.pcol")
-        write_pcol(path, names, types, dicts, pages)
+        if self._metadata.write_format == "parquet":
+            from ...formats.parquet_writer import write_parquet
+            path = os.path.join(d, f"{uuid.uuid4().hex[:12]}.parquet")
+            write_parquet(path, names, types, dicts, pages)
+        else:
+            path = os.path.join(d, f"{uuid.uuid4().hex[:12]}.pcol")
+            write_pcol(path, names, types, dicts, pages)
         return [path]
 
 
@@ -558,9 +585,10 @@ class FilePageSinkProvider(ConnectorPageSinkProvider):
 
 
 class FileConnector(Connector):
-    def __init__(self, connector_id: str, base_dir: str):
+    def __init__(self, connector_id: str, base_dir: str,
+                 write_format: str = "pcol"):
         os.makedirs(base_dir, exist_ok=True)
-        self._metadata = FileMetadata(connector_id, base_dir)
+        self._metadata = FileMetadata(connector_id, base_dir, write_format)
         self._splits = FileSplitManager(connector_id, self._metadata)
         self._sources = FilePageSourceProvider(self._metadata)
         self._sinks = FilePageSinkProvider(self._metadata)

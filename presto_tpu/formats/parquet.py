@@ -34,8 +34,8 @@ from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple
 import numpy as np
 
 from ..block import Dictionary
-from ..types import (BIGINT, BOOLEAN, DATE, DOUBLE, INTEGER, REAL, Type,
-                     VARCHAR, DecimalType)
+from ..types import (BIGINT, BOOLEAN, DATE, DOUBLE, INTEGER, REAL, SMALLINT,
+                     TIMESTAMP, Type, VARCHAR, DecimalType)
 
 MAGIC = b"PAR1"
 
@@ -50,6 +50,7 @@ E_DELTA_BINARY_PACKED, E_DELTA_LENGTH_BA, E_DELTA_BA = 5, 6, 7
 E_RLE_DICTIONARY = 8
 # parquet::ConvertedType (subset)
 CT_UTF8, CT_DECIMAL, CT_DATE = 0, 5, 6
+CT_TIMESTAMP_MILLIS, CT_INT_16 = 9, 16
 # parquet::PageType
 PT_DATA, PT_INDEX, PT_DICTIONARY, PT_DATA_V2 = 0, 1, 2, 3
 
@@ -712,11 +713,13 @@ def _engine_type(elem: SchemaElement) -> Type:
     if elem.ptype == T_BOOLEAN:
         return BOOLEAN
     if elem.ptype == T_INT32:
-        return DATE if ct == CT_DATE else INTEGER
+        if ct == CT_DATE:
+            return DATE
+        return SMALLINT if ct == CT_INT_16 else INTEGER
     if elem.ptype == T_INT64:
         if ct == CT_DECIMAL:
             return DecimalType(elem.precision, elem.scale)
-        return BIGINT
+        return TIMESTAMP if ct == CT_TIMESTAMP_MILLIS else BIGINT
     if elem.ptype == T_FLOAT:
         return REAL
     if elem.ptype == T_DOUBLE:

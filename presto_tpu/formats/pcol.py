@@ -29,14 +29,47 @@ import numpy as np
 
 from ..block import Block, Dictionary, Page
 from ..types import (BIGINT, BOOLEAN, DATE, DecimalType, DOUBLE, INTEGER,
-                     Type, VARCHAR, WIDE_VARCHAR)
+                     REAL, SMALLINT, TIMESTAMP, Type, VARCHAR, WIDE_VARCHAR)
 
 MAGIC = b"PCOL1\n"
 _ALIGN = 64
 
-_TYPE_TAGS = {"bigint": BIGINT, "integer": INTEGER, "double": DOUBLE,
-              "boolean": BOOLEAN, "date": DATE, "varchar": VARCHAR,
+_TYPE_TAGS = {"bigint": BIGINT, "integer": INTEGER, "smallint": SMALLINT,
+              "double": DOUBLE, "real": REAL, "boolean": BOOLEAN,
+              "date": DATE, "timestamp": TIMESTAMP, "varchar": VARCHAR,
               "wide_varchar": WIDE_VARCHAR}
+
+
+def compact_pages(names: Sequence[str], types: Sequence[Type],
+                  pages: Sequence[Page]
+                  ) -> Tuple[int, List[Tuple[np.ndarray,
+                                             Optional[np.ndarray]]]]:
+    """Compact live rows (page mask) into one contiguous array per column.
+
+    The shared preamble of every columnar file writer (pcol and parquet):
+    -> (total_rows, [(data astype the engine dtype, bool null mask or None)]).
+    Null masks are returned only when at least one null survives compaction.
+    """
+    masks = [np.asarray(p.mask) for p in pages]
+    keeps = [np.flatnonzero(m) for m in masks]
+    total = int(sum(len(k) for k in keeps))
+    cols: List[Tuple[np.ndarray, Optional[np.ndarray]]] = []
+    for c in range(len(names)):
+        datas = [np.asarray(p.blocks[c].data)[k]
+                 for p, k in zip(pages, keeps)]
+        data = np.concatenate(datas) if datas else \
+            np.zeros(0, dtype=types[c].np_dtype)
+        data = np.ascontiguousarray(data.astype(types[c].np_dtype,
+                                                copy=False))
+        nulls = None
+        if any(p.blocks[c].nulls is not None for p in pages):
+            nparts = [np.asarray(p.blocks[c].null_mask())[k]
+                      for p, k in zip(pages, keeps)]
+            nm = np.concatenate(nparts) if nparts else np.zeros(0, dtype=bool)
+            if nm.any():
+                nulls = nm
+        cols.append((data, nulls))
+    return total, cols
 
 
 def _type_tag(t: Type) -> Tuple[str, int]:
@@ -92,26 +125,11 @@ def write_pcol(path: str, names: Sequence[str], types: Sequence[Type],
                pages: Sequence[Page]) -> int:
     """Write pages (live rows compacted) as one pcol file; returns rows."""
     ncols = len(names)
-    masks = [np.asarray(p.mask) for p in pages]
-    keeps = [np.flatnonzero(m) for m in masks]
-    total = int(sum(len(k) for k in keeps))
-
-    cols = []
-    for c in range(ncols):
-        datas = [np.asarray(p.blocks[c].data)[k]
-                 for p, k in zip(pages, keeps)]
-        data = np.concatenate(datas) if datas else \
-            np.zeros(0, dtype=types[c].np_dtype)
-        data = np.ascontiguousarray(data.astype(types[c].np_dtype,
-                                                copy=False))
-        nulls = None
-        if any(p.blocks[c].nulls is not None for p in pages):
-            nparts = [np.asarray(p.blocks[c].null_mask())[k]
-                      for p, k in zip(pages, keeps)]
-            nm = np.concatenate(nparts)
-            if nm.any():
-                nulls = np.ascontiguousarray(nm.astype(np.uint8))
-        cols.append((data, nulls))
+    total, compacted = compact_pages(names, types, pages)
+    cols = [(data,
+             None if nulls is None
+             else np.ascontiguousarray(nulls.astype(np.uint8)))
+            for data, nulls in compacted]
 
     # header with chunk offsets (two passes: size then write)
     headers = []

@@ -45,7 +45,8 @@ def _file_factory(catalog: str, config: Dict[str, str]):
     base = config.get("file.base-dir")
     if not base:
         raise ValueError(f"catalog {catalog}: file.base-dir is required")
-    return FileConnector(catalog, base)
+    return FileConnector(catalog, base,
+                         write_format=config.get("file.format", "pcol"))
 
 
 def _memory_factory(catalog: str, config: Dict[str, str]):

@@ -17,7 +17,8 @@ from typing import Dict, List, Optional, Sequence, Tuple
 from ..ops.expressions import (Call, Constant, RowExpression, SpecialForm, SymbolRef,
                                arithmetic_result_type, days_from_civil, special,
                                symbol_ref)
-from ..types import (BIGINT, BOOLEAN, DATE, DOUBLE, INTEGER, REAL, TIMESTAMP, Type,
+from ..types import (BIGINT, BOOLEAN, DATE, DOUBLE, INTEGER, REAL, SMALLINT,
+                     TIMESTAMP, Type,
                      UNKNOWN, VARCHAR, DecimalType, is_floating, is_integral,
                      is_numeric, is_string)
 from . import tree as t
@@ -107,6 +108,8 @@ def type_from_name(tn: t.TypeName) -> Type:
         return BIGINT
     if name in ("integer", "int"):
         return INTEGER
+    if name == "smallint":
+        return SMALLINT
     if name in ("double", "float64"):
         return DOUBLE
     if name == "real":
