@@ -36,6 +36,7 @@ from ..types import BIGINT, BOOLEAN, Type, is_string
 from ..utils import kernel_cache
 from .aggregates import MAX, MIN, SUM, AggregateCall
 from .operator import Operator, OperatorContext, OperatorFactory, timed
+from .sorting import lexsort_fast
 
 
 def _builder_key(tag: str, b, page: "Page" = None) -> tuple:
@@ -182,7 +183,7 @@ def sort_group_reduce(keys: Tuple[jnp.ndarray, ...], mask: jnp.ndarray,
     n = mask.shape[0]
     widths = widths or (1,) * len(kinds)
     invalid = ~mask
-    order = jnp.lexsort(tuple(reversed(keys)) + (invalid,))
+    order = lexsort_fast(tuple(reversed(keys)) + (invalid,))
     sk = tuple(k[order] for k in keys)
     sv = mask[order]
     sc = tuple((c[0][order], c[1][order]) if isinstance(c, tuple) else c[order]

@@ -24,6 +24,7 @@ import numpy as np
 from ..block import Block, Dictionary, Page
 from ..types import Type, is_string
 from .operator import Operator, OperatorContext, OperatorFactory, timed
+from .sorting import lexsort_fast
 
 
 @dataclasses.dataclass(frozen=True)
@@ -79,7 +80,7 @@ def _topn_merge(page: Page, buffer: Optional[Page], orders: Tuple[SortOrder, ...
     else:
         merged = page
     keys = _sort_key_arrays(merged, orders) + (~merged.mask,)
-    order = jnp.lexsort(keys)
+    order = lexsort_fast(keys)
     top = order[:n]
     blocks = []
     for b in merged.blocks:
@@ -180,7 +181,7 @@ class OrderByOperator(Operator):
         mask = jnp.concatenate([p.mask for p in self._pages])
         merged = Page(tuple(merged_blocks), mask)
         keys = _sort_key_arrays(merged, self.orders) + (~merged.mask,)
-        order = jnp.lexsort(keys)
+        order = lexsort_fast(keys)
         blocks = []
         for b in merged.blocks:
             nulls = b.nulls[order] if b.nulls is not None else None

@@ -34,6 +34,7 @@ import numpy as np
 from ..block import Block, Dictionary, Page
 from ..types import Type
 from .operator import Operator, OperatorContext, OperatorFactory, timed
+from .sorting import lexsort_fast
 
 
 def _seg_scan(op: str, values: jnp.ndarray, new_seg: jnp.ndarray) -> jnp.ndarray:
@@ -65,7 +66,7 @@ def _window_kernel(keys, args_and_nulls, mask, calls, n_keys, n_ord):
     (values, null_mask_or_None) per call, in ORIGINAL row order."""
     n = mask.shape[0]
     sort_cols = tuple(reversed(keys)) + (~mask,)  # dead rows sort last
-    order = jnp.lexsort(sort_cols)
+    order = lexsort_fast(sort_cols)
     inv = jnp.zeros(n, dtype=jnp.int32).at[order].set(
         jnp.arange(n, dtype=jnp.int32))
     sm = mask[order]
