@@ -32,19 +32,26 @@ class StatementClient:
 
     def __init__(self, server: str, sql: str, poll_interval_s: float = 0.05,
                  timeout_s: float = 3600.0, user: Optional[str] = None,
-                 password: Optional[str] = None):
+                 password: Optional[str] = None,
+                 catalog: Optional[str] = None, schema: Optional[str] = None):
         self.server = server.rstrip("/")
         self.sql = sql
         self.poll_interval_s = poll_interval_s
         self.timeout_s = timeout_s
         self.user = user
         self.password = password
+        self.catalog = catalog
+        self.schema = schema
         self.columns: Optional[List[Column]] = None
         self.stats: dict = {}
 
     def _request(self, method: str, url: str, body: Optional[bytes] = None) -> dict:
         req = urllib.request.Request(url, data=body, method=method)
         req.add_header("Content-Type", "text/plain")
+        if self.catalog:
+            req.add_header("X-Presto-Catalog", self.catalog)
+        if self.schema:
+            req.add_header("X-Presto-Schema", self.schema)
         if self.password is not None:
             import base64
 

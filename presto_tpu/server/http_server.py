@@ -125,7 +125,9 @@ class _Handler(BaseHTTPRequestHandler):
                 {"error": {"message": "empty statement"}}, status=400)
         info = self.manager.submit(
             sql, user=user,
-            source=self.headers.get("X-Presto-Source", ""))
+            source=self.headers.get("X-Presto-Source", ""),
+            catalog=self.headers.get("X-Presto-Catalog", ""),
+            schema=self.headers.get("X-Presto-Schema", ""))
         self._send_json(self.manager.results_payload(info, 0, self._base_uri()))
 
     def do_GET(self) -> None:  # noqa: N802

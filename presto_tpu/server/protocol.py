@@ -45,6 +45,8 @@ class QueryInfo:
     row_count: int = 0
     user: str = ""
     source: str = ""
+    catalog: str = ""    # per-query default-catalog override (JDBC/DBAPI)
+    schema: str = ""
 
     def done(self) -> bool:
         return self.state in _DONE
@@ -84,10 +86,12 @@ class QueryManager:
 
     # ----------------------------------------------------------------- api
 
-    def submit(self, sql: str, user: str = "", source: str = "") -> QueryInfo:
+    def submit(self, sql: str, user: str = "", source: str = "",
+               catalog: str = "", schema: str = "") -> QueryInfo:
         with self._lock:
             qid = f"q{next(self._ids)}_{int(time.time())}"
-            info = QueryInfo(qid, sql, user=user, source=source)
+            info = QueryInfo(qid, sql, user=user, source=source,
+                             catalog=catalog, schema=schema)
             self._queries[qid] = info
             self._expire_locked()
         if self.monitor is not None:
@@ -124,6 +128,22 @@ class QueryManager:
     def list_queries(self) -> List[QueryInfo]:
         return list(self._queries.values())
 
+    def _scoped_runner(self, info: QueryInfo):
+        """Shallow-copy the engine with the query's catalog/schema defaults
+        (the X-Presto-Catalog/Schema headers a JDBC/DBAPI client sends).
+        Kernel caches are process-global, so scoped copies cost nothing."""
+        if not (info.catalog or info.schema):
+            return self.runner
+        import copy
+        import dataclasses as _dc
+
+        runner = copy.copy(self.runner)
+        runner.session = _dc.replace(
+            runner.session,
+            catalog=info.catalog or runner.session.catalog,
+            schema=info.schema or runner.session.schema)
+        return runner
+
     # ------------------------------------------------------------- execute
 
     def _run(self, info: QueryInfo) -> None:
@@ -154,10 +174,11 @@ class QueryManager:
                 # qualified cross-catalog writes included
                 for cat in self.transactions.catalog_names():
                     self.transactions.join(tx, cat)
+            runner = self._scoped_runner(info)
             if self._execute_takes_user:
-                result = self.runner.execute(info.sql, user=info.user)
+                result = runner.execute(info.sql, user=info.user)
             else:
-                result = self.runner.execute(info.sql)
+                result = runner.execute(info.sql)
             rows = [self._to_json_row(r) for r in result.rows]
             if tx is not None:
                 self.transactions.commit(tx)

@@ -1,0 +1,127 @@
+"""DB-API 2.0 driver (client/dbapi.py): PEP 249 surface over a live server.
+
+Reference analogue: presto-jdbc (PrestoDriver/PrestoConnection/
+PrestoPreparedStatement over StatementClientV1) — DB-API is Python's JDBC."""
+import pytest
+
+import presto_tpu.client.dbapi as dbapi
+from presto_tpu.metadata import Session
+from presto_tpu.runner import LocalQueryRunner
+from presto_tpu.server import PrestoTpuServer
+
+
+@pytest.fixture(scope="module")
+def server():
+    runner = LocalQueryRunner(session=Session(catalog="tpch", schema="tiny"))
+    srv = PrestoTpuServer(runner, port=0, page_rows=7)
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+@pytest.fixture()
+def conn(server):
+    with dbapi.connect(host="localhost", port=server.port, user="alice") as c:
+        yield c
+
+
+def test_module_globals():
+    assert dbapi.apilevel == "2.0"
+    assert dbapi.paramstyle == "qmark"
+    assert issubclass(dbapi.ProgrammingError, dbapi.DatabaseError)
+    assert issubclass(dbapi.DatabaseError, dbapi.Error)
+
+
+def test_fetchall_and_description(conn):
+    cur = conn.cursor()
+    cur.execute("select n_nationkey, n_name from nation "
+                "where n_nationkey < 3 order by n_nationkey")
+    assert [d[0] for d in cur.description] == ["n_nationkey", "n_name"]
+    # PEP 249 type-object protocol: singletons compare against type codes
+    assert dbapi.NUMBER == cur.description[0][1]
+    assert dbapi.STRING == cur.description[1][1]
+    assert not (dbapi.DATETIME == cur.description[0][1])
+    rows = cur.fetchall()
+    assert len(rows) == 3 and rows[0][0] == 0
+    assert cur.rowcount == 3
+    assert all(isinstance(r, tuple) for r in rows)
+
+
+def test_fetchone_fetchmany_iteration(conn):
+    cur = conn.cursor()
+    cur.execute("select n_nationkey from nation order by n_nationkey")
+    assert cur.fetchone() == (0,)
+    assert cur.fetchmany(3) == [(1,), (2,), (3,)]
+    rest = list(cur)
+    assert rest[0] == (4,) and len(rest) == 21
+    assert cur.fetchone() is None
+
+
+def test_qmark_parameters(conn):
+    cur = conn.cursor()
+    cur.execute("select n_name from nation where n_nationkey = ? "
+                "or n_name = ?", (7, "CANADA"))
+    got = sorted(r[0] for r in cur.fetchall())
+    assert got == ["CANADA", "GERMANY"]
+
+
+def test_parameter_rendering_edge_cases():
+    sub = dbapi.substitute_params
+    assert sub("select ?", (None,)) == "select NULL"
+    assert sub("select ?", (True,)) == "select true"
+    assert sub("select ?", ("it's",)) == "select 'it''s'"
+    # placeholders inside string literals / comments are NOT substituted
+    assert sub("select '?' , ?", (1,)) == "select '?' , 1"
+    assert sub("select 1 -- ?\n, ?", (2,)) == "select 1 -- ?\n, 2"
+    assert sub("select /* ? */ 1, ?", (2,)) == "select /* ? */ 1, 2"
+    import datetime
+    assert sub("select ?", (datetime.date(1995, 6, 17),)) == \
+        "select date '1995-06-17'"
+    assert sub("select ?", (datetime.datetime(2020, 1, 1, 0, 0, 0, 500000),)) \
+        == "select timestamp '2020-01-01 00:00:00.500000'"
+    assert sub("select ?", (datetime.time(12, 30, 5),)) == \
+        "select time '12:30:05'"
+    with pytest.raises(dbapi.ProgrammingError):
+        sub("select ?, ?", (1,))
+    with pytest.raises(dbapi.ProgrammingError):
+        sub("select ?", (1, 2))
+
+
+def test_query_error_maps_to_programming_error(conn):
+    cur = conn.cursor()
+    with pytest.raises(dbapi.ProgrammingError):
+        cur.execute("select definitely_not_a_column from nation")
+        cur.fetchall()
+
+
+def test_closed_state_checks(conn):
+    cur = conn.cursor()
+    with pytest.raises(dbapi.ProgrammingError):
+        cur.fetchall()  # nothing executed
+    cur.close()
+    with pytest.raises(dbapi.InterfaceError):
+        cur.execute("select 1")
+    c2 = dbapi.connect(host="localhost", port=1)
+    c2.close()
+    with pytest.raises(dbapi.InterfaceError):
+        c2.cursor()
+
+
+def test_rollback_not_supported(conn):
+    with pytest.raises(dbapi.NotSupportedError):
+        conn.rollback()
+    conn.commit()  # autocommit no-op
+
+
+def test_catalog_schema_scoping(server):
+    # connection-level schema: unqualified table names resolve through it
+    conn = dbapi.connect(host="localhost", port=server.port,
+                         catalog="tpch", schema="tiny")
+    cur = conn.cursor()
+    cur.execute("select count(*) from region")
+    assert cur.fetchall() == [(5,)]
+    # a bogus schema must fail, proving the header actually scopes the query
+    bad = dbapi.connect(host="localhost", port=server.port,
+                        catalog="tpch", schema="no_such_schema")
+    with pytest.raises(dbapi.Error):
+        bad.cursor().execute("select count(*) from region").fetchall()
