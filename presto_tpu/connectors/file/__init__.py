@@ -1,11 +1,13 @@
-"""File connector: directory-backed tables in PCOL or PARQUET.
+"""File connector: directory-backed tables in PCOL, PARQUET or ORC.
 
 The engine's presto-hive analogue, radically narrowed: a catalog roots at a
-directory, `<base>/<schema>/<table>/*.pcol` (or `*.parquet`) are the table's
-files. PCOL reads are native-mmap scans with header-stats SPLIT PRUNING (the
-ORC stripe-skipping pattern) plus libpcol range pre-filters; PARQUET reads go
-through the engine's own reader (formats/parquet.py — the presto-parquet
-analogue) with one split per row group, pruned by row-group statistics.
+directory, `<base>/<schema>/<table>/*.pcol` (or `*.parquet` / `*.orc`) are
+the table's files. PCOL reads are native-mmap scans with header-stats SPLIT
+PRUNING (the ORC stripe-skipping pattern) plus libpcol range pre-filters;
+PARQUET and ORC reads go through the engine's own readers
+(formats/parquet.py, formats/orc.py — the presto-parquet / presto-orc
+analogues) with one split per row group / stripe, pruned by chunk
+statistics. ORC is ingest-only; parquet is read-write.
 Writes (CTAS/INSERT) produce new immutable files — one per writer sink, the
 classic append-only layout — in the connector's configured write format:
 PCOL (default, the native mmap format) or PARQUET via the engine's own
@@ -45,6 +47,36 @@ from ...spi.connector import (ColumnHandle, ColumnMetadata, ColumnStatistics,
 # plan-time bound on a varchar column's materialized distinct-value set
 # (the PLAIN-encoded parquet fallback decodes whole columns to build it)
 MAX_VARCHAR_DICTIONARY = 1 << 21
+
+
+class _ExternalFile:
+    """Uniform chunked view over the two external formats: parquet files
+    read per ROW GROUP, ORC files per STRIPE. Each chunk becomes one split,
+    pruned by that chunk's column statistics (the OrcPredicate pattern)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        if path.endswith(".orc"):
+            from ...formats.orc import OrcFile
+            self._f = OrcFile(path)
+            self.n_chunks = self._f.n_stripes
+            self.chunk_rows = self._f.stripe_rows
+            self.read_chunk = self._f.read_stripe
+            self.chunk_stats = self._f.stripe_col_stats
+        else:
+            self._f = ParquetFile(path)
+            self.n_chunks = self._f.n_row_groups
+            self.chunk_rows = self._f.row_group_rows
+            self.read_chunk = self._f.read_row_group
+            self.chunk_stats = self._f.row_group_stats
+        self.num_rows = self._f.num_rows
+        self.schema = self._f.schema
+
+    def column_distinct_strings(self, name: str):
+        return self._f.column_distinct_strings(name)
+
+    def close(self):
+        self._f.close()
 
 
 class _TableInfo:
@@ -94,7 +126,7 @@ class FileMetadata(ConnectorMetadata):
         if not os.path.isdir(d):
             return []
         return sorted(os.path.join(d, f) for f in os.listdir(d)
-                      if f.endswith(".pcol") or f.endswith(".parquet"))
+                      if f.endswith((".pcol", ".parquet", ".orc")))
 
     def _load(self, name: SchemaTableName) -> Optional[_TableInfo]:
         files = self._files_of(name)
@@ -105,14 +137,14 @@ class FileMetadata(ConnectorMetadata):
             cached = self._cache.get(name)
             if cached is not None and cached.signature == sig:
                 return cached
-        has_parquet = any(f.endswith(".parquet") for f in files)
-        if has_parquet:
-            if not all(f.endswith(".parquet") for f in files):
-                raise RuntimeError(
-                    f"table {name} mixes parquet and pcol files — "
-                    f"unsupported (write every file through one catalog "
-                    f"with a consistent file.format)")
-            return self._load_parquet(name, files, sig)
+        exts = {f.rsplit(".", 1)[-1] for f in files}
+        if len(exts) > 1:
+            raise RuntimeError(
+                f"table {name} mixes {'/'.join(sorted(exts))} files — "
+                f"unsupported (write every file through one catalog "
+                f"with a consistent file.format)")
+        if exts in ({"parquet"}, {"orc"}):
+            return self._load_external(name, files, sig)
         headers = []
         rows = 0
         for f in files:
@@ -144,37 +176,38 @@ class FileMetadata(ConnectorMetadata):
             self._cache[name] = info
         return info
 
-    def _load_parquet(self, name: SchemaTableName, files: List[str],
+    def _load_external(self, name: SchemaTableName, files: List[str],
                       sig) -> _TableInfo:
-        """Schema from the first parquet file. Varchar columns get ONE
-        table-wide SORTED Dictionary built at load by decoding every file's
-        string values once (dictionary-encoded parquet pages make this a
-        near-metadata read) — plan-time string predicates need the complete
-        code space (reference: hive table dictionaries from ORC metadata)."""
+        """Parquet/ORC tables: schema from the first file. Varchar columns
+        get ONE table-wide SORTED Dictionary built at load by decoding every
+        file's string values once (dictionary-encoded pages/streams make
+        this a near-metadata read) — plan-time string predicates need the
+        complete code space (reference: hive table dictionaries from ORC
+        metadata)."""
         rows = 0
         schema = None
         string_values: Dict[str, set] = {}
         for f in files:
-            pf = ParquetFile(f)
+            pf = _ExternalFile(f)
             if schema is None:
                 schema = pf.schema
             rows += pf.num_rows
             str_cols = [n for n, t in pf.schema if is_string(t)]
             for n in str_cols:
                 vals_set = string_values.setdefault(n, set())
-                # cheap path: union the files' own dictionary pages
+                # cheap path: union the files' own dictionary pages/streams
                 distinct = pf.column_distinct_strings(n)
                 if distinct is not None:
                     vals_set.update(distinct)
                     continue
-                # PLAIN-encoded fallback: decode the column once, with a hard
-                # cardinality bound — an unbounded high-cardinality column
-                # would materialize every distinct string in memory at PLAN
-                # time; fail with a clear message instead of an OOM
-                for gi in range(pf.n_row_groups):
-                    if pf.row_group_rows(gi) == 0:
+                # direct-encoded fallback: decode the column once, with a
+                # hard cardinality bound — an unbounded high-cardinality
+                # column would materialize every distinct string in memory
+                # at PLAN time; fail with a clear message instead of an OOM
+                for gi in range(pf.n_chunks):
+                    if pf.chunk_rows(gi) == 0:
                         continue
-                    vals, nulls = pf.read_row_group(gi, [n])[n]
+                    vals, nulls = pf.read_chunk(gi, [n])[n]
                     if nulls is not None:
                         vals = vals[~nulls]
                     vals_set.update(np.unique(vals.astype(str)).tolist())
@@ -182,7 +215,7 @@ class FileMetadata(ConnectorMetadata):
                         raise ValueError(
                             f"varchar column {n!r} of {name} exceeds "
                             f"{MAX_VARCHAR_DICTIONARY} distinct values; "
-                            "re-encode the parquet files with dictionary "
+                            "re-encode the files with dictionary "
                             "encoding (or drop the column from the table)")
             pf.close()
         cols = tuple(
@@ -239,6 +272,10 @@ class FileMetadata(ConnectorMetadata):
 
     def begin_insert(self, table: TableHandle):
         files = self._files_of(table.schema_table)
+        if any(f.endswith(".orc") for f in files):
+            raise RuntimeError(
+                f"table {table.schema_table} is ORC-backed and read-only "
+                f"(the engine writes pcol or parquet; ORC is ingest-only)")
         has_parquet = any(f.endswith(".parquet") for f in files)
         if has_parquet and self.write_format != "parquet":
             raise RuntimeError(
@@ -279,8 +316,8 @@ class FileSplitManager(ConnectorSplitManager):
     def get_splits(self, table: TableHandle, constraint: Constraint,
                    desired_splits: int) -> List[Split]:
         info = self._metadata.table_info(table)
-        if info.files and info.files[0].endswith(".parquet"):
-            return self._parquet_splits(table, info, constraint)
+        if info.files and info.files[0].endswith((".parquet", ".orc")):
+            return self._external_splits(table, info, constraint)
         splits = []
         for b, f in enumerate(info.files):
             pf = PcolFile(f)
@@ -304,21 +341,22 @@ class FileSplitManager(ConnectorSplitManager):
                                     bucket=b))
         return splits  # [] = every file pruned: the scan yields no pages
 
-    def _parquet_splits(self, table: TableHandle, info: _TableInfo,
-                        constraint: Constraint) -> List[Split]:
-        """One split per row group, pruned by row-group min/max statistics
-        (the reference's OrcPredicate stripe/row-group skipping)."""
+    def _external_splits(self, table: TableHandle, info: _TableInfo,
+                         constraint: Constraint) -> List[Split]:
+        """One split per row group (parquet) / stripe (ORC), pruned by that
+        chunk's min/max statistics (the reference's OrcPredicate
+        stripe/row-group skipping)."""
         splits = []
         b = 0
         for f in info.files:
-            pf = ParquetFile(f)
+            pf = _ExternalFile(f)
             try:
-                for g in range(pf.n_row_groups):
-                    keep = pf.row_group_rows(g) > 0
+                for g in range(pf.n_chunks):
+                    keep = pf.chunk_rows(g) > 0
                     if keep and constraint.domains:
                         for col, dom in constraint.domains.items():
                             lo, hi = dom if isinstance(dom, tuple) else (None, None)
-                            stats = pf.row_group_stats(g, col)
+                            stats = pf.chunk_stats(g, col)
                             if stats is None or stats[0] is None or \
                                     isinstance(stats[0], str):
                                 continue
@@ -349,7 +387,7 @@ class FilePageSource(ConnectorPageSource):
 
     def __iter__(self) -> Iterator[Page]:
         if len(self.split.payload) == 3:
-            yield from self._iter_parquet()
+            yield from self._iter_external()
             return
         name, path = self.split.payload
         info = self._metadata._load(name)
@@ -397,18 +435,18 @@ class FilePageSource(ConnectorPageSource):
         finally:
             pf.close()
 
-    def _iter_parquet(self) -> Iterator[Page]:
+    def _iter_external(self) -> Iterator[Page]:
         name, path, group = self.split.payload
         info = self._metadata._load(name)
         table_dicts = {c.name: c.dictionary for c in info.metadata.columns}
         types = {c.name: c.type for c in info.metadata.columns}
         names = [c.name for c in self.columns]
-        pf = ParquetFile(path)
+        pf = _ExternalFile(path)
         try:
-            data = pf.read_row_group(group, names)
+            data = pf.read_chunk(group, names)
         finally:
             pf.close()
-        n = pf.row_group_rows(group)
+        n = pf.chunk_rows(group)
         from ...utils.batching import clamp_capacity
         cap = clamp_capacity(n, self.capacity)
         cols = {}

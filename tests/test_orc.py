@@ -1,0 +1,239 @@
+"""ORC reader (formats/orc.py): verified against pyarrow-written files.
+
+Reference analogue: presto-orc OrcReader + stream decoders; pyarrow appears
+ONLY as the fixture writer — the read path under test is the engine's own
+protobuf/RLEv2/stripe decoder."""
+import decimal
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.orc as pa_orc
+import pytest
+
+from presto_tpu.formats.orc import OrcFile, decode_rlev2
+from presto_tpu.types import (BIGINT, BOOLEAN, DATE, DOUBLE, INTEGER,
+                              SMALLINT, VARCHAR, DecimalType)
+
+
+def _write(tmp_path, tbl, name="t.orc", **kw):
+    path = str(tmp_path / name)
+    pa_orc.write_table(tbl, path, **kw)
+    return path
+
+
+@pytest.mark.parametrize("compression", ["uncompressed", "zlib", "snappy",
+                                         "zstd"])
+def test_scalar_types_roundtrip(tmp_path, compression):
+    n = 5000
+    rng = np.random.default_rng(0)
+    tbl = pa.table({
+        "c_i64": pa.array(rng.integers(-2**40, 2**40, n)),
+        "c_i32": pa.array(rng.integers(-2**30, 2**30, n), type=pa.int32()),
+        "c_i16": pa.array(rng.integers(-2**14, 2**14, n), type=pa.int16()),
+        "c_f64": pa.array(rng.standard_normal(n)),
+        "c_f32": pa.array(rng.standard_normal(n).astype(np.float32)),
+        "c_bool": pa.array(rng.integers(0, 2, n).astype(bool)),
+        "c_date": pa.array(rng.integers(8000, 12000, n).astype("int32"),
+                           type=pa.int32()).cast(pa.date32()),
+        "c_str": pa.array([f"val{int(x)}" for x in rng.integers(0, 30, n)]),
+        "c_dec": pa.array([decimal.Decimal(int(x)) / 100
+                           for x in rng.integers(-10**7, 10**7, n)],
+                          type=pa.decimal128(12, 2)),
+    })
+    path = _write(tmp_path, tbl, compression=compression)
+    f = OrcFile(path)
+    assert f.num_rows == n
+    schema = dict(f.schema)
+    assert schema["c_i64"] is BIGINT and schema["c_i32"] is INTEGER
+    assert schema["c_i16"] is SMALLINT and schema["c_f64"] is DOUBLE
+    assert schema["c_bool"] is BOOLEAN and schema["c_date"] is DATE
+    assert schema["c_str"] is VARCHAR
+    assert isinstance(schema["c_dec"], DecimalType)
+    got = {}
+    for s in range(f.n_stripes):
+        part = f.read_stripe(s, [nm for nm, _ in f.schema])
+        for k, (v, nulls) in part.items():
+            assert nulls is None
+            got.setdefault(k, []).append(v)
+    got = {k: np.concatenate(v) for k, v in got.items()}
+    assert np.array_equal(got["c_i64"], tbl["c_i64"].to_numpy())
+    assert np.array_equal(got["c_i32"], tbl["c_i32"].to_numpy())
+    assert np.array_equal(got["c_i16"], tbl["c_i16"].to_numpy())
+    assert np.array_equal(got["c_f64"], tbl["c_f64"].to_numpy())
+    assert np.array_equal(got["c_f32"], tbl["c_f32"].to_numpy())
+    assert np.array_equal(got["c_bool"], tbl["c_bool"].to_numpy())
+    assert np.array_equal(got["c_date"],
+                          tbl["c_date"].cast(pa.int32()).to_numpy())
+    assert list(got["c_str"]) == tbl["c_str"].to_pylist()
+    want_dec = np.array([int(d * 100) for d in tbl["c_dec"].to_pylist()])
+    assert np.array_equal(got["c_dec"], want_dec)
+    f.close()
+
+
+def test_nulls_roundtrip(tmp_path):
+    n = 4000
+    vals = [None if i % 7 == 0 else i * 3 for i in range(n)]
+    strs = [None if i % 11 == 0 else f"s{i % 9}" for i in range(n)]
+    tbl = pa.table({"a": pa.array(vals), "b": pa.array(strs)})
+    f = OrcFile(_write(tmp_path, tbl, compression="zlib"))
+    got_a, nulls_a = [], []
+    got_b = []
+    for s in range(f.n_stripes):
+        part = f.read_stripe(s, ["a", "b"])
+        va, na = part["a"]
+        vb, _nb = part["b"]
+        got_a.append(va)
+        nulls_a.append(na if na is not None
+                       else np.zeros(len(va), dtype=bool))
+        got_b.append(vb)
+    va = np.concatenate(got_a)
+    na = np.concatenate(nulls_a)
+    vb = np.concatenate(got_b)
+    assert [None if m else int(v) for v, m in zip(va, na)] == vals
+    assert list(vb) == strs
+
+
+def test_multi_stripe_and_stats(tmp_path):
+    n = 300_000  # forces multiple stripes at the default stripe size? no —
+    # pin a small stripe size so the file genuinely has several stripes
+    tbl = pa.table({"k": pa.array(np.arange(n)),
+                    "v": pa.array(np.arange(n) % 997)})
+    path = _write(tmp_path, tbl, compression="zlib", stripe_size=1024)
+    f = OrcFile(path)
+    assert f.n_stripes > 1
+    total = sum(f.stripe_rows(s) for s in range(f.n_stripes))
+    assert total == n
+    got = np.concatenate([f.read_stripe(s, ["k"])["k"][0]
+                          for s in range(f.n_stripes)])
+    assert np.array_equal(got, np.arange(n))
+    # stripe statistics exist and bound each stripe's key range
+    lo, hi = 0, 0
+    for s in range(f.n_stripes):
+        stats = f.stripe_col_stats(s, "k")
+        assert stats is not None
+        mn, mx = stats
+        assert mn == hi if s else mn == 0
+        rows = f.stripe_rows(s)
+        assert mx == hi + rows - 1
+        hi += rows
+    f.close()
+
+
+def test_rlev2_delta_and_repeat_paths():
+    # engineered arrays that exercise SHORT_REPEAT / DELTA / DIRECT runs
+    arrs = [
+        np.full(100, 42),                      # short repeat
+        np.arange(1000) * 7,                   # monotonic delta
+        np.arange(1000)[::-1] * 3,             # descending delta
+        np.asarray([0, 1, -1, 2**33, -2**33] * 50),  # wide direct
+    ]
+    for arr in arrs:
+        tbl = pa.table({"x": pa.array(arr)})
+        import tempfile
+        with tempfile.TemporaryDirectory() as d:
+            path = f"{d}/x.orc"
+            pa_orc.write_table(tbl, path, compression="uncompressed")
+            f = OrcFile(path)
+            got = np.concatenate([f.read_stripe(s, ["x"])["x"][0]
+                                  for s in range(f.n_stripes)])
+            assert np.array_equal(got, arr), arr[:5]
+            f.close()
+
+
+def test_file_connector_orc_table(tmp_path):
+    """An .orc directory is a queryable (read-only) table: schema inference,
+    stripe-split pruning, string dictionary handling, oracle-checked SQL."""
+    import sqlite3
+
+    from presto_tpu.connectors.file import FileConnector
+    from presto_tpu.metadata import CatalogManager, Session
+    from presto_tpu.runner import LocalQueryRunner
+
+    n = 10_000
+    rng = np.random.default_rng(7)
+    ks = np.arange(n)
+    vs = rng.integers(0, 1000, n)
+    names = [f"grp{int(x)}" for x in rng.integers(0, 8, n)]
+    tbl = pa.table({"k": pa.array(ks), "v": pa.array(vs),
+                    "name": pa.array(names)})
+    d = tmp_path / "s" / "events"
+    d.mkdir(parents=True)
+    pa_orc.write_table(tbl, str(d / "part0.orc"), compression="zlib",
+                       stripe_size=4096)
+    catalogs = CatalogManager()
+    catalogs.register("wh", FileConnector("wh", str(tmp_path)))
+    runner = LocalQueryRunner(session=Session(catalog="wh", schema="s"),
+                              catalogs=catalogs)
+    conn = sqlite3.connect(":memory:")
+    conn.execute("create table events (k, v, name)")
+    conn.executemany("insert into events values (?,?,?)",
+                     list(zip(ks.tolist(), vs.tolist(), names)))
+    for sql in (
+            "select count(*), sum(v) from events",
+            "select name, count(*) c, sum(v) s from events group by name "
+            "order by name",
+            "select k, v from events where k between 5000 and 5005 "
+            "order by k",
+            "select count(*) from events where name = 'grp3'"):
+        got = runner.execute(sql).rows
+        want = [list(r) for r in conn.execute(sql).fetchall()]
+        assert [list(map(_num, r)) for r in got] == \
+            [list(map(_num, r)) for r in want], sql
+    # writes into an ORC-backed table are rejected (read-only format)
+    import pytest as _pytest
+    with _pytest.raises(Exception):
+        runner.execute("insert into wh.s.events select * from wh.s.events")
+
+
+def _num(x):
+    return float(x) if isinstance(x, (int, float, np.number)) else x
+
+
+def test_patched_base_runs(tmp_path):
+    """Small values with rare huge outliers force PATCHED_BASE encoding."""
+    rng = np.random.default_rng(5)
+    arr = rng.integers(0, 512, 5000)
+    arr[::701] = 2**40 + np.arange(len(arr[::701]))  # sparse outliers
+    tbl = pa.table({"x": pa.array(arr)})
+    f = OrcFile(_write(tmp_path, tbl, compression="uncompressed"))
+    got = np.concatenate([f.read_stripe(s, ["x"])["x"][0]
+                          for s in range(f.n_stripes)])
+    assert np.array_equal(got, arr)
+    f.close()
+
+
+def test_tinyint_column(tmp_path):
+    arr = np.asarray([-128, -1, 0, 1, 127] * 200, dtype=np.int8)
+    tbl = pa.table({"b": pa.array(arr, type=pa.int8())})
+    f = OrcFile(_write(tmp_path, tbl))
+    got = np.concatenate([f.read_stripe(s, ["b"])["b"][0]
+                          for s in range(f.n_stripes)])
+    assert np.array_equal(got, arr.astype(np.int64))
+    f.close()
+
+
+def test_large_footer_reread(tmp_path):
+    """Footer + stripe stats exceeding the 16 KB tail probe must trigger a
+    re-read, not a wrapped negative slice (regression)."""
+    n = 200_000
+    tbl = pa.table({"k": pa.array(np.arange(n)),
+                    "a": pa.array(np.arange(n) % 13),
+                    "b": pa.array(np.arange(n) % 17),
+                    "c": pa.array((np.arange(n) % 19).astype(np.float64))})
+    path = _write(tmp_path, tbl, compression="uncompressed",
+                  stripe_size=1024)
+    f = OrcFile(path)
+    assert f.n_stripes > 100  # enough stripes to blow the 16 KB tail
+    assert sum(f.stripe_rows(s) for s in range(f.n_stripes)) == n
+    got = np.concatenate([f.read_stripe(s, ["k"])["k"][0]
+                          for s in range(f.n_stripes)])
+    assert np.array_equal(got, np.arange(n))
+    assert f.stripe_col_stats(0, "k")[0] == 0
+    f.close()
+
+
+def test_nested_rejected(tmp_path):
+    tbl = pa.table({"a": pa.array([[1, 2], [3]])})
+    path = _write(tmp_path, tbl)
+    with pytest.raises(NotImplementedError):
+        OrcFile(path)
