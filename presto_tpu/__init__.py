@@ -50,4 +50,8 @@ from .types import (BIGINT, BOOLEAN, DATE, DOUBLE, INTEGER, REAL, SMALLINT,  # n
                     TIMESTAMP, VARCHAR, DecimalType, Type, parse_type)
 from .block import Block, Dictionary, Page, page_from_arrays, page_from_pylists  # noqa: E402,F401
 
+# pluggable function libraries (geospatial / teradata / ml) self-register
+# into the analyzer + expression-compiler registries on import
+from . import functions as _functions  # noqa: E402,F401
+
 __version__ = "0.1.0"

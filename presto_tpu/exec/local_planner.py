@@ -698,6 +698,8 @@ class LocalExecutionPlanner:
                 out_dict = src.dicts[inter_ch[0]] \
                     if ac.name in ("min", "max", "arbitrary", "any_value") and \
                     inter_ch and src.dicts[inter_ch[0]] is not None else None
+                if fn.output_dict is not None:  # string-producing aggregates
+                    out_dict = fn.output_dict
                 calls.append(AggregateCall(fn, [], None,
                                            intermediate_channels=inter_ch,
                                            output_dictionary=out_dict))
@@ -710,6 +712,8 @@ class LocalExecutionPlanner:
             if ac.name in ("min", "max", "arbitrary", "any_value") and arg_ch \
                     and src.dicts[arg_ch[0]] is not None:
                 out_dict = src.dicts[arg_ch[0]]
+            if fn.output_dict is not None:  # string-producing aggregates
+                out_dict = fn.output_dict
             calls.append(AggregateCall(fn, arg_ch, mask_ch,
                                        output_dictionary=out_dict))
             if step == P_PARTIAL:

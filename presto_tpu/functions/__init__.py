@@ -1,0 +1,18 @@
+"""Pluggable SQL function libraries (the standalone-module analogues).
+
+Each submodule registers its functions into the engine's two extension
+registries — sql/analyzer.py EXTERNAL_FUNCTIONS (typing) and
+ops/expressions.py EXTERNAL_COMPILERS (kernel compilation) — the way
+reference plugins contribute functions through Plugin.getFunctions
+(spi/Plugin.java:31, metadata/FunctionManager.java).
+
+- geospatial: presto-geospatial analogue (ST_* over planar points, WKT
+  polygon constants, great-circle distance)
+- teradata: presto-teradata-functions analogue (index/char2hexint/...)
+- ml: presto-ml analogue (learn/eval linear models as aggregates)
+
+Importing this package installs all of them.
+"""
+from . import geospatial  # noqa: F401
+from . import teradata  # noqa: F401
+from . import ml  # noqa: F401

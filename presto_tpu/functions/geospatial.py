@@ -1,0 +1,253 @@
+"""Geospatial functions: the presto-geospatial analogue, TPU-first.
+
+Reference: presto-geospatial/.../GeoFunctions.java (ST_* scalar functions
+over an Esri geometry object model). A per-row object model is hostile to
+the TPU, so the re-design narrows to the shapes that vectorize:
+
+- POINT values are complex128 lanes (x + iy) — see types.GeometryType;
+  ST_Point / ST_X / ST_Y / ST_Distance are pure jnp arithmetic.
+- POLYGON / geometry *construction from text* is a plan-time fold:
+  ST_GeometryFromText over a varchar LITERAL parses the WKT once during
+  analysis; ST_Contains / ST_Within against that constant polygon compile
+  to a vectorized crossing-number test over the point column (each edge is
+  a trace-time constant — XLA fuses the whole ring into one kernel).
+- ST_Area over a constant polygon folds to a DOUBLE literal (shoelace).
+- great_circle_distance(lat1, lon1, lat2, lon2) -> km (haversine), same
+  signature as the reference's.
+
+Per-row (non-constant) polygon values are rejected at analysis with a
+clear message — the same stance the engine takes on ragged arrays.
+"""
+from __future__ import annotations
+
+import math
+import re
+from typing import List, Tuple
+
+import jax.numpy as jnp
+
+from ..ops.expressions import Call, Constant, register_compiler
+from ..sql.analyzer import SemanticError, cast_to, register_scalar_function
+from ..types import BOOLEAN, DOUBLE, GEOMETRY
+
+
+# --------------------------------------------------------------------------
+# WKT parsing (plan-time only)
+# --------------------------------------------------------------------------
+
+_NUM = r"[-+]?\d+(?:\.\d+)?(?:[eE][-+]?\d+)?"
+
+
+def parse_wkt(text: str):
+    """'POINT (x y)' -> complex; 'POLYGON ((x y, ...))' -> ring tuple."""
+    s = text.strip()
+    m = re.fullmatch(rf"POINT\s*\(\s*({_NUM})\s+({_NUM})\s*\)", s,
+                     re.IGNORECASE)
+    if m:
+        return complex(float(m.group(1)), float(m.group(2)))
+    m = re.fullmatch(r"POLYGON\s*\(\((.*)\)\)", s, re.IGNORECASE | re.DOTALL)
+    if m:
+        pts: List[Tuple[float, float]] = []
+        for pair in m.group(1).split(","):
+            xy = pair.split()
+            if len(xy) != 2 or not all(re.fullmatch(_NUM, v) for v in xy):
+                raise SemanticError(f"malformed WKT polygon vertex {pair!r}")
+            pts.append((float(xy[0]), float(xy[1])))
+        if len(pts) >= 2 and pts[0] == pts[-1]:
+            pts.pop()  # drop the closing vertex; the test wraps implicitly
+        if len(pts) < 3:
+            raise SemanticError("WKT polygon needs at least 3 vertices")
+        return tuple(pts)
+    raise SemanticError(
+        f"unsupported WKT {text[:40]!r} (POINT and single-ring POLYGON)")
+
+
+def _const_geometry(arg) -> object:
+    if isinstance(arg, Constant) and isinstance(arg.value, (tuple, complex)):
+        return arg.value
+    raise SemanticError(
+        "this geometry argument must be a constant "
+        "(ST_GeometryFromText over a literal) — per-row polygons have no "
+        "device representation")
+
+
+def _shoelace(ring) -> float:
+    area = 0.0
+    n = len(ring)
+    for i in range(n):
+        x1, y1 = ring[i]
+        x2, y2 = ring[(i + 1) % n]
+        area += x1 * y2 - x2 * y1
+    return abs(area) / 2.0
+
+
+# --------------------------------------------------------------------------
+# typers (analysis)
+# --------------------------------------------------------------------------
+
+def _t_st_point(name, args):
+    if len(args) != 2:
+        raise SemanticError("st_point(x, y) takes two arguments")
+    return Call(GEOMETRY, "st_point",
+                tuple(cast_to(a, DOUBLE) for a in args))
+
+
+def _t_st_geometryfromtext(name, args):
+    if len(args) != 1:
+        raise SemanticError("st_geometryfromtext(wkt) takes one argument")
+    a = args[0]
+    if not isinstance(a, Constant):
+        raise SemanticError(
+            "st_geometryfromtext requires a literal WKT string")
+    return Constant(GEOMETRY, parse_wkt(str(a.value)))
+
+
+def _t_coord(name, args):
+    if args[0].type is not GEOMETRY:
+        raise SemanticError(f"{name}() expects a geometry")
+    return Call(DOUBLE, name, args)
+
+
+def _t_st_distance(name, args):
+    if len(args) != 2 or any(a.type is not GEOMETRY for a in args):
+        raise SemanticError("st_distance expects two geometries")
+    return Call(DOUBLE, "st_distance", args)
+
+
+def _t_contains(name, args):
+    if len(args) != 2:
+        raise SemanticError(f"{name}() takes two geometries")
+    poly, point = (args[0], args[1]) if name == "st_contains" else \
+        (args[1], args[0])
+    ring = _const_geometry(poly)
+    if not isinstance(ring, tuple):
+        raise SemanticError(f"{name}() needs a polygon argument")
+    if point.type is not GEOMETRY:
+        raise SemanticError(f"{name}() second operand must be a geometry "
+                            f"(got {point.type.name})")
+    return Call(BOOLEAN, "st_contains_const", (Constant(GEOMETRY, ring),
+                                               point))
+
+
+def _t_st_area(name, args):
+    ring = _const_geometry(args[0])
+    if not isinstance(ring, tuple):
+        raise SemanticError("st_area() needs a polygon")
+    return Constant(DOUBLE, _shoelace(ring))
+
+
+def _t_great_circle(name, args):
+    if len(args) != 4:
+        raise SemanticError(
+            "great_circle_distance(lat1, lon1, lat2, lon2)")
+    return Call(DOUBLE, "great_circle_distance",
+                tuple(cast_to(a, DOUBLE) for a in args))
+
+
+# --------------------------------------------------------------------------
+# compilers (kernels)
+# --------------------------------------------------------------------------
+
+def _c_st_point(compiler, expr):
+    fx = compiler._compile(expr.args[0])[0]
+    fy = compiler._compile(expr.args[1])[0]
+
+    def fn(datas, nulls):
+        x, nx = fx(datas, nulls)
+        y, ny = fy(datas, nulls)
+        n = nx if ny is None else (ny if nx is None else nx | ny)
+        return x + 1j * y, n
+    return fn, None
+
+
+def _c_coord(part):
+    def compile_(compiler, expr):
+        f = compiler._compile(expr.args[0])[0]
+
+        def fn(datas, nulls):
+            g, n = f(datas, nulls)
+            return (jnp.real(g) if part == "x" else jnp.imag(g)), n
+        return fn, None
+    return compile_
+
+
+def _c_st_distance(compiler, expr):
+    fa = compiler._compile(expr.args[0])[0]
+    fb = compiler._compile(expr.args[1])[0]
+
+    def fn(datas, nulls):
+        a, na = fa(datas, nulls)
+        b, nb = fb(datas, nulls)
+        n = na if nb is None else (nb if na is None else na | nb)
+        return jnp.abs(a - b), n
+    return fn, None
+
+
+def _c_st_contains(compiler, expr):
+    ring = expr.args[0].value
+    f = compiler._compile(expr.args[1])[0]
+    xs = [p[0] for p in ring]
+    ys = [p[1] for p in ring]
+
+    def fn(datas, nulls):
+        g, n = f(datas, nulls)
+        px = jnp.real(g)
+        py = jnp.imag(g)
+        inside = jnp.zeros(px.shape, dtype=jnp.bool_)
+        # crossing-number test, one fused comparison per edge (edges are
+        # trace constants; XLA folds the ring into a single kernel)
+        m = len(xs)
+        for i in range(m):
+            x1, y1 = xs[i], ys[i]
+            x2, y2 = xs[(i + 1) % m], ys[(i + 1) % m]
+            straddles = (y1 > py) != (y2 > py)
+            dy = y2 - y1 if y2 != y1 else 1e-300
+            xcross = x1 + (py - y1) * (x2 - x1) / dy
+            inside = inside ^ (straddles & (px < xcross))
+        return inside, n
+    return fn, None
+
+
+_EARTH_RADIUS_KM = 6371.01
+
+
+def _c_great_circle(compiler, expr):
+    fs = [compiler._compile(a)[0] for a in expr.args]
+
+    def fn(datas, nulls):
+        vals = []
+        n = None
+        for f in fs:
+            v, nv = f(datas, nulls)
+            vals.append(jnp.deg2rad(v))
+            n = nv if n is None else (n if nv is None else n | nv)
+        lat1, lon1, lat2, lon2 = vals
+        dlat = lat2 - lat1
+        dlon = lon2 - lon1
+        h = jnp.sin(dlat / 2) ** 2 + \
+            jnp.cos(lat1) * jnp.cos(lat2) * jnp.sin(dlon / 2) ** 2
+        return 2 * _EARTH_RADIUS_KM * jnp.arcsin(jnp.sqrt(h)), n
+    return fn, None
+
+
+# --------------------------------------------------------------------------
+# registration
+# --------------------------------------------------------------------------
+
+register_scalar_function("st_point", _t_st_point)
+register_scalar_function("st_geometryfromtext", _t_st_geometryfromtext)
+register_scalar_function("st_geometry_from_text", _t_st_geometryfromtext)
+register_scalar_function("st_x", _t_coord)
+register_scalar_function("st_y", _t_coord)
+register_scalar_function("st_distance", _t_st_distance)
+register_scalar_function("st_contains", _t_contains)
+register_scalar_function("st_within", _t_contains)
+register_scalar_function("st_area", _t_st_area)
+register_scalar_function("great_circle_distance", _t_great_circle)
+
+register_compiler("st_point", _c_st_point)
+register_compiler("st_x", _c_coord("x"))
+register_compiler("st_y", _c_coord("y"))
+register_compiler("st_distance", _c_st_distance)
+register_compiler("st_contains_const", _c_st_contains)
+register_compiler("great_circle_distance", _c_great_circle)

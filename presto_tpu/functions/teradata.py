@@ -1,0 +1,128 @@
+"""Teradata compatibility functions: the presto-teradata-functions analogue.
+
+Reference: presto-teradata-functions/.../TeradataStringFunctions.java +
+TeradataDateFunctions.java (362 LoC: index, char2hexint, to_char-family).
+String inputs are dictionary-encoded in this engine, so string->scalar
+functions evaluate ONCE PER DISTINCT VALUE on the host and become a small
+lookup array gathered by code on device (the substr/upper/lower pattern in
+ops/expressions.py) — per-row Python never runs.
+
+Provided: index(string, substring) [1-based, 0 when absent], strpos (the
+ANSI twin), char2hexint(string) -> VARCHAR, char_length /
+character_length (aliases of length), trim/ltrim/rtrim, reverse.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..block import Dictionary
+from ..ops.expressions import Call, Constant, register_compiler
+from ..sql.analyzer import SemanticError, register_scalar_function
+from ..types import BIGINT, VARCHAR, is_string
+
+
+# --------------------------------------------------------------------------
+# shared dictionary-transform machinery
+# --------------------------------------------------------------------------
+
+def _dict_scalar_compiler(value_fn, out_dtype):
+    """string -> scalar via per-distinct-value host evaluation + device
+    gather (ops/expressions.py's length() pattern)."""
+    def compile_(compiler, expr):
+        d = compiler._dictionary_of(expr.args[0])
+        if d is None or not hasattr(d, "values"):
+            raise NotImplementedError(
+                f"{expr.name}() needs a materialized dictionary column")
+        extra = tuple(a.value for a in expr.args[1:])
+        f = compiler._compile(expr.args[0])[0]
+        table = jnp.asarray([value_fn(str(v), *extra) for v in d.values],
+                            dtype=out_dtype)
+        hi = max(len(d.values) - 1, 0)
+
+        def fn(datas, nulls, _t=table, _hi=hi):
+            c, n = f(datas, nulls)
+            return _t[jnp.clip(c.astype(jnp.int32), 0, _hi)], n
+        return fn, None
+    return compile_
+
+
+def _dict_string_compiler(value_fn):
+    """string -> string via host transform + re-encoded dictionary (the
+    upper/lower collision-safe pattern)."""
+    def compile_(compiler, expr):
+        d = compiler._dictionary_of(expr.args[0])
+        if d is None or not hasattr(d, "values"):
+            raise NotImplementedError(
+                f"{expr.name}() needs a materialized dictionary column")
+        f = compiler._compile(expr.args[0])[0]
+        transformed = [value_fn(str(v)) for v in d.values]
+        uniq = sorted(set(transformed))
+        pos = {v: i for i, v in enumerate(uniq)}
+        remap = jnp.asarray([pos[v] for v in transformed], dtype=jnp.int32)
+        new_dict = Dictionary(uniq)
+        hi = max(len(transformed) - 1, 0)
+
+        def fn(datas, nulls, _remap=remap, _hi=hi):
+            c, n = f(datas, nulls)
+            return _remap[jnp.clip(c.astype(jnp.int32), 0, _hi)], n
+        return fn, new_dict
+    return compile_
+
+
+def _string_arg_typer(out_type, n_const_args: int = 0, name_override=None):
+    def typer(name, args):
+        if len(args) != 1 + n_const_args:
+            raise SemanticError(
+                f"{name}() takes {1 + n_const_args} argument(s), "
+                f"got {len(args)}")
+        if not is_string(args[0].type):
+            raise SemanticError(f"{name}() expects a varchar argument")
+        for a in args[1:]:
+            if not isinstance(a, Constant):
+                raise SemanticError(
+                    f"{name}() extra arguments must be literals "
+                    f"(evaluated per distinct dictionary value)")
+        return Call(out_type, name_override or name, tuple(args))
+    return typer
+
+
+# --------------------------------------------------------------------------
+# the functions
+# --------------------------------------------------------------------------
+
+def _index(s: str, sub) -> int:
+    return s.find(str(sub)) + 1  # 1-based; 0 = absent (Teradata INDEX)
+
+
+def _char2hexint(s: str) -> str:
+    # Teradata CHAR2HEXINT: UTF-16BE code units as 4-hex-digit groups
+    # (encode() emits surrogate PAIRS for astral chars, as the fixed-width
+    # group contract requires — ord() would leak 5-digit groups)
+    return s.encode("utf-16-be").hex().upper()
+
+
+register_scalar_function("index", _string_arg_typer(BIGINT, 1))
+register_scalar_function("strpos", _string_arg_typer(BIGINT, 1,
+                                                     name_override="index"))
+register_scalar_function("char2hexint", _string_arg_typer(VARCHAR))
+register_scalar_function("reverse", _string_arg_typer(VARCHAR))
+register_scalar_function("trim", _string_arg_typer(VARCHAR))
+register_scalar_function("ltrim", _string_arg_typer(VARCHAR))
+register_scalar_function("rtrim", _string_arg_typer(VARCHAR))
+
+
+def _t_char_length(name, args):
+    if len(args) != 1 or not is_string(args[0].type):
+        raise SemanticError(f"{name}() expects one varchar argument")
+    return Call(BIGINT, "length", tuple(args))
+
+
+register_scalar_function("char_length", _t_char_length)
+register_scalar_function("character_length", _t_char_length)
+
+register_compiler("index", _dict_scalar_compiler(_index, jnp.int64))
+register_compiler("char2hexint", _dict_string_compiler(_char2hexint))
+register_compiler("reverse", _dict_string_compiler(lambda s: s[::-1]))
+register_compiler("trim", _dict_string_compiler(str.strip))
+register_compiler("ltrim", _dict_string_compiler(str.lstrip))
+register_compiler("rtrim", _dict_string_compiler(str.rstrip))

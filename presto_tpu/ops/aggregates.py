@@ -67,6 +67,10 @@ class AggregateFunction:
     # resolve_aggregate; two functions with equal fingerprints compile to
     # behaviorally identical contributions
     fingerprint: tuple = ()
+    # string-producing aggregates (ml learn_*): a Dictionary allocated at
+    # RESOLVE time so the plan layout can reference it; final_map fills it
+    # with the actual values (codes index into it) when the query runs
+    output_dict: object = None
 
 
 def _ones_i64(args, mask):
@@ -387,7 +391,21 @@ def _resolve_aggregate(name: str, arg_types: Sequence[Type],
             [StateColumn(np.dtype(np.float64), SUM, 0.0, width=K)],
             input_map, final_map, [], splittable=False)
 
+    ext = EXTERNAL_AGGREGATES.get(name)
+    if ext is not None:
+        return ext(arg_types, distinct, params)
     raise NotImplementedError(f"aggregate function {name}({arg_types})")
+
+
+# pluggable aggregates (Plugin.getFunctions analogue for accumulator
+# functions): presto_tpu.functions.* register `(arg_types, distinct, params)
+# -> AggregateFunction` resolvers here; sql/analyzer.register_aggregate_name
+# makes the parser route the call through aggregation planning
+EXTERNAL_AGGREGATES: dict = {}
+
+
+def register_aggregate(name: str, resolver) -> None:
+    EXTERNAL_AGGREGATES[name.lower()] = resolver
 
 
 def _hash_to_u64(a0):

@@ -235,6 +235,18 @@ def _np_const(value, type_: Type):
     return np.asarray(value, dtype=type_.np_dtype)
 
 
+# pluggable scalar-function compilers, keyed by Call name: each maps
+# `(compiler, call_expr) -> (closure, output_dictionary)` — the counterpart
+# of sql/analyzer.py's EXTERNAL_FUNCTIONS typer registry (together they are
+# the engine's FunctionManager extension point; presto_tpu.functions.*
+# modules self-register on import)
+EXTERNAL_COMPILERS: dict = {}
+
+
+def register_compiler(name: str, fn) -> None:
+    EXTERNAL_COMPILERS[name.lower()] = fn
+
+
 class ExpressionCompiler:
     """Compiles a RowExpression against a static InputLayout."""
 
@@ -650,6 +662,9 @@ class ExpressionCompiler:
                 c, n = f(datas, nulls)
                 return _remap[jnp.clip(c.astype(jnp.int32), 0, _hi)], n
             return fn, new_dict
+        compiler = EXTERNAL_COMPILERS.get(name)
+        if compiler is not None:
+            return compiler(self, expr)
         raise NotImplementedError(f"function {name}")
 
     def _dictionary_of(self, expr: RowExpression) -> Optional[Dictionary]:

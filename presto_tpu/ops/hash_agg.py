@@ -579,8 +579,11 @@ class GlobalAggregationBuilder:
                        (at.min if kind == MIN else at.max))(vals, mode="drop")
             else:
                 if self.from_intermediate:
-                    c = jnp.where(mask, c, jnp.asarray(ident, dtype=c.dtype))
-                red = {SUM: jnp.sum, MIN: jnp.min, MAX: jnp.max}[kind](c)
+                    cond = mask if c.ndim == 1 else mask[:, None]
+                    c = jnp.where(cond, c, jnp.asarray(ident, dtype=c.dtype))
+                # axis=0 keeps (rows, width) vector contributions per-column
+                red = {SUM: jnp.sum, MIN: jnp.min,
+                       MAX: jnp.max}[kind](c, axis=0)
             new_state.append({SUM: lambda a, b: a + b,
                               MIN: jnp.minimum, MAX: jnp.maximum}[kind](s, red))
         return tuple(new_state)
@@ -728,7 +731,7 @@ class HashAggregationOperator(Operator):
                                          ).astype(jnp.int32)]
                 out_cols.append((call.function.output_type,
                                  jnp.asarray(out, dtype=call.function.output_type.np_dtype),
-                                 call.output_dictionary, nulls))
+                                 d, nulls))
         for lo in range(0, max(total, 1), cap):
             hi = min(lo + cap, total)
             blocks = []

@@ -12,7 +12,7 @@ from __future__ import annotations
 import dataclasses
 import datetime
 import math
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from ..ops.expressions import (Call, Constant, RowExpression, SpecialForm, SymbolRef,
                                arithmetic_result_type, days_from_civil, special,
@@ -29,6 +29,26 @@ AGGREGATE_NAMES = {"count", "sum", "avg", "min", "max", "stddev", "stddev_samp",
                    "covar_samp", "covar_pop", "approx_distinct", "count_if",
                    "bool_and", "bool_or", "every", "arbitrary", "any_value",
                    "approx_percentile"}
+
+# pluggable scalar functions (the FunctionManager/function-namespace
+# analogue, metadata/FunctionManager.java): plugin modules register a typer
+# `(name, args) -> RowExpression` here; ops/expressions.py holds the
+# matching compiler registry. presto_tpu.functions.* self-register on import.
+EXTERNAL_FUNCTIONS: Dict[str, "Callable"] = {}
+
+
+def register_scalar_function(name: str, typer) -> None:
+    EXTERNAL_FUNCTIONS[name.lower()] = typer
+
+
+def register_aggregate_name(name: str, output_typer=None) -> None:
+    """Route `name(...)` through aggregation planning (pair with
+    ops/aggregates.register_aggregate, which supplies the resolver).
+    `output_typer(arg_types) -> Type` feeds aggregate_output_type."""
+    AGGREGATE_NAMES.add(name.lower())
+    if output_typer is not None:
+        EXTERNAL_AGGREGATE_TYPES[name.lower()] = output_typer
+
 
 _ARITH_NAMES = {"+": "add", "-": "subtract", "*": "multiply", "/": "divide",
                 "%": "modulus"}
@@ -541,6 +561,9 @@ class ExpressionTranslator:
             out_t = common_type(then.type, els.type)
             return SpecialForm(out_t, "IF",
                                (cond, cast_to(then, out_t), cast_to(els, out_t)))
+        typer = EXTERNAL_FUNCTIONS.get(name)
+        if typer is not None:
+            return typer(name, args)
         raise SemanticError(f"unknown function {name}")
 
     def _t_SubqueryExpression(self, e: t.SubqueryExpression) -> RowExpression:
@@ -577,4 +600,11 @@ def aggregate_output_type(name: str, arg_types: Sequence[Type]) -> Type:
         return DOUBLE
     if name in ("bool_and", "bool_or", "every"):
         return BOOLEAN
+    typer = EXTERNAL_AGGREGATE_TYPES.get(name)
+    if typer is not None:
+        return typer(arg_types)
     raise SemanticError(f"unknown aggregate {name}")
+
+
+# output-type resolvers for externally registered aggregates
+EXTERNAL_AGGREGATE_TYPES: Dict[str, Callable] = {}

@@ -243,6 +243,27 @@ class ArrayType(Type):
 
 
 @dataclasses.dataclass(frozen=True)
+class GeometryType(Type):
+    """Planar POINT geometry (presto-geospatial's GEOMETRY, narrowed).
+
+    TPU-first representation: a point is ONE complex128 lane (x + iy) — two
+    doubles packed per value, so point columns ride the same dense-array
+    page substrate as every scalar type. Polygons/linestrings exist only as
+    PLAN-TIME constants (WKT literals folded by the analyzer); per-row
+    polygon values have no device representation and are rejected there."""
+
+    name: ClassVar[str] = "geometry"
+
+    @property
+    def np_dtype(self) -> np.dtype:
+        return np.dtype(np.complex128)
+
+    def to_python(self, raw):
+        c = complex(raw)
+        return f"POINT ({c.real:g} {c.imag:g})"
+
+
+@dataclasses.dataclass(frozen=True)
 class UnknownType(Type):
     """Type of NULL literals before coercion (spi/type/UnknownType analogue)."""
 
@@ -264,6 +285,7 @@ TIMESTAMP = TimestampType()
 VARCHAR = VarcharType()
 WIDE_VARCHAR = VarcharType(wide=True)
 UNKNOWN = UnknownType()
+GEOMETRY = GeometryType()
 
 
 def decimal_type(precision: int = 12, scale: int = 2) -> DecimalType:

@@ -1,0 +1,202 @@
+"""Pluggable function libraries (presto_tpu/functions/): geospatial,
+teradata compatibility, and ML — the presto-geospatial /
+presto-teradata-functions / presto-ml analogues, run through full SQL."""
+import json
+import math
+
+import numpy as np
+import pytest
+
+from presto_tpu.metadata import CatalogManager, Session
+from presto_tpu.connectors.memory import MemoryConnector
+from presto_tpu.runner import LocalQueryRunner
+
+
+@pytest.fixture(scope="module")
+def runner():
+    return LocalQueryRunner(session=Session(catalog="tpch", schema="tiny"))
+
+
+# ------------------------------------------------------------- geospatial
+
+def test_st_point_distance(runner):
+    rows = runner.execute(
+        "select st_distance(st_point(0, 0), st_point(3, 4)), "
+        "st_x(st_point(2.5, 7)), st_y(st_point(2.5, 7))").rows
+    assert rows == [[5.0, 2.5, 7.0]]
+
+
+def test_st_contains_polygon(runner):
+    sql = ("select n_nationkey, "
+           "st_contains(st_geometryfromtext("
+           "'POLYGON((0 0, 10 0, 10 10, 0 10))'), "
+           "st_point(n_nationkey, n_nationkey)) inside "
+           "from nation order by n_nationkey limit 12")
+    rows = runner.execute(sql).rows
+    for k, inside in rows:
+        assert inside == (0 <= k < 10), (k, inside)  # boundary: even-odd
+
+
+def test_st_area_and_within(runner):
+    rows = runner.execute(
+        "select st_area(st_geometryfromtext("
+        "'POLYGON((0 0, 4 0, 4 3, 0 3))')), "
+        "st_within(st_point(1, 1), st_geometryfromtext("
+        "'POLYGON((0 0, 2 0, 2 2, 0 2))'))").rows
+    assert rows == [[12.0, True]]
+
+
+def test_great_circle_distance(runner):
+    # London -> Paris ~ 343 km
+    rows = runner.execute(
+        "select great_circle_distance(51.5074, -0.1278, "
+        "48.8566, 2.3522)").rows
+    assert abs(rows[0][0] - 343.5) < 2.0
+
+
+def test_geometry_output_renders_as_wkt(runner):
+    rows = runner.execute("select st_point(1.5, -2)").rows
+    assert rows == [["POINT (1.5 -2)"]]
+
+
+def test_per_row_polygon_rejected(runner):
+    from presto_tpu.sql.analyzer import SemanticError
+    with pytest.raises(SemanticError):
+        runner.execute("select st_geometryfromtext(n_name) from nation")
+
+
+def test_type_and_arity_validation(runner):
+    """Wrong types / arities fail at ANALYSIS with SemanticError — never
+    silently compute on dictionary codes (regression: review findings)."""
+    from presto_tpu.sql.analyzer import SemanticError
+    bad = [
+        # non-geometry point operand fed to containment
+        "select st_contains(st_geometryfromtext("
+        "'POLYGON((0 0, 1 0, 1 1, 0 1))'), n_name) from nation",
+        "select st_geometryfromtext() from nation",
+        "select st_distance(st_point(0, 0)) from nation",
+        "select st_geometryfromtext('POLYGON((a b, 1 1, 2 2))')",
+        # string column into a numeric regression
+        "select regr_slope(n_name, n_nationkey) from nation",
+        "select learn_linear_regressor(n_name, n_nationkey) from nation",
+        "select index(n_name) from nation",
+        "select index(n_name, 'A', 'B') from nation",
+        "select char_length(n_name, n_name) from nation",
+    ]
+    for sql in bad:
+        with pytest.raises(SemanticError):
+            runner.execute(sql)
+
+
+# --------------------------------------------------------------- teradata
+
+def test_index_and_strpos(runner):
+    rows = runner.execute(
+        "select n_name, index(n_name, 'AN'), strpos(n_name, 'AN') "
+        "from nation where n_nationkey < 4 order by n_nationkey").rows
+    for name, idx, sp in rows:
+        assert idx == sp == name.find("AN") + 1
+
+
+def test_char2hexint(runner):
+    rows = runner.execute(
+        "select char2hexint(n_name) from nation "
+        "where n_name = 'CANADA'").rows
+    assert rows == [["".join(f"{ord(c):04X}" for c in "CANADA")]]
+
+
+def test_reverse_trim_char_length(runner):
+    rows = runner.execute(
+        "select reverse(n_name), char_length(n_name) from nation "
+        "where n_nationkey = 3").rows
+    assert rows == [["ADANAC", 6]]
+
+
+# --------------------------------------------------------------------- ml
+
+def _feature_table():
+    """y = 3 + 2*x1 - 0.5*x2 with noise-free values -> exact recovery."""
+    rng = np.random.default_rng(0)
+    n = 500
+    # round to 4 decimals: literals parse as DECIMAL(_,4), exact in int64
+    x1 = np.round(rng.standard_normal(n), 4)
+    x2 = np.round(rng.standard_normal(n), 4)
+    y = np.round(3.0 + 2.0 * x1 - 0.5 * x2, 4)  # exact at 4 decimals
+    catalogs = CatalogManager()
+    catalogs.register("memory", MemoryConnector("memory"))
+    r = LocalQueryRunner(session=Session(catalog="memory", schema="s"),
+                         catalogs=catalogs)
+    r.execute("create table memory.s.pts as select * from (values "
+              + ", ".join(f"({float(x1[i])!r}, {float(x2[i])!r}, "
+                          f"{float(y[i])!r})" for i in range(n))
+              + ") as t(x1, x2, y)")
+    return r
+
+
+def test_regr_slope_intercept_r2(runner):
+    rows = runner.execute(
+        "select regr_slope(l_extendedprice, l_quantity), "
+        "regr_intercept(l_extendedprice, l_quantity), "
+        "regr_r2(l_extendedprice, l_quantity) from lineitem").rows
+    slope, intercept, r2 = rows[0]
+    # cross-check against numpy on the same data
+    from presto_tpu.connectors.tpch import generator as g
+    data = g.lineitem_for_orders(0, g.TPCH_TABLES["orders"].row_count(0.01),
+                                 0.01, ["l_quantity", "l_extendedprice"])
+    x = data["l_quantity"].astype(float) / 100.0   # decimal scale 2
+    y = data["l_extendedprice"].astype(float) / 100.0
+    want_slope, want_icept = np.polyfit(x, y, 1)
+    assert abs(slope - want_slope) / abs(want_slope) < 1e-6
+    assert abs(intercept - want_icept) / abs(want_icept) < 1e-6
+    assert 0.0 <= r2 <= 1.0
+
+
+def test_learn_linear_regressor_exact():
+    r = _feature_table()
+    rows = r.execute(
+        "select learn_linear_regressor(y, x1, x2) from memory.s.pts").rows
+    model = json.loads(rows[0][0])
+    assert model["type"] == "regressor"
+    assert abs(model["intercept"] - 3.0) < 1e-4
+    assert abs(model["coefficients"][0] - 2.0) < 1e-4
+    assert abs(model["coefficients"][1] + 0.5) < 1e-4
+
+
+def test_regress_applies_model():
+    r = _feature_table()
+    rows = r.execute(
+        "select avg(abs(regress(m, x1, x2) - y)) from memory.s.pts, "
+        "(select learn_linear_regressor(y, x1, x2) m from memory.s.pts)"
+        ).rows
+    assert rows[0][0] < 1e-3  # y rounds to 4 decimals in the fixture
+
+
+def test_learn_classifier_separates():
+    r = _feature_table()
+    # label: y above its mean -> the linear discriminant must recover it
+    rows = r.execute(
+        "select sum(case when classify(m, x1, x2) = "
+        "(case when y > 3.0 then 1 else 0 end) then 1 else 0 end), count(*) "
+        "from memory.s.pts, (select learn_classifier("
+        "case when y > 3.0 then 1 else -1 end, x1, x2) m "
+        "from memory.s.pts)").rows
+    correct, total = rows[0]
+    assert correct / total > 0.95
+
+
+def test_learn_grouped():
+    """learn_* with GROUP BY: one model per group via the vector-state
+    grouping kernels."""
+    r = _feature_table()
+    rows = r.execute(
+        "select g, learn_linear_regressor(y2, x1) from (select x1, "
+        "case when x2 > 0 then 1 else 0 end g, "
+        "case when x2 > 0 then 2*x1 + 1 else -3*x1 + 4 end y2 "
+        "from memory.s.pts) group by g order by g").rows
+    assert len(rows) == 2
+    m0 = json.loads(rows[0][1])
+    m1 = json.loads(rows[1][1])
+    assert abs(m0["coefficients"][0] + 3.0) < 1e-6  # g=0: slope -3
+    assert abs(m0["intercept"] - 4.0) < 1e-6
+    assert abs(m1["coefficients"][0] - 2.0) < 1e-6  # g=1: slope 2
+    assert abs(m1["intercept"] - 1.0) < 1e-6
