@@ -43,7 +43,9 @@ class BlackholeMetadata(ConnectorMetadata):
                              constraint: Constraint) -> TableStatistics:
         return TableStatistics(row_count=0.0)
 
-    def create_table(self, metadata: TableMetadata) -> None:
+    def create_table(self, metadata: TableMetadata, properties=None) -> None:
+        if properties:
+            raise ValueError("blackhole connector tables take no properties")
         with self._lock:
             self._tables[metadata.name] = metadata
 

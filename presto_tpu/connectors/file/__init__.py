@@ -249,7 +249,11 @@ class FileMetadata(ConnectorMetadata):
 
     # ---------------------------------------------------------------- writes
 
-    def create_table(self, metadata: TableMetadata) -> None:
+    def create_table(self, metadata: TableMetadata, properties=None) -> None:
+        if properties:
+            raise ValueError(
+                "file connector tables take no properties (partitioning "
+                "lives in the hive connector; format is per-catalog)")
         d = self._table_dir(metadata.name)
         if self._files_of(metadata.name):
             raise ValueError(f"table {metadata.name} already exists")

@@ -62,7 +62,9 @@ class MemoryMetadata(ConnectorMetadata):
 
     # ------------------------------------------------------------- writes
 
-    def create_table(self, metadata: TableMetadata) -> None:
+    def create_table(self, metadata: TableMetadata, properties=None) -> None:
+        if properties:
+            raise ValueError("memory connector tables take no properties")
         with self._lock:
             if metadata.name in self._tables:
                 raise ValueError(f"table {metadata.name} already exists")

@@ -103,14 +103,21 @@ def _t_st_geometryfromtext(name, args):
 
 
 def _t_coord(name, args):
-    if args[0].type is not GEOMETRY:
-        raise SemanticError(f"{name}() expects a geometry")
+    if len(args) != 1 or args[0].type is not GEOMETRY:
+        raise SemanticError(f"{name}(geometry) takes one geometry")
+    if isinstance(args[0], Constant) and isinstance(args[0].value, tuple):
+        raise SemanticError(f"{name}() needs a point, not a polygon")
     return Call(DOUBLE, name, args)
 
 
 def _t_st_distance(name, args):
     if len(args) != 2 or any(a.type is not GEOMETRY for a in args):
         raise SemanticError("st_distance expects two geometries")
+    for a in args:
+        if isinstance(a, Constant) and isinstance(a.value, tuple):
+            raise SemanticError(
+                "st_distance() operates on points, not polygons "
+                "(use st_contains/st_within for polygon tests)")
     return Call(DOUBLE, "st_distance", args)
 
 
@@ -130,6 +137,8 @@ def _t_contains(name, args):
 
 
 def _t_st_area(name, args):
+    if len(args) != 1:
+        raise SemanticError("st_area(geometry) takes one argument")
     ring = _const_geometry(args[0])
     if not isinstance(ring, tuple):
         raise SemanticError("st_area() needs a polygon")

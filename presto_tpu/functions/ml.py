@@ -76,6 +76,7 @@ def _regr_resolver(which: str):
 
         def final_map(states):
             sx, sy, sxy, sxx, syy, n = states
+            empty = n == 0.0  # SQL: aggregate over no rows is NULL
             n = jnp.maximum(n, 1.0)
             cov = sxy - sx * sy / n
             varx = sxx - sx * sx / n
@@ -90,7 +91,7 @@ def _regr_resolver(which: str):
                                   varx * vary)
                 out = jnp.where((varx == 0) | (vary == 0), 0.0,
                                 cov * cov / denom)
-            return out
+            return out, empty
 
         return AggregateFunction(
             which, DOUBLE,
@@ -142,6 +143,10 @@ def _learn_resolver(classifier: bool):
             flat = np.asarray(states[0], dtype=np.float64)
             flat = flat.reshape(-1, _d * _d + _d)
             models = []
+            # xtx[0,0] accumulates the intercept column of ones = the
+            # group's contributing-row count; 0 rows -> NULL model (SQL
+            # empty-group aggregate contract), not an all-zero model
+            empty = flat[:, 0] == 0.0
             for row in flat:
                 xtx = row[:_d * _d].reshape(_d, _d)
                 xty = row[_d * _d:]
@@ -153,7 +158,7 @@ def _learn_resolver(classifier: bool):
                     "intercept": coef[0],
                     "coefficients": list(coef[1:])}))
             codes = np.asarray(_dict.extend(models), dtype=np.int64)
-            return codes, None
+            return codes, (empty if empty.any() else None)
 
         return AggregateFunction(
             "learn_classifier" if classifier else "learn_linear_regressor",
@@ -197,7 +202,13 @@ def _c_apply_model(classify: bool):
             # dictionary's (token, len), so growth forces a re-trace
             coefs = np.zeros((max(len(d.values), 1), k + 1))
             for i, v in enumerate(d.values):
-                m = json.loads(str(v))
+                try:
+                    m = json.loads(str(v))
+                except (ValueError, TypeError) as e:
+                    raise ValueError(
+                        f"{'classify' if classify else 'regress'}(): model "
+                        f"column value {str(v)[:40]!r} is not a learn_* "
+                        f"model JSON") from e
                 got = list(m.get("coefficients", []))[:k]
                 coefs[i, 0] = float(m.get("intercept", 0.0))
                 coefs[i, 1:1 + len(got)] = got

@@ -163,8 +163,17 @@ class LocalQueryRunner:
             return QueryResult([[line] for line in text.split("\n")],
                                ["Query Plan"])
         if isinstance(stmt, t.ShowTables):
-            conn = self.metadata.connector(self.session.catalog)
-            tables = conn.metadata().list_tables(self.session.schema)
+            catalog, schema = self.session.catalog, self.session.schema
+            if stmt.schema:  # FROM [catalog.]schema
+                parts = tuple(stmt.schema)
+                if len(parts) == 2:
+                    catalog, schema = parts
+                elif len(parts) == 1:
+                    schema = parts[0]
+                else:
+                    raise ValueError("SHOW TABLES FROM takes [catalog.]schema")
+            conn = self.metadata.connector(catalog)
+            tables = conn.metadata().list_tables(schema)
             return QueryResult([[st.table] for st in tables], ["Table"])
         if isinstance(stmt, t.ShowSchemas):
             conn = self.metadata.connector(self.session.catalog)
@@ -241,7 +250,11 @@ class LocalQueryRunner:
                 for n, tt, d in zip(exec_plan.output_names,
                                     exec_plan.output_types,
                                     exec_plan.output_dicts))
-            meta.create_table(TableMetadata(name, cols))
+            props = dict(stmt.properties)
+            if props:
+                meta.create_table(TableMetadata(name, cols), properties=props)
+            else:
+                meta.create_table(TableMetadata(name, cols))
             handle = meta.get_table_handle(name)
             created = True
         else:  # INSERT

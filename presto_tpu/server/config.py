@@ -49,6 +49,16 @@ def _file_factory(catalog: str, config: Dict[str, str]):
                          write_format=config.get("file.format", "pcol"))
 
 
+def _hive_factory(catalog: str, config: Dict[str, str]):
+    from ..connectors.hive import HiveConnector
+
+    base = config.get("hive.metastore.catalog.dir")
+    if not base:
+        raise ValueError(
+            f"catalog {catalog}: hive.metastore.catalog.dir is required")
+    return HiveConnector(catalog, base)
+
+
 def _memory_factory(catalog: str, config: Dict[str, str]):
     from ..connectors.memory import MemoryConnector
 
@@ -79,6 +89,7 @@ FACTORIES: Dict[str, Callable] = {
     "memory": _memory_factory,
     "blackhole": _blackhole_factory,
     "file": _file_factory,
+    "hive": _hive_factory,
 }
 
 

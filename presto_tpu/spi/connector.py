@@ -202,7 +202,11 @@ class ConnectorMetadata(abc.ABC):
     def finish_insert(self, handle, fragments) -> None:
         pass
 
-    def create_table(self, metadata: TableMetadata) -> None:
+    def create_table(self, metadata: TableMetadata,
+                     properties: Optional[Dict[str, Any]] = None) -> None:
+        """`properties` are the CTAS WITH(...) table properties (the
+        reference's ConnectorMetadata table-property flow, e.g. hive
+        partitioned_by). Connectors that define none must reject any."""
         raise NotImplementedError(f"{type(self).__name__} does not support CREATE TABLE")
 
     def drop_table(self, table: TableHandle) -> None:

@@ -227,10 +227,53 @@ class _Parser:
             self.expect_kw("exists")
             not_exists = True
         name = self.parse_qualified_name()
+        properties: Tuple[Tuple[str, object], ...] = ()
+        if self.accept_kw("with"):
+            self.expect_op("(")
+            props = []
+            while True:
+                key = self.expect_ident().lower()
+                self.expect_op("=")
+                props.append((key, self._parse_property_value()))
+                if not self.accept_op(","):
+                    break
+            self.expect_op(")")
+            properties = tuple(props)
         if self.accept_kw("as"):
             return t.CreateTableAsSelect(name, self.parse_query(),
-                                         not_exists=not_exists)
+                                         not_exists=not_exists,
+                                         properties=properties)
         self.error("only CREATE TABLE ... AS SELECT is supported")
+
+    def _parse_property_value(self):
+        """Constant table-property value: string/number/boolean literal or
+        ARRAY['a', ...] of strings (partitioned_by/bucketed_by lists)."""
+        tok = self.peek()
+        if tok.kind == "kw:array" or (tok.kind == "ident" and
+                                      tok.text.lower() == "array"):
+            self.next()
+            self.expect_op("[")
+            items = []
+            if not self.at_op("]"):
+                while True:
+                    items.append(self._parse_property_value())
+                    if not self.accept_op(","):
+                        break
+            self.expect_op("]")
+            return items
+        if tok.kind == "string":
+            self.next()
+            return tok.text
+        if tok.kind == "number":
+            self.next()
+            return int(tok.text) if re.fullmatch(r"\d+", tok.text) \
+                else float(tok.text)
+        if self.accept_kw("true"):
+            return True
+        if self.accept_kw("false"):
+            return False
+        self.error("table property values must be constants "
+                   "(string, number, boolean or ARRAY[...])")
 
     def parse_insert(self) -> t.Statement:
         self.expect_kw("insert")

@@ -404,6 +404,10 @@ class CreateTableAsSelect(Statement):
     name: Tuple[str, ...] = ()
     query: Optional[Query] = None
     not_exists: bool = False
+    # WITH (k = v, ...) table properties, evaluated to python constants
+    # (strings, numbers, lists of strings) — the reference's
+    # ConnectorMetadata table-property flow (e.g. hive partitioned_by)
+    properties: Tuple[Tuple[str, object], ...] = ()
 
 
 @_dc
