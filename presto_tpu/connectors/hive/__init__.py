@@ -540,17 +540,31 @@ class HiveSplitManager(ConnectorSplitManager):
     def __init__(self, connector_id: str, metadata: HiveMetadata):
         self.connector_id = connector_id
         self._metadata = metadata
+        # split listings re-read file/chunk metadata; grouped execution asks
+        # once per bucket, so memoize on (table, domains, snapshot signature)
+        self._cache: Dict[tuple, List[Split]] = {}
+        self._lock = threading.Lock()
 
     def get_splits(self, table: TableHandle, constraint: Constraint,
                    desired_splits: int) -> List[Split]:
         snap = self._metadata.snapshot(table.schema_table)
         if snap is None:
             return []
+        key = (table.schema_table, tuple(sorted(constraint.domains.items())),
+               snap.signature)
+        with self._lock:
+            hit = self._cache.get(key)
+            if hit is not None:
+                return list(hit)
         parts = prune_partitions(snap, constraint)
+        bucketed = snap.desc.bucket_count > 0
         splits: List[Split] = []
         seq = 0
         for part in parts:
             for f in part.files:
+                # bucketed table: only engine-named bucket files carry a
+                # bucket id — an out-of-band file gets None so grouped
+                # execution sees the table as not safely groupable
                 bucket = _bucket_of_file(f)
                 if f.endswith(".pcol"):
                     if not self._pcol_keep(f, constraint):
@@ -559,7 +573,7 @@ class HiveSplitManager(ConnectorSplitManager):
                     splits.append(Split(
                         self.connector_id,
                         payload=(table.schema_table, part.rel_dir, f, None),
-                        bucket=bucket if bucket is not None else seq))
+                        bucket=bucket if bucketed else seq))
                     seq += 1
                 else:
                     xf = _ExternalFile(f)
@@ -573,10 +587,14 @@ class HiveSplitManager(ConnectorSplitManager):
                                 self.connector_id,
                                 payload=(table.schema_table, part.rel_dir,
                                          f, g),
-                                bucket=bucket if bucket is not None else seq))
+                                bucket=bucket if bucketed else seq))
                             seq += 1
                     finally:
                         xf.close()
+        with self._lock:
+            if len(self._cache) > 64:
+                self._cache.clear()
+            self._cache[key] = list(splits)
         return splits
 
     @staticmethod
@@ -886,6 +904,12 @@ class HiveNodePartitioning(ConnectorNodePartitioningProvider):
         snap = self._metadata.snapshot(table.schema_table)
         if snap is not None and snap.desc.bucket_count > 0:
             return snap.desc.bucket_count
+        return None
+
+    def bucket_columns(self, table: TableHandle) -> Optional[Tuple[str, ...]]:
+        snap = self._metadata.snapshot(table.schema_table)
+        if snap is not None and snap.desc.bucketed_by:
+            return tuple(snap.desc.bucketed_by)
         return None
 
 

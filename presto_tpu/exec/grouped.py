@@ -1,0 +1,284 @@
+"""Grouped (lifespan) execution: run a plan once per bucket of co-bucketed
+tables, so join build tables and aggregation state are bounded by ONE
+bucket's data instead of the whole table.
+
+Analogue of the reference's grouped execution
+(execution/Lifespan.java:26, operator/StageExecutionDescriptor.java:33,
+execution/scheduler/group/): when every table a stage reads is bucketed
+compatibly — same bucket count, joins keyed on the bucket columns — the
+stage's splits partition into `bucket_count` independent driver groups,
+each executed to completion (and its operator state freed) before the
+next starts.
+
+TPU-shaped placement: instead of threading lifespans through the driver
+scheduler, the runner executes the WHOLE local plan once per bucket with
+the scans restricted to that bucket's splits, then merges the per-bucket
+results at the root (concatenation, plus a host-side re-sort/TopN/limit
+when the plan spine orders or truncates — top-N of a union is the top-N
+of per-bucket top-Ns). Peak device state per lifespan is 1/N of the
+ungrouped run, which is the point of the feature.
+
+Safety analysis (`analyze_grouped`): a plan may group iff
+- every TableScan reads a bucketed table, all with the SAME bucket count
+  (one engine, one bucket hash, so equal counts align);
+- every join's criteria aligns the bucket columns of its two sides
+  pairwise (rows that join are in the same bucket on both sides);
+- every aggregation/window groups by (at least) some table's bucket
+  columns, so no group spans two buckets;
+- the root spine above the heavy nodes is only Project / Sort / TopN /
+  Limit, whose effect the combiner can re-establish over the merged rows.
+Anything unrecognized rejects grouping — falling back to the normal path
+is always correct.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..sql.planner.plan import (AggregationNode, FilterNode, JoinNode,
+                                LimitNode, Ordering, OutputNode, PlanNode,
+                                ProjectNode, SortNode, TableScanNode,
+                                TopNNode, WindowNode)
+
+
+@dataclasses.dataclass
+class GroupedExecution:
+    bucket_count: int
+    # host-side re-merge of per-bucket results, applied root-down:
+    # orderings as (output column index, descending, nulls_first)
+    orderings: List[Tuple[int, bool, bool]]
+    limit: Optional[int]
+
+
+# ---------------------------------------------------------------------------
+# alignment walk
+
+def _scan_bucket_tuple(node: TableScanNode, metadata) -> Optional[Tuple]:
+    """-> (bucket_count, tuple of symbol names carrying the table's bucket
+    columns in bucketed_by order), or None if the table is not bucketed or
+    a bucket column is not scanned."""
+    conn = metadata.connector(node.table.connector_id)
+    provider = conn.node_partitioning_provider()
+    count = provider.bucket_count(node.table)
+    if not count:
+        return None
+    bucket_cols = provider.bucket_columns(node.table)
+    if not bucket_cols:
+        return None
+    by_col = {c.name: s.name for s, c in node.assignments}
+    syms = tuple(by_col.get(c) for c in bucket_cols)
+    if any(s is None for s in syms):
+        return None
+    # every split must carry a well-formed bucket id (a bucketed table can
+    # still hold files written outside the engine's bucket naming)
+    from ..spi.connector import Constraint
+    splits = conn.split_manager().get_splits(node.table, Constraint.all(), 8)
+    if any(s.bucket is None or not (0 <= s.bucket < count) for s in splits):
+        return None
+    return count, syms
+
+
+class _Reject(Exception):
+    pass
+
+
+def _walk(node: PlanNode, metadata, counts: List[int]) -> List[Tuple[str, ...]]:
+    """-> the symbol tuples (by name) that carry bucket alignment at this
+    node's output. An EMPTY list means the subtree IS bucket-partitioned but
+    no carrier symbols survive projection — fine unless a consumer (join
+    criteria, aggregation keys, window partition) needs to see them.
+    Raises _Reject when the subtree cannot group at all."""
+    if isinstance(node, TableScanNode):
+        got = _scan_bucket_tuple(node, metadata)
+        if got is None:
+            raise _Reject()
+        count, syms = got
+        counts.append(count)
+        return [syms]
+
+    if isinstance(node, FilterNode):
+        return _walk(node.source, metadata, counts)
+
+    if isinstance(node, SortNode):
+        # ordering within a bucket is harmless (no truncation)
+        return _walk(node.source, metadata, counts)
+
+    if isinstance(node, (TopNNode, LimitNode)):
+        # a truncation BELOW the spine would apply per bucket instead of
+        # globally (spine ones were peeled off by analyze_grouped)
+        raise _Reject()
+
+    if isinstance(node, ProjectNode):
+        from ..ops.expressions import SymbolRef
+        tuples = _walk(node.source, metadata, counts)
+        renames: Dict[str, List[str]] = {}
+        for s, e in node.assignments:
+            if isinstance(e, SymbolRef):
+                renames.setdefault(e.name, []).append(s.name)
+        out = []
+        for t in tuples:
+            if all(n in renames for n in t):
+                out.append(tuple(renames[n][0] for n in t))
+        return out
+
+    if isinstance(node, JoinNode):
+        if node.type not in ("inner", "left"):
+            raise _Reject()
+        lt = _walk(node.left, metadata, counts)
+        rt = _walk(node.right, metadata, counts)
+        pairs = {(l.name, r.name) for l, r in node.criteria}
+        aligned = any(
+            len(a) == len(b) and all((x, y) in pairs for x, y in zip(a, b))
+            for a in lt for b in rt)
+        if not aligned:
+            raise _Reject()
+        out_names = {s.name for s in node.outputs()}
+        # a LEFT join null-extends the build side: its key columns carry
+        # NULL (not the bucket value) on unmatched rows in EVERY bucket, so
+        # only the probe side's tuples still partition the output
+        carriers = lt if node.type == "left" else lt + rt
+        return [t for t in carriers if all(n in out_names for n in t)]
+
+    if isinstance(node, AggregationNode):
+        tuples = _walk(node.source, metadata, counts)
+        keys = {s.name for s in node.keys}
+        kept = [t for t in tuples if set(t) <= keys]
+        if not kept:
+            raise _Reject()
+        return kept
+
+    if isinstance(node, WindowNode):
+        tuples = _walk(node.source, metadata, counts)
+        part = {s.name for s in node.partition_keys}
+        if not any(set(t) <= part for t in tuples):
+            raise _Reject()
+        return tuples
+
+    raise _Reject()
+
+
+def analyze_grouped(plan: OutputNode, metadata,
+                    session) -> Optional[GroupedExecution]:
+    """Decide whether `plan` can run one-bucket-at-a-time, and how to merge
+    the per-bucket results. None = run normally."""
+    if not session.get("grouped_execution"):
+        return None
+    # spine: nodes above the first heavy node whose effect must be
+    # re-established over merged rows. Project renames; Sort/TopN/Limit merge.
+    orderings: List[Ordering] = []
+    limit: Optional[int] = None
+    spine = plan.source
+    renames: Dict[str, str] = {s.name: s.name for s in plan.symbols}
+    while True:
+        if isinstance(spine, ProjectNode):
+            from ..ops.expressions import SymbolRef
+            nxt: Dict[str, str] = {}
+            for s, e in spine.assignments:
+                if s.name in renames and isinstance(e, SymbolRef):
+                    nxt[e.name] = renames[s.name]
+            renames = nxt
+            spine = spine.source
+            continue
+        if isinstance(spine, TopNNode):
+            if orderings or limit is not None:
+                return None
+            orderings = list(spine.orderings)
+            limit = spine.count
+            spine = spine.source
+            continue
+        if isinstance(spine, LimitNode):
+            if limit is not None:
+                return None
+            limit = spine.count
+            spine = spine.source
+            continue
+        if isinstance(spine, SortNode):
+            if orderings:
+                return None
+            orderings = list(spine.orderings)
+            spine = spine.source
+            continue
+        break
+    # ordering symbols must surface in the root output to re-sort there
+    out_index = {}
+    for i, s in enumerate(plan.symbols):
+        out_index.setdefault(s.name, i)
+    merged: List[Tuple[int, bool, bool]] = []
+    for o in orderings:
+        name = renames.get(o.symbol.name)
+        # the sort may run below the final projection: accept either a spine
+        # rename of the symbol or the symbol itself surviving to the root
+        if name is None and o.symbol.name in out_index:
+            name = o.symbol.name
+        if name is None or name not in out_index:
+            return None
+        merged.append((out_index[name], o.descending, o.nulls_first))
+
+    counts: List[int] = []
+    try:
+        # walk below the spine: spine Sort/TopN/Limit are re-established by
+        # the combiner; any truncation deeper down rejects inside _walk
+        _walk(spine, metadata, counts)
+    except _Reject:
+        return None
+    if not counts or len(set(counts)) != 1:
+        return None
+    n = counts[0]
+    if n < 2:
+        return None
+    return GroupedExecution(n, merged, limit)
+
+
+# ---------------------------------------------------------------------------
+# result merge
+
+def merge_rows(results: Sequence[List[list]], g: GroupedExecution) -> List[list]:
+    """Concatenate per-bucket result rows, re-apply ordering and limit."""
+    rows = [r for res in results for r in res]
+    if g.orderings:
+        # stable sorts applied minor-to-major key; None ordered per
+        # nulls_first with a presence flag so values never compare to None
+        for idx, desc, nulls_first in reversed(g.orderings):
+            # null placement is by the flag alone (not negated by desc);
+            # within non-nulls, desc flips comparisons via _Neg
+            def key(row, _i=idx, _d=desc, _nf=nulls_first):
+                v = row[_i]
+                if v is None:
+                    return (0 if _nf else 1, _NULL)
+                return (1 if _nf else 0, _Neg(v) if _d else _Cmp(v))
+            rows.sort(key=key)
+    if g.limit is not None:
+        rows = rows[:g.limit]
+    return rows
+
+
+class _Cmp:
+    __slots__ = ("v",)
+
+    def __init__(self, v):
+        self.v = v
+
+    def __lt__(self, other):
+        return self.v < other.v
+
+    def __eq__(self, other):
+        return self.v == other.v
+
+
+class _Neg(_Cmp):
+    def __lt__(self, other):
+        return other.v < self.v
+
+
+class _Null:
+    """Compares equal to itself; only ever compared against other _Null
+    instances (the null flag isolates it from real values)."""
+
+    def __lt__(self, other):
+        return False
+
+    def __eq__(self, other):
+        return isinstance(other, _Null)
+
+
+_NULL = _Null()

@@ -207,11 +207,14 @@ class LocalExecutionPlanner:
     def __init__(self, metadata: MetadataManager, session: Session,
                  n_workers: int = 1,
                  remote_dicts: Optional[Dict[int, List[Optional[Dictionary]]]] = None,
-                 devices=None):
+                 devices=None, bucket_filter: Optional[int] = None):
         self.metadata = metadata
         self.session = session
         self.page_capacity = int(session.get("page_capacity"))
         self.n_workers = n_workers
+        # grouped (lifespan) execution: restrict every scan to this bucket's
+        # splits (exec/grouped.py drives one planner per lifespan)
+        self.bucket_filter = bucket_filter
         # worker -> device placement (distributed mode): scans upload worker
         # w's pages to mesh device w so fragment chains stay device-resident
         self.devices = devices
@@ -412,6 +415,8 @@ class LocalExecutionPlanner:
         conn = self.metadata.connector(node.table.connector_id)
         constraint = constraint or Constraint.all()
         splits = conn.split_manager().get_splits(node.table, constraint, 8)
+        if self.bucket_filter is not None:
+            splits = [s for s in splits if s.bucket == self.bucket_filter]
         cols = [c for _, c in node.assignments]
         provider = conn.page_source_provider()
         count = self.n_workers

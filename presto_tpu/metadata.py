@@ -44,6 +44,10 @@ class Session:
         "memory_pool_bytes": 8 << 30,
         "query_max_memory_bytes": 4 << 30,
         "revoke_target_fraction": 0.9,
+        # grouped (lifespan) execution over co-bucketed tables: run the plan
+        # once per bucket so join/agg state is bounded by one bucket's data
+        # (execution/Lifespan.java + StageExecutionDescriptor analogue)
+        "grouped_execution": True,
     }
 
     def get(self, name: str, default=None):

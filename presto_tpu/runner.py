@@ -77,6 +77,9 @@ class LocalQueryRunner:
         if "page_capacity" not in self.session.properties:
             self.session = self.session.with_properties(page_capacity=page_capacity)
         self.parser = SqlParser()
+        # bucket count of the last grouped (lifespan) execution, None if the
+        # last query ran ungrouped — observability for tests and EXPLAIN
+        self.last_grouped: Optional[int] = None
 
     # ------------------------------------------------------------------ api
 
@@ -150,6 +153,7 @@ class LocalQueryRunner:
             walk(stmt)
 
     def execute(self, sql: str, user: Optional[str] = None) -> QueryResult:
+        self.last_grouped = None  # set again on the grouped query path
         stmt = self.parser.parse(sql)
         self._check_access(stmt, user)
         if isinstance(stmt, t.Explain):
@@ -194,6 +198,21 @@ class LocalQueryRunner:
             raise ValueError(f"unsupported statement {type(stmt).__name__}")
 
         plan = self.plan_statement(stmt)
+
+        # grouped (lifespan) execution: co-bucketed scans run one bucket at
+        # a time so join/agg device state is bounded by a single bucket
+        from .exec.grouped import analyze_grouped, merge_rows
+        g = analyze_grouped(plan, self.metadata, self.session)
+        if g is not None:
+            self.last_grouped = g.bucket_count
+            results, names, types = [], None, None
+            for b in range(g.bucket_count):
+                exec_plan, _d, _w = self._run_plan(plan, bucket_filter=b)
+                results.append(exec_plan.sink.rows())
+                names = exec_plan.output_names
+                types = exec_plan.output_types
+            return QueryResult(merge_rows(results, g), names, types)
+
         exec_plan, _drivers, _wall = self._run_plan(plan)
         return QueryResult(exec_plan.sink.rows(), exec_plan.output_names,
                            exec_plan.output_types)
@@ -342,13 +361,14 @@ class LocalQueryRunner:
         total = sum(r[0] for r in count_sink.rows())
         return QueryResult([[total]], ["rows"], [BIGINT])
 
-    def _run_plan(self, plan: OutputNode):
+    def _run_plan(self, plan: OutputNode, bucket_filter=None):
         """Shared execution recipe: local planning + memory wiring + task
         executor. Both execute() and EXPLAIN ANALYZE go through here so the
         profile always measures the pipeline the query actually runs."""
         import time as _time
 
-        local = LocalExecutionPlanner(self.metadata, self.session)
+        local = LocalExecutionPlanner(self.metadata, self.session,
+                                      bucket_filter=bucket_filter)
         local.attach_memory(*self._query_memory())
         exec_plan = local.plan(plan)
         drivers = exec_plan.create_drivers()

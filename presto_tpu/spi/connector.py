@@ -248,6 +248,11 @@ class ConnectorNodePartitioningProvider:
     def bucket_count(self, table: TableHandle) -> Optional[int]:
         return None
 
+    def bucket_columns(self, table: TableHandle) -> Optional[Tuple[str, ...]]:
+        """Ordered column names the bucket hash is computed over, or None.
+        Grouped execution requires them to verify join/grouping alignment."""
+        return None
+
 
 class Connector(abc.ABC):
     """spi/connector/Connector.java:27 — bundle of services for one catalog."""

@@ -1,0 +1,147 @@
+"""Grouped (lifespan) execution over co-bucketed hive tables.
+
+Reference: execution/Lifespan.java:26 + StageExecutionDescriptor.java:33 —
+a stage whose tables are bucketed compatibly executes one bucket at a time,
+bounding join/aggregation state to a single bucket's data. Correctness is
+checked against the ungrouped run and the sqlite oracle; activation is
+observed through runner.last_grouped.
+"""
+import pytest
+
+from presto_tpu.connectors.hive import HiveConnector
+from presto_tpu.runner import LocalQueryRunner
+from presto_tpu.utils.testing import SqliteOracle, assert_rows_equal
+
+
+@pytest.fixture()
+def runner(tmp_path):
+    r = LocalQueryRunner()
+    r.catalogs.register("hive", HiveConnector("hive", str(tmp_path)))
+    # co-bucketed on the join key, same bucket count
+    r.execute(
+        "create table hive.default.ord "
+        "with (bucketed_by = array['o_custkey'], bucket_count = 4) "
+        "as select o_orderkey, o_custkey, o_totalprice from orders")
+    r.execute(
+        "create table hive.default.cust "
+        "with (bucketed_by = array['c_custkey'], bucket_count = 4) "
+        "as select c_custkey, c_name, c_mktsegment from customer")
+    return r
+
+
+@pytest.fixture(scope="module")
+def oracle():
+    o = SqliteOracle()
+    o.load_tpch(0.01, ["orders", "customer"])
+    return o
+
+
+def test_cobucketed_join_runs_grouped(runner, oracle):
+    sql = ("select c_name, o_orderkey from hive.default.ord o "
+           "join hive.default.cust c on o.o_custkey = c.c_custkey "
+           "where o_totalprice > 100000.0")
+    got = runner.execute(sql)
+    assert runner.last_grouped == 4
+    exp = oracle.query(
+        "select c_name, o_orderkey from orders join customer "
+        "on o_custkey = c_custkey where o_totalprice > 100000.0")
+    assert_rows_equal(got.rows, exp)
+
+
+def test_grouped_agg_on_bucket_key(runner, oracle):
+    sql = ("select o_custkey, count(*), sum(o_totalprice) "
+           "from hive.default.ord group by o_custkey")
+    got = runner.execute(sql)
+    assert runner.last_grouped == 4
+    exp = oracle.query(
+        "select o_custkey, count(*), sum(o_totalprice) "
+        "from orders group by o_custkey")
+    assert_rows_equal(got.rows, exp)
+
+
+def test_grouped_topn_merges_across_buckets(runner, oracle):
+    sql = ("select o_custkey, sum(o_totalprice) as total "
+           "from hive.default.ord group by o_custkey "
+           "order by total desc limit 7")
+    got = runner.execute(sql)
+    assert runner.last_grouped == 4
+    exp = oracle.query(
+        "select o_custkey, sum(o_totalprice) as total from orders "
+        "group by o_custkey order by total desc limit 7")
+    assert_rows_equal(got.rows, exp)
+
+
+def test_global_agg_not_grouped(runner, oracle):
+    # count(*) has no group keys -> a group would span buckets -> ungrouped
+    got = runner.execute("select count(*) from hive.default.ord")
+    assert runner.last_grouped is None
+    exp = oracle.query("select count(*) from orders")
+    assert_rows_equal(got.rows, exp)
+
+
+def test_mismatched_bucket_counts_not_grouped(runner, tmp_path):
+    runner.execute(
+        "create table hive.default.cust8 "
+        "with (bucketed_by = array['c_custkey'], bucket_count = 8) "
+        "as select c_custkey, c_name from customer")
+    runner.execute(
+        "select c_name, o_orderkey from hive.default.ord o "
+        "join hive.default.cust8 c on o.o_custkey = c.c_custkey")
+    assert runner.last_grouped is None
+
+
+def test_join_not_on_bucket_key_not_grouped(runner):
+    runner.execute(
+        "select * from hive.default.ord o "
+        "join hive.default.cust c on o.o_orderkey = c.c_custkey")
+    assert runner.last_grouped is None
+
+
+def test_session_flag_disables(runner):
+    runner.session = runner.session.with_properties(grouped_execution=False)
+    runner.execute(
+        "select o_custkey, count(*) from hive.default.ord group by o_custkey")
+    assert runner.last_grouped is None
+
+
+def test_limit_below_agg_not_grouped(runner):
+    # a LIMIT under the aggregation would truncate per bucket, not globally
+    got = runner.execute(
+        "select o_custkey, count(*) from "
+        "(select o_custkey from hive.default.ord limit 10) "
+        "group by o_custkey")
+    assert runner.last_grouped is None
+    assert sum(r[1] for r in got.rows) == 10
+
+
+def test_left_join_null_group_not_split(runner, oracle):
+    # null-extended build rows appear in every bucket; grouping by the
+    # build-side key must not be grouped (one NULL group, not one per bucket)
+    sql = ("select c_custkey, count(*) from hive.default.ord o "
+           "left join hive.default.cust c on o.o_custkey = c.c_custkey "
+           "group by c_custkey")
+    got = runner.execute(sql)
+    assert runner.last_grouped is None
+    exp = oracle.query(
+        "select c_custkey, count(*) from orders left join customer "
+        "on o_custkey = c_custkey group by c_custkey")
+    assert_rows_equal(got.rows, exp)
+
+
+def test_unbucketed_scan_not_grouped(runner):
+    runner.execute("select count(*) from orders where o_custkey > 0")
+    assert runner.last_grouped is None
+
+
+def test_grouped_matches_ungrouped(runner):
+    sql = ("select c_custkey, count(*) as n "
+           "from hive.default.ord o join hive.default.cust c "
+           "on o.o_custkey = c.c_custkey "
+           "group by c_custkey order by n desc, c_custkey limit 11")
+    grouped = runner.execute(sql)
+    assert runner.last_grouped == 4
+    runner.session = runner.session.with_properties(grouped_execution=False)
+    ungrouped = runner.execute(sql)
+    assert runner.last_grouped is None
+    assert [tuple(r) for r in grouped.rows] == \
+        [tuple(r) for r in ungrouped.rows]
