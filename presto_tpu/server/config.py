@@ -59,6 +59,16 @@ def _hive_factory(catalog: str, config: Dict[str, str]):
     return HiveConnector(catalog, base)
 
 
+def _kafka_factory(catalog: str, config: Dict[str, str]):
+    from ..connectors.kafka import KafkaConnector
+
+    base = config.get("kafka.log.dir")
+    if not base:
+        raise ValueError(f"catalog {catalog}: kafka.log.dir is required")
+    return KafkaConnector(catalog, base,
+                          config.get("kafka.default-schema", "default"))
+
+
 def _memory_factory(catalog: str, config: Dict[str, str]):
     from ..connectors.memory import MemoryConnector
 
@@ -90,6 +100,7 @@ FACTORIES: Dict[str, Callable] = {
     "blackhole": _blackhole_factory,
     "file": _file_factory,
     "hive": _hive_factory,
+    "kafka": _kafka_factory,
 }
 
 

@@ -82,6 +82,9 @@ class Field:
     name: Optional[str]
     symbol: Symbol
     qualifier: Optional[str] = None  # table alias / table name
+    # hidden columns (connector internal columns like _partition_offset)
+    # resolve by name but are excluded from SELECT * expansion
+    hidden: bool = False
 
     @property
     def type(self) -> Type:

@@ -214,7 +214,7 @@ class LogicalPlanner:
         for cm in meta.columns:
             sym = self.symbols.new_symbol(cm.name, cm.type)
             assignments.append((sym, columns[cm.name]))
-            fields.append(Field(cm.name, sym, qname.table))
+            fields.append(Field(cm.name, sym, qname.table, hidden=cm.hidden))
         return RelationPlan(TableScanNode(handle, assignments), Scope(fields))
 
     def plan_aliased(self, rel: t.AliasedRelation) -> RelationPlan:
@@ -223,7 +223,7 @@ class LogicalPlanner:
         fields = []
         for i, f in enumerate(inner.scope.fields):
             name = rel.column_names[i].lower() if rel.column_names else f.name
-            fields.append(Field(name, f.symbol, alias))
+            fields.append(Field(name, f.symbol, alias, hidden=f.hidden))
         return RelationPlan(inner.node, Scope(fields))
 
     def plan_values(self, rel: t.Values) -> RelationPlan:
@@ -538,7 +538,7 @@ class LogicalPlanner:
                 q = item.expression.qualifier
                 q = q.lower() if q else None
                 for f in scope.fields:
-                    if q is None or f.qualifier == q:
+                    if (q is None or f.qualifier == q) and not f.hidden:
                         out.append(t.SelectItem(t.Identifier(f.name), f.name))
             else:
                 out.append(item)
