@@ -1,0 +1,501 @@
+"""DB-API connector family: external relational databases as catalogs.
+
+Analogue of presto-base-jdbc (BaseJdbcClient/JdbcMetadata/JdbcSplitManager/
+JdbcRecordSet) plus its concrete drivers (presto-mysql/-postgresql/
+-sqlserver): the generic layer speaks python's DB-API 2.0 instead of JDBC,
+and a DIALECT object supplies what the reference gets from JDBC metadata —
+connection factory, table/column discovery, type mapping, identifier
+quoting. `SqliteDialect` is the built-in concrete driver (stdlib sqlite3,
+the image has no external databases); adding MySQL/Postgres is a dialect,
+not a connector.
+
+Pushdown (BaseJdbcClient.buildSql analogue): column pruning and the
+engine's [lo, hi] constraint domains compile into the remote SELECT's
+column list and WHERE clause, so the external database scans and filters
+before anything crosses into the engine.
+
+Varchar columns get a plan-time dictionary via SELECT DISTINCT (bounded),
+matching the engine's dictionaries-as-metadata contract.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ...block import Block, Dictionary, Page
+from ...types import (BIGINT, DOUBLE, Type, VARCHAR, is_string, parse_type)
+from ...spi.connector import (ColumnHandle, ColumnMetadata, Connector,
+                              ConnectorMetadata, ConnectorPageSink,
+                              ConnectorPageSinkProvider, ConnectorPageSource,
+                              ConnectorPageSourceProvider,
+                              ConnectorSplitManager, Constraint,
+                              SchemaTableName, Split, TableHandle,
+                              TableMetadata, TableStatistics)
+
+MAX_VARCHAR_DICTIONARY = 1 << 20
+
+
+class Dialect:
+    """What a concrete driver provides (the BaseJdbcClient surface)."""
+
+    name = "generic"
+
+    def connect(self):
+        raise NotImplementedError
+
+    def list_schemas(self, conn) -> List[str]:
+        raise NotImplementedError
+
+    def list_tables(self, conn, schema: str) -> List[str]:
+        raise NotImplementedError
+
+    def columns(self, conn, schema: str,
+                table: str) -> List[Tuple[str, Type]]:
+        """-> [(column name, engine type)]"""
+        raise NotImplementedError
+
+    def quote(self, ident: str) -> str:
+        return '"' + ident.replace('"', '""') + '"'
+
+    def qualified(self, schema: str, table: str) -> str:
+        return f"{self.quote(schema)}.{self.quote(table)}"
+
+    def create_table_sql(self, schema: str, table: str,
+                         columns: Sequence[ColumnMetadata]) -> str:
+        defs = ", ".join(
+            f"{self.quote(c.name)} {self.type_to_sql(c.type)}"
+            for c in columns)
+        return f"CREATE TABLE {self.qualified(schema, table)} ({defs})"
+
+    def type_to_sql(self, t: Type) -> str:
+        """Declared SQL type for CTAS — must ROUND-TRIP through the
+        dialect's column-type mapping, or values written in engine
+        substrate units read back corrupted."""
+        from ...types import DecimalType
+        if is_string(t):
+            return "VARCHAR"
+        if t.name in ("double", "real"):
+            return "DOUBLE PRECISION"
+        if isinstance(t, DecimalType):
+            return f"DECIMAL({t.precision},{t.scale})"
+        if t.name == "date":
+            return "DATE"
+        if t.name == "timestamp":
+            return "TIMESTAMP"
+        if t.name == "boolean":
+            return "BOOLEAN"
+        return "BIGINT"
+
+
+class SqliteDialect(Dialect):
+    """Concrete driver over stdlib sqlite3 (the presto-mysql-class role).
+
+    sqlite has no schemas; everything lives in schema 'main' (sqlite's own
+    name for it). Types come from declared column affinities."""
+
+    name = "sqlite"
+
+    def __init__(self, path: str):
+        self.path = path
+
+    def connect(self):
+        import sqlite3
+
+        conn = sqlite3.connect(self.path)
+        conn.row_factory = None
+        return conn
+
+    def list_schemas(self, conn) -> List[str]:
+        return ["main"]
+
+    def list_tables(self, conn, schema: str) -> List[str]:
+        if schema != "main":
+            return []
+        cur = conn.execute(
+            "select name from sqlite_master where type = 'table' "
+            "and name not like 'sqlite_%' order by name")
+        return [r[0] for r in cur.fetchall()]
+
+    def columns(self, conn, schema: str,
+                table: str) -> List[Tuple[str, Type]]:
+        cur = conn.execute(f"PRAGMA table_info({self.quote(table)})")
+        out = []
+        for _cid, name, decl, _notnull, _default, _pk in cur.fetchall():
+            out.append((name.lower(), _affinity_type(decl or "")))
+        return out
+
+    def qualified(self, schema: str, table: str) -> str:
+        return self.quote(table)  # sqlite: no schema qualifier
+
+
+def _affinity_type(decl: str) -> Type:
+    """sqlite's type-affinity rules -> engine types (the JDBC-type-to-presto
+    mapping of BaseJdbcClient.toPrestoType). The declared-type checks must
+    invert Dialect.type_to_sql so CTAS round-trips."""
+    d = decl.upper()
+    if "BOOL" in d:
+        from ...types import BOOLEAN
+        return BOOLEAN
+    if "INT" in d:
+        return BIGINT
+    if any(k in d for k in ("CHAR", "CLOB", "TEXT")):
+        return VARCHAR
+    if any(k in d for k in ("REAL", "FLOA", "DOUB")):
+        return DOUBLE
+    if "DEC" in d or "NUM" in d:
+        try:
+            return parse_type(decl.lower())
+        except ValueError:
+            return DOUBLE
+    if "TIMESTAMP" in d or "TIME" in d:
+        from ...types import TIMESTAMP
+        return TIMESTAMP
+    if "DATE" in d:
+        from ...types import DATE
+        return DATE
+    return VARCHAR  # sqlite's catch-all affinity
+
+
+class DbApiMetadata(ConnectorMetadata):
+    def __init__(self, connector_id: str, dialect: Dialect):
+        self.connector_id = connector_id
+        self.dialect = dialect
+        self._local = threading.local()
+        self._dicts: Dict[Tuple[SchemaTableName, str], Dictionary] = {}
+        self._lock = threading.Lock()
+
+    def _conn(self):
+        conn = getattr(self._local, "conn", None)
+        if conn is None:
+            conn = self._local.conn = self.dialect.connect()
+        return conn
+
+    def list_schemas(self) -> List[str]:
+        return self.dialect.list_schemas(self._conn())
+
+    def list_tables(self, schema: Optional[str] = None) -> List[SchemaTableName]:
+        out = []
+        for s in ([schema] if schema else self.list_schemas()):
+            for t in self.dialect.list_tables(self._conn(), s):
+                out.append(SchemaTableName(s, t))
+        return out
+
+    def get_table_handle(self, name: SchemaTableName) -> Optional[TableHandle]:
+        if name.table in self.dialect.list_tables(self._conn(), name.schema):
+            return TableHandle(self.connector_id, name)
+        return None
+
+    def get_table_metadata(self, table: TableHandle) -> TableMetadata:
+        name = table.schema_table
+        cols = self.dialect.columns(self._conn(), name.schema, name.table)
+        if not cols:
+            raise ValueError(f"no such table {name}")
+        metas = []
+        for cname, ctype in cols:
+            d = None
+            if is_string(ctype):
+                d = self._dictionary(name, cname)
+            metas.append(ColumnMetadata(cname, ctype, dictionary=d))
+        return TableMetadata(name, tuple(metas))
+
+    def _dictionary(self, name: SchemaTableName, column: str) -> Dictionary:
+        """Plan-time dictionary via SELECT DISTINCT (bounded). Cached until
+        an INSERT through this connector invalidates it."""
+        key = (name, column)
+        with self._lock:
+            hit = self._dicts.get(key)
+            if hit is not None:
+                return hit
+        q = self.dialect.qualified(name.schema, name.table)
+        cur = self._conn().execute(
+            f"SELECT DISTINCT {self.dialect.quote(column)} FROM {q} "
+            f"LIMIT {MAX_VARCHAR_DICTIONARY + 1}")
+        vals = [r[0] for r in cur.fetchall() if r[0] is not None]
+        if len(vals) > MAX_VARCHAR_DICTIONARY:
+            raise ValueError(
+                f"varchar column {column!r} of {name} exceeds "
+                f"{MAX_VARCHAR_DICTIONARY} distinct values")
+        d = Dictionary(sorted(str(v) for v in vals))
+        with self._lock:
+            self._dicts[key] = d
+        return d
+
+    def get_table_statistics(self, table: TableHandle,
+                             constraint: Constraint) -> TableStatistics:
+        q = self.dialect.qualified(table.schema_table.schema,
+                                   table.schema_table.table)
+        meta = self.get_table_metadata(table)
+        types = {c.name: c.type for c in meta.columns}
+        where, params = _where_clause(self.dialect, constraint, types)
+        cur = self._conn().execute(
+            f"SELECT COUNT(*) FROM {q}{where}", params)
+        return TableStatistics(row_count=float(cur.fetchone()[0]))
+
+    # --------------------------------------------------------------- writes
+
+    def create_table(self, metadata: TableMetadata, properties=None) -> None:
+        if properties:
+            raise ValueError(f"{self.dialect.name} tables take no properties")
+        name = metadata.name
+        conn = self._conn()
+        conn.execute(self.dialect.create_table_sql(
+            name.schema, name.table, metadata.columns))
+        conn.commit()
+
+    def begin_insert(self, table: TableHandle):
+        return table
+
+    def finish_insert(self, handle, fragments) -> None:
+        with self._lock:  # new rows may add distinct strings
+            self._dicts = {k: v for k, v in self._dicts.items()
+                           if k[0] != handle.schema_table}
+
+    def drop_table(self, table: TableHandle) -> None:
+        conn = self._conn()
+        q = self.dialect.qualified(table.schema_table.schema,
+                                   table.schema_table.table)
+        conn.execute(f"DROP TABLE {q}")
+        conn.commit()
+
+
+def _where_clause(dialect: Dialect, constraint: Constraint,
+                  types: Optional[Dict[str, Type]] = None,
+                  columns: Optional[set] = None) -> Tuple[str, list]:
+    """Constraint domains -> pushed-down WHERE (BaseJdbcClient.buildSql's
+    TupleDomain translation, narrowed to [lo, hi] ranges).
+
+    Domains arrive in the ENGINE's substrate units (scaled decimal ints,
+    date days); the remote database stores native values, so convert per
+    column type. Varchar domains (dictionary codes) never push down."""
+    conds, params = [], []
+    for col, dom in constraint.domains.items():
+        if columns is not None and col not in columns:
+            continue
+        t = types.get(col) if types else None
+        if t is not None and is_string(t):
+            continue
+        lo, hi = dom if isinstance(dom, tuple) else (None, None)
+        if lo is not None:
+            conds.append(f"{dialect.quote(col)} >= ?")
+            params.append(_remote_value(lo, t))
+        if hi is not None:
+            conds.append(f"{dialect.quote(col)} <= ?")
+            params.append(_remote_value(hi, t))
+    return (" WHERE " + " AND ".join(conds) if conds else ""), params
+
+
+def _remote_value(v, t: Optional[Type]):
+    """Engine substrate value -> the remote database's native value."""
+    if t is None:
+        return v
+    from ...types import DecimalType
+    if isinstance(t, DecimalType):
+        return v / (10 ** t.scale)
+    if t.name == "date":
+        import datetime
+        return (datetime.date(1970, 1, 1) +
+                datetime.timedelta(days=int(v))).isoformat()
+    return v
+
+
+class DbApiSplitManager(ConnectorSplitManager):
+    """One split per table (the reference's JdbcSplitManager default: the
+    remote database is the parallelism domain, not the engine)."""
+
+    def __init__(self, connector_id: str):
+        self.connector_id = connector_id
+
+    def get_splits(self, table: TableHandle, constraint: Constraint,
+                   desired_splits: int) -> List[Split]:
+        return [Split(self.connector_id, payload=(table.schema_table,))]
+
+
+class DbApiPageSource(ConnectorPageSource):
+    def __init__(self, metadata: DbApiMetadata, split: Split,
+                 columns: Sequence[ColumnHandle], capacity: int,
+                 constraint: Constraint):
+        self._metadata = metadata
+        self.split = split
+        self.columns = list(columns)
+        self.capacity = capacity
+        self.constraint = constraint
+
+    def __iter__(self) -> Iterator[Page]:
+        name = self.split.payload[0]
+        dialect = self._metadata.dialect
+        meta = self._metadata.get_table_metadata(
+            TableHandle(self._metadata.connector_id, name))
+        if not self.columns:
+            return
+        want = {c.name for c in self.columns}
+        sel = ", ".join(dialect.quote(c.name) for c in self.columns)
+        types = {c.name: c.type for c in meta.columns}
+        where, params = _where_clause(dialect, self.constraint, types, want)
+        q = dialect.qualified(name.schema, name.table)
+        cur = self._metadata._conn().execute(
+            f"SELECT {sel} FROM {q}{where}", params)
+        from ...utils.batching import clamp_capacity
+        cap = self.capacity
+        while True:
+            batch = cur.fetchmany(cap)
+            if not batch:
+                break
+            n = len(batch)
+            bcap = clamp_capacity(n, cap)
+            blocks = []
+            for j, c in enumerate(self.columns):
+                cm = meta.column(c.name)
+                vals = [row[j] for row in batch]
+                blocks.append(_typed_block(cm, vals, bcap))
+            mask = np.arange(bcap) < n
+            yield Page(tuple(blocks), mask)
+
+
+def _typed_block(cm: ColumnMetadata, vals: List[object], cap: int) -> Block:
+    n = len(vals)
+    nulls = None
+    if any(v is None for v in vals):
+        nulls = np.zeros(cap, dtype=bool)
+        nulls[:n] = [v is None for v in vals]
+    if is_string(cm.type):
+        index = cm.dictionary.index() if cm.dictionary is not None else {}
+        codes = np.zeros(cap, dtype=np.int32)
+        for i, v in enumerate(vals):
+            if v is not None:
+                code = index.get(str(v))
+                if code is None:
+                    raise RuntimeError(
+                        f"value {str(v)[:40]!r} missing from the plan-time "
+                        f"dictionary of {cm.name} — table changed mid-query?")
+                codes[i] = code
+        return Block(cm.type, codes, nulls, cm.dictionary)
+    arr = np.zeros(cap, dtype=cm.type.np_dtype)
+    from ...types import DecimalType
+    for i, v in enumerate(vals):
+        if v is None:
+            continue
+        if isinstance(cm.type, DecimalType):
+            from decimal import Decimal
+            arr[i] = int(round(Decimal(str(v)).scaleb(cm.type.scale)))
+        elif cm.type.name == "date" and isinstance(v, str):
+            import datetime
+            d = datetime.date.fromisoformat(v)
+            arr[i] = (d - datetime.date(1970, 1, 1)).days
+        else:
+            arr[i] = v
+    return Block(cm.type, arr, nulls, None)
+
+
+class DbApiPageSourceProvider(ConnectorPageSourceProvider):
+    def __init__(self, metadata: DbApiMetadata):
+        self._metadata = metadata
+
+    def create_page_source(self, split: Split, columns: Sequence[ColumnHandle],
+                           page_capacity: int,
+                           constraint: Constraint = Constraint.all()
+                           ) -> ConnectorPageSource:
+        return DbApiPageSource(self._metadata, split, columns, page_capacity,
+                               constraint)
+
+
+class DbApiPageSink(ConnectorPageSink):
+    """INSERT batches through executemany; ONE transaction, committed at
+    finish() so a failed multi-page insert leaves nothing behind
+    (JdbcPageSink's commit discipline)."""
+
+    def __init__(self, metadata: DbApiMetadata, table: TableHandle):
+        self._metadata = metadata
+        self._table = table
+        self._meta = metadata.get_table_metadata(table)  # fixed for the sink
+        self.rows_written = 0
+
+    def append_page(self, page: Page) -> None:
+        import jax
+
+        host = jax.device_get(page)
+        meta = self._meta
+        mask = np.asarray(host.mask)
+        live = np.flatnonzero(mask)
+        if len(live) == 0:
+            return
+        cols = []
+        for b, cm in zip(host.blocks, meta.columns):
+            data = np.asarray(b.data)[live]
+            nulls = np.asarray(b.nulls)[live] if b.nulls is not None else None
+            if b.dictionary is not None:
+                strs = b.dictionary.lookup(data)
+                vals = [None if (nulls is not None and nulls[i]) or s is None
+                        else str(s) for i, s in enumerate(strs)]
+            else:
+                vals = [None if nulls is not None and nulls[i]
+                        else cm.type.to_python(x)
+                        for i, x in enumerate(data.tolist())]
+            cols.append(vals)
+        rows = list(zip(*cols))
+        dialect = self._metadata.dialect
+        name = self._table.schema_table
+        q = dialect.qualified(name.schema, name.table)
+        holes = ", ".join("?" for _ in meta.columns)
+        conn = self._metadata._conn()
+        conn.executemany(f"INSERT INTO {q} VALUES ({holes})",
+                         [tuple(_plain(v) for v in r) for r in rows])
+        self.rows_written += len(rows)
+
+    def finish(self):
+        self._metadata._conn().commit()
+        return []
+
+    def abort(self) -> None:
+        try:
+            self._metadata._conn().rollback()
+        except Exception:
+            pass
+
+
+def _plain(v):
+    """DB-API parameter-friendly python value."""
+    if hasattr(v, "isoformat"):
+        return v.isoformat()
+    if type(v).__name__ == "Decimal":
+        return float(v)
+    if isinstance(v, (np.integer,)):
+        return int(v)
+    if isinstance(v, (np.floating,)):
+        return float(v)
+    if isinstance(v, np.bool_):
+        return bool(v)
+    return v
+
+
+class DbApiPageSinkProvider(ConnectorPageSinkProvider):
+    def __init__(self, metadata: DbApiMetadata):
+        self._metadata = metadata
+
+    def create_page_sink(self, insert_handle) -> ConnectorPageSink:
+        return DbApiPageSink(self._metadata, insert_handle)
+
+
+class DbApiConnector(Connector):
+    def __init__(self, connector_id: str, dialect: Dialect):
+        self._metadata = DbApiMetadata(connector_id, dialect)
+        self._splits = DbApiSplitManager(connector_id)
+        self._sources = DbApiPageSourceProvider(self._metadata)
+        self._sinks = DbApiPageSinkProvider(self._metadata)
+
+    def metadata(self) -> ConnectorMetadata:
+        return self._metadata
+
+    def split_manager(self) -> ConnectorSplitManager:
+        return self._splits
+
+    def page_source_provider(self) -> ConnectorPageSourceProvider:
+        return self._sources
+
+    def page_sink_provider(self) -> Optional[ConnectorPageSinkProvider]:
+        return self._sinks
+
+
+def sqlite_connector(connector_id: str, path: str) -> DbApiConnector:
+    return DbApiConnector(connector_id, SqliteDialect(path))

@@ -57,14 +57,11 @@ class TopicDescription:
         self.fields = fields
 
     @staticmethod
-    def load(path: str, default_schema: str) -> "TopicDescription":
+    def load(path: str, name: SchemaTableName) -> "TopicDescription":
+        """`name` is resolved by the caller (KafkaMetadata._descriptions is
+        the single owner of the basename -> schema.table rule)."""
         with open(path) as f:
             doc = json.load(f)
-        base = os.path.basename(path)[: -len(".json")]
-        if "." in base:
-            schema, table = base.split(".", 1)
-        else:
-            schema, table = default_schema, base
         msg = doc.get("message", {})
         fields = []
         for e in msg.get("fields", []):
@@ -80,8 +77,7 @@ class TopicDescription:
         decoder = create_row_decoder(msg.get("dataFormat", "json"), fields,
                                      **opts)
         return TopicDescription(
-            SchemaTableName(schema, table),
-            doc.get("topic", table), decoder, fields)
+            name, doc.get("topic", name.table), decoder, fields)
 
 
 class _TopicData:
@@ -139,10 +135,6 @@ class KafkaMetadata(ConnectorMetadata):
             return TableHandle(self.connector_id, name)
         return None
 
-    def description(self, name: SchemaTableName) -> TopicDescription:
-        path = self._descriptions()[name]
-        return TopicDescription.load(path, self.default_schema)
-
     # -------------------------------------------------------------- decode
 
     def _log_files(self, topic: str) -> List[Tuple[int, str]]:
@@ -160,7 +152,7 @@ class KafkaMetadata(ConnectorMetadata):
 
     def topic_data(self, name: SchemaTableName) -> _TopicData:
         desc_path = self._descriptions()[name]
-        desc = TopicDescription.load(desc_path, self.default_schema)
+        desc = TopicDescription.load(desc_path, name)
         files = self._log_files(desc.topic)
         sig = (os.path.getmtime(desc_path),) + tuple(
             (p, f, os.path.getmtime(f), os.path.getsize(f))

@@ -53,19 +53,46 @@ def _extract_constraint(filter_parts, scan: TableScanNode) -> Constraint:
     needs). Values are the engine's substrate ints (scaled decimals, date
     days, dictionary codes for equality on sorted dictionaries are NOT
     extracted — only numeric columns)."""
-    from ..ops.expressions import Call, Constant, InputRef
+    import math
 
-    col_of = {i: col.name for i, (_s, col) in enumerate(scan.assignments)}
+    from ..ops.expressions import Call, Constant, InputRef
+    from ..types import DecimalType
+
+    cols = {i: col for i, (_s, col) in enumerate(scan.assignments)}
     domains: Dict[str, List] = {}
 
-    def note(ch: int, lo, hi):
-        name = col_of.get(ch)
-        if name is None:
+    def to_substrate(v, vt, ct, is_lo: bool):
+        """Convert a constant from ITS representation (scaled decimal int,
+        date days, float) into the COLUMN's substrate units, widening
+        non-exact conversions outward (pruning must over-approximate).
+
+        Integer paths use exact integer arithmetic — float round-trips
+        above 2^53 could NARROW a domain and silently drop rows."""
+        if is_string(ct):
+            return v  # dictionary code compare: units already match
+        s_from = vt.scale if isinstance(vt, DecimalType) else 0
+        s_to = ct.scale if isinstance(ct, DecimalType) else 0
+        if ct.name in ("double", "real"):
+            return float(v) / (10 ** s_from) if s_from else float(v)
+        if isinstance(v, int):
+            if s_to >= s_from:
+                return v * 10 ** (s_to - s_from)
+            q, r = divmod(v, 10 ** (s_from - s_to))  # // floors negatives
+            return q if (is_lo or r == 0) else q + 1
+        # float constant -> integral substrate: widen outward
+        real = v * (10 ** (s_to - s_from)) if s_to != s_from else v
+        return math.floor(real) if is_lo else math.ceil(real)
+
+    def note(ch: int, lo, hi, vt):
+        col = cols.get(ch)
+        if col is None:
             return
-        cur = domains.setdefault(name, [None, None])
+        cur = domains.setdefault(col.name, [None, None])
         if lo is not None:
+            lo = to_substrate(lo, vt, col.type, True)
             cur[0] = lo if cur[0] is None else max(cur[0], lo)
         if hi is not None:
+            hi = to_substrate(hi, vt, col.type, False)
             cur[1] = hi if cur[1] is None else min(cur[1], hi)
 
     for part in filter_parts:
@@ -90,14 +117,17 @@ def _extract_constraint(filter_parts, scan: TableScanNode) -> Constraint:
             continue
         # the +-1 strict-bound tightening is only sound on integral
         # substrates; float constants keep the inclusive bound (pruning must
-        # over-approximate, never drop satisfying files)
+        # over-approximate, never drop satisfying files). It runs in the
+        # CONSTANT's units; note() converts to the column's substrate after.
         step = 1 if isinstance(v, int) else 0
         if name == "equal":
-            note(a.channel, v, v)
+            note(a.channel, v, v, b.type)
         elif name in ("less_than", "less_than_or_equal"):
-            note(a.channel, None, v - (step if name == "less_than" else 0))
+            note(a.channel, None, v - (step if name == "less_than" else 0),
+                 b.type)
         elif name in ("greater_than", "greater_than_or_equal"):
-            note(a.channel, v + (step if name == "greater_than" else 0), None)
+            note(a.channel, v + (step if name == "greater_than" else 0),
+                 None, b.type)
     return Constraint({k: tuple(v) for k, v in domains.items()}) \
         if domains else Constraint.all()
 

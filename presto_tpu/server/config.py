@@ -59,6 +59,15 @@ def _hive_factory(catalog: str, config: Dict[str, str]):
     return HiveConnector(catalog, base)
 
 
+def _sqlite_factory(catalog: str, config: Dict[str, str]):
+    from ..connectors.dbapi import sqlite_connector
+
+    path = config.get("sqlite.path")
+    if not path:
+        raise ValueError(f"catalog {catalog}: sqlite.path is required")
+    return sqlite_connector(catalog, path)
+
+
 def _kafka_factory(catalog: str, config: Dict[str, str]):
     from ..connectors.kafka import KafkaConnector
 
@@ -101,6 +110,7 @@ FACTORIES: Dict[str, Callable] = {
     "file": _file_factory,
     "hive": _hive_factory,
     "kafka": _kafka_factory,
+    "sqlite": _sqlite_factory,
 }
 
 

@@ -117,7 +117,13 @@ def _coerce(f: DecoderField, v) -> object:
                     dt = datetime.datetime.strptime(v.strip(), f.date_format)
                 else:
                     dt = datetime.datetime.fromisoformat(v.strip())
-                epoch = datetime.datetime(1970, 1, 1)
+                # aware timestamps (RFC3339 'Z'/offset, the producer norm)
+                # need an aware epoch; naive ones a naive epoch
+                if dt.tzinfo is not None:
+                    epoch = datetime.datetime(
+                        1970, 1, 1, tzinfo=datetime.timezone.utc)
+                else:
+                    epoch = datetime.datetime(1970, 1, 1)
                 return int((dt - epoch).total_seconds() * 1000)
             return int(v)
         if isinstance(t, DecimalType):
