@@ -79,7 +79,12 @@ def serialize_columns(datas: Sequence[np.ndarray],
             body, codec = z, "zlib1"
     header = json.dumps({"rows": nrows, "cols": cols_meta,
                          "codec": codec}).encode()
-    return MAGIC + struct.pack("<I", len(header)) + header + body
+    frame = MAGIC + struct.pack("<I", len(header)) + header + body
+    from ..utils.metrics import METRICS
+    METRICS.count("exchange.frames")
+    METRICS.count("exchange.bytes", len(frame))
+    METRICS.count("exchange.rows", nrows)
+    return frame
 
 
 def serialize_pages(pages: Sequence[Page], types: Sequence[Type],

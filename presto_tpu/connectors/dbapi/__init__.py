@@ -79,7 +79,10 @@ class Dialect:
         if t.name in ("double", "real"):
             return "DOUBLE PRECISION"
         if isinstance(t, DecimalType):
-            return f"DECIMAL({t.precision},{t.scale})"
+            # DECINT carries INTEGER affinity in sqlite, so the UNSCALED
+            # int64 substrate stores exactly (a float/NUMERIC column would
+            # corrupt >2^53 decimals); _affinity_type inverts it
+            return f"DECINT({t.precision},{t.scale})"
         if t.name == "date":
             return "DATE"
         if t.name == "timestamp":
@@ -103,7 +106,10 @@ class SqliteDialect(Dialect):
     def connect(self):
         import sqlite3
 
-        conn = sqlite3.connect(self.path)
+        # one shared connection serialized by DbApiMetadata's lock; the
+        # engine's task executor migrates drivers across threads, so
+        # sqlite's same-thread check must be off
+        conn = sqlite3.connect(self.path, check_same_thread=False)
         conn.row_factory = None
         return conn
 
@@ -135,6 +141,11 @@ def _affinity_type(decl: str) -> Type:
     mapping of BaseJdbcClient.toPrestoType). The declared-type checks must
     invert Dialect.type_to_sql so CTAS round-trips."""
     d = decl.upper()
+    if d.startswith("DECINT"):
+        inner = d[len("DECINT"):].strip("() ")
+        p_, s_ = (int(x) for x in inner.split(","))
+        from ...types import DecimalType
+        return DecimalType(p_, s_)
     if "BOOL" in d:
         from ...types import BOOLEAN
         return BOOLEAN
@@ -162,34 +173,44 @@ class DbApiMetadata(ConnectorMetadata):
     def __init__(self, connector_id: str, dialect: Dialect):
         self.connector_id = connector_id
         self.dialect = dialect
-        self._local = threading.local()
         self._dicts: Dict[Tuple[SchemaTableName, str], Dictionary] = {}
         self._lock = threading.Lock()
+        # ONE shared connection + RLock: the task executor migrates drivers
+        # across threads and the sink's commit must see the pages inserted
+        # from pool threads — per-thread connections would commit nothing
+        self._conn_obj = None
+        self.conn_lock = threading.RLock()
 
     def _conn(self):
-        conn = getattr(self._local, "conn", None)
-        if conn is None:
-            conn = self._local.conn = self.dialect.connect()
-        return conn
+        with self.conn_lock:
+            if self._conn_obj is None:
+                self._conn_obj = self.dialect.connect()
+            return self._conn_obj
 
     def list_schemas(self) -> List[str]:
-        return self.dialect.list_schemas(self._conn())
+        with self.conn_lock:
+            return self.dialect.list_schemas(self._conn())
 
     def list_tables(self, schema: Optional[str] = None) -> List[SchemaTableName]:
         out = []
         for s in ([schema] if schema else self.list_schemas()):
-            for t in self.dialect.list_tables(self._conn(), s):
+            with self.conn_lock:
+                tables = self.dialect.list_tables(self._conn(), s)
+            for t in tables:
                 out.append(SchemaTableName(s, t))
         return out
 
     def get_table_handle(self, name: SchemaTableName) -> Optional[TableHandle]:
-        if name.table in self.dialect.list_tables(self._conn(), name.schema):
+        with self.conn_lock:
+            tables = self.dialect.list_tables(self._conn(), name.schema)
+        if name.table in tables:
             return TableHandle(self.connector_id, name)
         return None
 
     def get_table_metadata(self, table: TableHandle) -> TableMetadata:
         name = table.schema_table
-        cols = self.dialect.columns(self._conn(), name.schema, name.table)
+        with self.conn_lock:
+            cols = self.dialect.columns(self._conn(), name.schema, name.table)
         if not cols:
             raise ValueError(f"no such table {name}")
         metas = []
@@ -209,10 +230,11 @@ class DbApiMetadata(ConnectorMetadata):
             if hit is not None:
                 return hit
         q = self.dialect.qualified(name.schema, name.table)
-        cur = self._conn().execute(
-            f"SELECT DISTINCT {self.dialect.quote(column)} FROM {q} "
-            f"LIMIT {MAX_VARCHAR_DICTIONARY + 1}")
-        vals = [r[0] for r in cur.fetchall() if r[0] is not None]
+        with self.conn_lock:
+            cur = self._conn().execute(
+                f"SELECT DISTINCT {self.dialect.quote(column)} FROM {q} "
+                f"LIMIT {MAX_VARCHAR_DICTIONARY + 1}")
+            vals = [r[0] for r in cur.fetchall() if r[0] is not None]
         if len(vals) > MAX_VARCHAR_DICTIONARY:
             raise ValueError(
                 f"varchar column {column!r} of {name} exceeds "
@@ -229,9 +251,10 @@ class DbApiMetadata(ConnectorMetadata):
         meta = self.get_table_metadata(table)
         types = {c.name: c.type for c in meta.columns}
         where, params = _where_clause(self.dialect, constraint, types)
-        cur = self._conn().execute(
-            f"SELECT COUNT(*) FROM {q}{where}", params)
-        return TableStatistics(row_count=float(cur.fetchone()[0]))
+        with self.conn_lock:
+            cur = self._conn().execute(
+                f"SELECT COUNT(*) FROM {q}{where}", params)
+            return TableStatistics(row_count=float(cur.fetchone()[0]))
 
     # --------------------------------------------------------------- writes
 
@@ -239,10 +262,14 @@ class DbApiMetadata(ConnectorMetadata):
         if properties:
             raise ValueError(f"{self.dialect.name} tables take no properties")
         name = metadata.name
-        conn = self._conn()
-        conn.execute(self.dialect.create_table_sql(
-            name.schema, name.table, metadata.columns))
-        conn.commit()
+        with self.conn_lock:
+            conn = self._conn()
+            conn.execute(self.dialect.create_table_sql(
+                name.schema, name.table, metadata.columns))
+            conn.commit()
+        with self._lock:  # a recreated table must not see stale dictionaries
+            self._dicts = {k: v for k, v in self._dicts.items()
+                           if k[0] != name}
 
     def begin_insert(self, table: TableHandle):
         return table
@@ -253,11 +280,15 @@ class DbApiMetadata(ConnectorMetadata):
                            if k[0] != handle.schema_table}
 
     def drop_table(self, table: TableHandle) -> None:
-        conn = self._conn()
         q = self.dialect.qualified(table.schema_table.schema,
                                    table.schema_table.table)
-        conn.execute(f"DROP TABLE {q}")
-        conn.commit()
+        with self.conn_lock:
+            conn = self._conn()
+            conn.execute(f"DROP TABLE {q}")
+            conn.commit()
+        with self._lock:
+            self._dicts = {k: v for k, v in self._dicts.items()
+                           if k[0] != table.schema_table}
 
 
 def _where_clause(dialect: Dialect, constraint: Constraint,
@@ -274,7 +305,13 @@ def _where_clause(dialect: Dialect, constraint: Constraint,
         if columns is not None and col not in columns:
             continue
         t = types.get(col) if types else None
-        if t is not None and is_string(t):
+        from ...types import DecimalType
+        if t is not None and (
+                is_string(t) or isinstance(t, DecimalType) or
+                t.name == "timestamp"):
+            # varchar domains are dictionary codes; decimal/timestamp
+            # remote representations are ambiguous (DECINT vs NUMERIC,
+            # text vs epoch) — the engine-side filter refines instead
             continue
         lo, hi = dom if isinstance(dom, tuple) else (None, None)
         if lo is not None:
@@ -288,12 +325,7 @@ def _where_clause(dialect: Dialect, constraint: Constraint,
 
 def _remote_value(v, t: Optional[Type]):
     """Engine substrate value -> the remote database's native value."""
-    if t is None:
-        return v
-    from ...types import DecimalType
-    if isinstance(t, DecimalType):
-        return v / (10 ** t.scale)
-    if t.name == "date":
+    if t is not None and t.name == "date":
         import datetime
         return (datetime.date(1970, 1, 1) +
                 datetime.timedelta(days=int(v))).isoformat()
@@ -334,14 +366,21 @@ class DbApiPageSource(ConnectorPageSource):
         types = {c.name: c.type for c in meta.columns}
         where, params = _where_clause(dialect, self.constraint, types, want)
         q = dialect.qualified(name.schema, name.table)
-        cur = self._metadata._conn().execute(
-            f"SELECT {sel} FROM {q}{where}", params)
         from ...utils.batching import clamp_capacity
         cap = self.capacity
-        while True:
-            batch = cur.fetchmany(cap)
-            if not batch:
-                break
+        # fetch fully under the shared-connection lock: the cursor must not
+        # interleave with writers on other executor threads, and yielding
+        # mid-cursor while holding the lock could deadlock the query
+        with self._metadata.conn_lock:
+            cur = self._metadata._conn().execute(
+                f"SELECT {sel} FROM {q}{where}", params)
+            batches = []
+            while True:
+                batch = cur.fetchmany(cap)
+                if not batch:
+                    break
+                batches.append(batch)
+        for batch in batches:
             n = len(batch)
             bcap = clamp_capacity(n, cap)
             blocks = []
@@ -377,12 +416,22 @@ def _typed_block(cm: ColumnMetadata, vals: List[object], cap: int) -> Block:
         if v is None:
             continue
         if isinstance(cm.type, DecimalType):
-            from decimal import Decimal
-            arr[i] = int(round(Decimal(str(v)).scaleb(cm.type.scale)))
+            if isinstance(v, int):
+                arr[i] = v  # DECINT column: value IS the unscaled substrate
+            else:  # external NUMERIC/REAL decimal column: real-world value
+                from decimal import Decimal
+                arr[i] = int(round(Decimal(str(v)).scaleb(cm.type.scale)))
         elif cm.type.name == "date" and isinstance(v, str):
             import datetime
             d = datetime.date.fromisoformat(v)
             arr[i] = (d - datetime.date(1970, 1, 1)).days
+        elif cm.type.name == "timestamp" and isinstance(v, str):
+            import datetime
+            dt = datetime.datetime.fromisoformat(v)
+            epoch = datetime.datetime(
+                1970, 1, 1,
+                tzinfo=dt.tzinfo and datetime.timezone.utc)
+            arr[i] = int((dt - epoch).total_seconds() * 1000)
         else:
             arr[i] = v
     return Block(cm.type, arr, nulls, None)
@@ -429,27 +478,36 @@ class DbApiPageSink(ConnectorPageSink):
                 vals = [None if (nulls is not None and nulls[i]) or s is None
                         else str(s) for i, s in enumerate(strs)]
             else:
-                vals = [None if nulls is not None and nulls[i]
-                        else cm.type.to_python(x)
-                        for i, x in enumerate(data.tolist())]
+                from ...types import DecimalType
+                if isinstance(cm.type, DecimalType):
+                    # DECINT columns persist the unscaled int exactly
+                    vals = [None if nulls is not None and nulls[i] else int(x)
+                            for i, x in enumerate(data.tolist())]
+                else:
+                    vals = [None if nulls is not None and nulls[i]
+                            else cm.type.to_python(x)
+                            for i, x in enumerate(data.tolist())]
             cols.append(vals)
         rows = list(zip(*cols))
         dialect = self._metadata.dialect
         name = self._table.schema_table
         q = dialect.qualified(name.schema, name.table)
         holes = ", ".join("?" for _ in meta.columns)
-        conn = self._metadata._conn()
-        conn.executemany(f"INSERT INTO {q} VALUES ({holes})",
-                         [tuple(_plain(v) for v in r) for r in rows])
+        with self._metadata.conn_lock:
+            self._metadata._conn().executemany(
+                f"INSERT INTO {q} VALUES ({holes})",
+                [tuple(_plain(v) for v in r) for r in rows])
         self.rows_written += len(rows)
 
     def finish(self):
-        self._metadata._conn().commit()
+        with self._metadata.conn_lock:
+            self._metadata._conn().commit()
         return []
 
     def abort(self) -> None:
         try:
-            self._metadata._conn().rollback()
+            with self._metadata.conn_lock:
+                self._metadata._conn().rollback()
         except Exception:
             pass
 

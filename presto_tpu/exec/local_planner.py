@@ -61,39 +61,54 @@ def _extract_constraint(filter_parts, scan: TableScanNode) -> Constraint:
     cols = {i: col for i, (_s, col) in enumerate(scan.assignments)}
     domains: Dict[str, List] = {}
 
-    def to_substrate(v, vt, ct, is_lo: bool):
-        """Convert a constant from ITS representation (scaled decimal int,
-        date days, float) into the COLUMN's substrate units, widening
-        non-exact conversions outward (pruning must over-approximate).
+    def bound(v, vt, ct, kind: str):
+        """Constant in ITS representation (scaled decimal int, date days,
+        float) -> a domain bound in the COLUMN's substrate units.
 
+        kind: lo_ge | lo_gt | hi_le | hi_lt — strict bounds tighten AFTER
+        the exact conversion (tightening in the constant's coarser scale
+        then upscaling would narrow the domain and drop satisfying rows).
         Integer paths use exact integer arithmetic — float round-trips
-        above 2^53 could NARROW a domain and silently drop rows."""
+        above 2^53 could likewise narrow a domain."""
         if is_string(ct):
-            return v  # dictionary code compare: units already match
-        s_from = vt.scale if isinstance(vt, DecimalType) else 0
-        s_to = ct.scale if isinstance(ct, DecimalType) else 0
-        if ct.name in ("double", "real"):
-            return float(v) / (10 ** s_from) if s_from else float(v)
-        if isinstance(v, int):
-            if s_to >= s_from:
-                return v * 10 ** (s_to - s_from)
-            q, r = divmod(v, 10 ** (s_from - s_to))  # // floors negatives
-            return q if (is_lo or r == 0) else q + 1
-        # float constant -> integral substrate: widen outward
-        real = v * (10 ** (s_to - s_from)) if s_to != s_from else v
-        return math.floor(real) if is_lo else math.ceil(real)
+            fl = cl = v  # dictionary code compare: units already match
+            exact = True
+        else:
+            s_from = vt.scale if isinstance(vt, DecimalType) else 0
+            s_to = ct.scale if isinstance(ct, DecimalType) else 0
+            if ct.name in ("double", "real"):
+                # continuous substrate: strict bounds stay inclusive
+                # (over-approximation, the engine filter refines)
+                return float(v) / (10 ** s_from) if s_from else float(v)
+            if isinstance(v, int):
+                if s_to >= s_from:
+                    fl = cl = v * 10 ** (s_to - s_from)
+                    exact = True
+                else:
+                    q, r = divmod(v, 10 ** (s_from - s_to))  # // floors
+                    fl, cl, exact = q, q + (1 if r else 0), r == 0
+            else:
+                x = v * (10 ** (s_to - s_from)) if s_to != s_from else v
+                fl, cl = math.floor(x), math.ceil(x)
+                exact = fl == cl
+        if kind == "hi_le":
+            return fl
+        if kind == "hi_lt":
+            return fl - 1 if exact else fl
+        if kind == "lo_ge":
+            return cl
+        return cl + 1 if exact else cl  # lo_gt
 
-    def note(ch: int, lo, hi, vt):
+    def note(ch: int, kind: str, v, vt):
         col = cols.get(ch)
         if col is None:
             return
         cur = domains.setdefault(col.name, [None, None])
-        if lo is not None:
-            lo = to_substrate(lo, vt, col.type, True)
-            cur[0] = lo if cur[0] is None else max(cur[0], lo)
-        if hi is not None:
-            hi = to_substrate(hi, vt, col.type, False)
-            cur[1] = hi if cur[1] is None else min(cur[1], hi)
+        b = bound(v, vt, col.type, kind)
+        if kind.startswith("lo"):
+            cur[0] = b if cur[0] is None else max(cur[0], b)
+        else:
+            cur[1] = b if cur[1] is None else min(cur[1], b)
 
     for part in filter_parts:
         if not isinstance(part, Call) or len(part.args) != 2:
@@ -115,19 +130,17 @@ def _extract_constraint(filter_parts, scan: TableScanNode) -> Constraint:
         v = b.value
         if v is None or isinstance(v, str):
             continue
-        # the +-1 strict-bound tightening is only sound on integral
-        # substrates; float constants keep the inclusive bound (pruning must
-        # over-approximate, never drop satisfying files). It runs in the
-        # CONSTANT's units; note() converts to the column's substrate after.
-        step = 1 if isinstance(v, int) else 0
         if name == "equal":
-            note(a.channel, v, v, b.type)
-        elif name in ("less_than", "less_than_or_equal"):
-            note(a.channel, None, v - (step if name == "less_than" else 0),
-                 b.type)
-        elif name in ("greater_than", "greater_than_or_equal"):
-            note(a.channel, v + (step if name == "greater_than" else 0),
-                 None, b.type)
+            note(a.channel, "lo_ge", v, b.type)
+            note(a.channel, "hi_le", v, b.type)
+        elif name == "less_than":
+            note(a.channel, "hi_lt", v, b.type)
+        elif name == "less_than_or_equal":
+            note(a.channel, "hi_le", v, b.type)
+        elif name == "greater_than":
+            note(a.channel, "lo_gt", v, b.type)
+        elif name == "greater_than_or_equal":
+            note(a.channel, "lo_ge", v, b.type)
     return Constraint({k: tuple(v) for k, v in domains.items()}) \
         if domains else Constraint.all()
 

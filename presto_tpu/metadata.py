@@ -48,6 +48,10 @@ class Session:
         # once per bucket so join/agg state is bounded by one bucket's data
         # (execution/Lifespan.java + StageExecutionDescriptor analogue)
         "grouped_execution": True,
+        # scaled writers: INSERT/CTAS fan out over K parallel writer drivers
+        # (one sink file each) when the source is at least K * this many rows
+        "scaled_writers": True,
+        "writer_min_rows_per_driver": 1 << 20,
     }
 
     def get(self, name: str, default=None):

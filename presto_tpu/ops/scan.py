@@ -88,6 +88,13 @@ class _ResidentPageCache:
 
 RESIDENT_CACHE = _ResidentPageCache()
 
+from ..utils.metrics import METRICS as _METRICS  # noqa: E402
+
+_METRICS.set_gauge("scan.resident_cache_bytes",
+                   lambda: RESIDENT_CACHE._bytes)
+_METRICS.set_gauge("scan.resident_cache_streams",
+                   lambda: len(RESIDENT_CACHE._pages))
+
 
 def _widen_page(page: Page) -> Page:
     """Device-side upcast of narrow wire blocks to their declared dtypes."""

@@ -343,9 +343,28 @@ class LocalQueryRunner:
             writer_fac = TableWriterOperatorFactory(9000, sink_provider,
                                                     insert_handle)
         count_sink = PageConsumerFactory(9001, [BIGINT])
-        # swap the result consumer for writer -> row-count consumer
-        exec_plan.pipelines[-1] = exec_plan.pipelines[-1][:-1] + \
-            [writer_fac, count_sink]
+        # scaled writers (reference parallelism axis #9,
+        # execution/scheduler/ScaledWriterScheduler.java narrowed to the
+        # local tier): a large source fans out over K parallel writer
+        # drivers behind a local exchange, each with its own sink file —
+        # small writes keep ONE writer so they don't shatter into K files
+        n_writers = self._scaled_writer_count(plan)
+        if n_writers > 1:
+            from .ops.local_exchange import (LocalExchangeFactory,
+                                             LocalExchangeSinkFactory,
+                                             LocalExchangeSourceFactory)
+            lx = LocalExchangeFactory(n_producers=1,
+                                      max_pages=2 * n_writers + 2)
+            exec_plan.pipelines[-1] = exec_plan.pipelines[-1][:-1] + \
+                [LocalExchangeSinkFactory(9002, lx, [])]
+            for _ in range(n_writers):
+                exec_plan.pipelines.append(
+                    [LocalExchangeSourceFactory(9003, lx, []),
+                     writer_fac, count_sink])
+        else:
+            # swap the result consumer for writer -> row-count consumer
+            exec_plan.pipelines[-1] = exec_plan.pipelines[-1][:-1] + \
+                [writer_fac, count_sink]
         drivers = exec_plan.create_drivers()
         try:
             TaskExecutor(
@@ -360,6 +379,20 @@ class LocalQueryRunner:
         meta.finish_insert(insert_handle, fragments)
         total = sum(r[0] for r in count_sink.rows())
         return QueryResult([[total]], ["rows"], [BIGINT])
+
+    def _scaled_writer_count(self, plan: OutputNode) -> int:
+        """K parallel writer drivers when the write is big enough that K
+        sink files each stay above writer_min_rows_per_driver."""
+        if not self.session.get("scaled_writers"):
+            return 1
+        try:
+            from .sql.planner.optimizer import estimate_rows
+            est = estimate_rows(plan.source, self.metadata)
+        except Exception:
+            return 1
+        per_driver = int(self.session.get("writer_min_rows_per_driver"))
+        cap = int(self.session.get("task_concurrency"))
+        return max(1, min(cap, int(est // max(per_driver, 1))))
 
     def _run_plan(self, plan: OutputNode, bucket_filter=None):
         """Shared execution recipe: local planning + memory wiring + task

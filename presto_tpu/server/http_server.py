@@ -175,6 +175,13 @@ class _Handler(BaseHTTPRequestHandler):
                            "failureRatio": round(n.failure_ratio, 3)}
                           for n in (nodes.all_nodes() if nodes else [])],
             })
+        if self.path.rstrip("/").startswith("/v1/metrics"):
+            # JMX-analogue: flat counters/gauges as JSON; optional
+            # /v1/metrics/<prefix> filters like an mbean-name lookup
+            from ..utils.metrics import METRICS
+
+            prefix = self.path.rstrip("/")[len("/v1/metrics"):].lstrip("/")
+            return self._send_json(METRICS.snapshot(prefix))
         if self.path.rstrip("/") == "/v1/query":
             return self._send_json([self._query_json(q)
                                     for q in self.manager.list_queries()])

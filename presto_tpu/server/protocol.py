@@ -99,6 +99,8 @@ class QueryManager:
 
             self.monitor.query_created(
                 QueryCreatedEvent(qid, sql, user=user, source=source))
+        from ..utils.metrics import METRICS
+        METRICS.count("query_manager.submitted")
         threading.Thread(target=self._run, args=(info,), daemon=True).start()
         return info
 
@@ -192,6 +194,9 @@ class QueryManager:
                                 for i, n in enumerate(result.column_names)]
                 info.state = FINISHED
                 info.end_time = time.time()
+            from ..utils.metrics import METRICS
+            METRICS.count("query_manager.completed")
+            METRICS.count("query_manager.output_rows", len(rows))
         except Exception as e:  # noqa: BLE001 - reported through the protocol
             with self._lock:
                 info.error = {
@@ -201,6 +206,8 @@ class QueryManager:
                 }
                 info.state = FAILED
                 info.end_time = time.time()
+            from ..utils.metrics import METRICS
+            METRICS.count("query_manager.failed")
         finally:
             if tx is not None:
                 self.transactions.abort(tx)

@@ -438,8 +438,15 @@ class ExpressionTranslator:
     def _t_Cast(self, e: t.Cast) -> RowExpression:
         target = type_from_name(e.type)
         inner = self.translate(e.expression)
-        if isinstance(inner, Constant) and target is DATE and is_string(inner.type):
-            return Constant(DATE, _parse_date(inner.value))
+        if isinstance(inner, Constant) and is_string(inner.type):
+            if target is DATE:
+                return Constant(DATE, _parse_date(inner.value))
+            if isinstance(target, DecimalType):
+                # exact string -> scaled-int constant (a runtime CAST from
+                # a dictionary code cannot recover the digits)
+                from decimal import Decimal
+                v = Decimal(str(inner.value).strip()).scaleb(target.scale)
+                return Constant(target, int(v))
         return cast_to(inner, target)
 
     def _t_Extract(self, e: t.Extract) -> RowExpression:

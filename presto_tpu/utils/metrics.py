@@ -1,0 +1,66 @@
+"""Process-wide metrics registry + snapshot — the JMX/airlift-stats analogue.
+
+The reference exposes engine internals as JMX MBeans (queried over
+/v1/jmx/mbean/... and scraped by dashboards); here a flat registry of
+counters and gauges serves the same role, exported as JSON at
+``/v1/metrics`` on every server (server/http_server.py).
+
+- ``counter(name)``: monotonically increasing int, incremented by the
+  instrumented code paths (query lifecycle, exchange bytes, kernel-cache
+  hits, spills).
+- ``gauge(name, fn)``: a callable sampled at snapshot time (memory pool
+  reservation, resident-cache bytes).
+
+Names are dotted ``<component>.<metric>`` strings; everything is
+process-local (each worker serves its own /v1/metrics, exactly like
+per-node JMX)."""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict
+
+
+class MetricsRegistry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: Dict[str, float] = {}
+        self._gauges: Dict[str, Callable[[], float]] = {}
+        self._start = time.time()
+
+    def count(self, name: str, delta: float = 1) -> None:
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0) + delta
+
+    def set_gauge(self, name: str, fn: Callable[[], float]) -> None:
+        with self._lock:
+            self._gauges[name] = fn
+
+    def counter_value(self, name: str) -> float:
+        with self._lock:
+            return self._counters.get(name, 0)
+
+    def snapshot(self, prefix: str = "") -> Dict[str, float]:
+        """-> {name: value}; `prefix` filters (the mbean-name lookup)."""
+        with self._lock:
+            out = {k: v for k, v in self._counters.items()
+                   if k.startswith(prefix)}
+            gauges = [(k, fn) for k, fn in self._gauges.items()
+                      if k.startswith(prefix)]
+        for k, fn in gauges:
+            try:
+                out[k] = fn()
+            except Exception:
+                out[k] = None
+        if not prefix or "uptime".startswith(prefix):
+            out["uptime_seconds"] = round(time.time() - self._start, 1)
+        return out
+
+    def reset(self) -> None:
+        """Test hook."""
+        with self._lock:
+            self._counters.clear()
+            self._gauges.clear()
+
+
+METRICS = MetricsRegistry()

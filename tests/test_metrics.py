@@ -1,0 +1,76 @@
+"""Metrics registry + /v1/metrics endpoint (the JMX analogue).
+
+Reference: the reference exposes engine internals as JMX MBeans scraped
+over HTTP; here a process-wide registry of counters/gauges serves JSON at
+/v1/metrics with prefix filtering.
+"""
+import json
+import urllib.request
+
+from presto_tpu.utils.metrics import MetricsRegistry, METRICS
+
+
+def test_registry_counters_and_gauges():
+    r = MetricsRegistry()
+    r.count("a.x")
+    r.count("a.x", 2)
+    r.count("b.y", 5)
+    r.set_gauge("a.g", lambda: 42)
+    snap = r.snapshot()
+    assert snap["a.x"] == 3 and snap["b.y"] == 5 and snap["a.g"] == 42
+    assert "uptime_seconds" in snap
+    only_a = r.snapshot("a.")
+    assert set(only_a) == {"a.x", "a.g"}
+
+
+def test_gauge_error_is_null_not_crash():
+    r = MetricsRegistry()
+    r.set_gauge("bad", lambda: 1 / 0)
+    assert r.snapshot()["bad"] is None
+
+
+def test_query_lifecycle_counters_and_endpoint():
+    from presto_tpu.metadata import Session
+    from presto_tpu.runner import LocalQueryRunner
+    from presto_tpu.server import PrestoTpuServer
+
+    runner = LocalQueryRunner(session=Session(catalog="tpch", schema="tiny"))
+    server = PrestoTpuServer(runner, port=0)
+    server.start()
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+        before = METRICS.counter_value("query_manager.completed")
+
+        # run one query through the wire protocol
+        req = urllib.request.Request(
+            f"{base}/v1/statement", data=b"select 1",
+            headers={"X-Presto-User": "test"})
+        resp = json.loads(urllib.request.urlopen(req, timeout=10).read())
+        next_uri = resp.get("nextUri")
+        for _ in range(200):
+            if next_uri is None:
+                break
+            resp = json.loads(urllib.request.urlopen(
+                urllib.request.Request(
+                    next_uri, headers={"X-Presto-User": "test"}),
+                timeout=10).read())
+            next_uri = resp.get("nextUri")
+            if resp.get("stats", {}).get("state") in ("FINISHED", "FAILED"):
+                if next_uri is None:
+                    break
+
+        snap = json.loads(urllib.request.urlopen(
+            urllib.request.Request(
+                f"{base}/v1/metrics", headers={"X-Presto-User": "test"}),
+            timeout=10).read())
+        assert snap["query_manager.submitted"] >= 1
+        assert snap["query_manager.completed"] >= before + 1
+        # prefix filtering (mbean-name lookup analogue)
+        only = json.loads(urllib.request.urlopen(
+            urllib.request.Request(
+                f"{base}/v1/metrics/query_manager",
+                headers={"X-Presto-User": "test"}),
+            timeout=10).read())
+        assert all(k.startswith("query_manager") for k in only)
+    finally:
+        server.stop()
