@@ -15,6 +15,8 @@ import urllib.error
 import urllib.request
 from typing import Iterator, List, Optional, Sequence
 
+import numpy as np
+
 from ..block import Dictionary, Page
 from ..spi.connector import ConnectorPageSource
 from ..types import Type
@@ -145,3 +147,103 @@ class StreamingRemoteSource(ConnectorPageSource):
         for c in self.clients:
             if not c.complete:
                 c.finished_ack()
+
+
+class MergingRemoteSource(ConnectorPageSource):
+    """N-way merge over per-producer LOCALLY-SORTED streams — the HTTP-tier
+    distributed sort (operator/MergeOperator.java + MergeSortedPages): each
+    upstream task sorted its own rows (plan_subplan inserts the local
+    SortNode under MERGE outputs), so the consumer only heap-merges K
+    ordered streams instead of re-sorting the full row set.
+
+    `orderings`: [(channel, descending, nulls_first)]; varchar channels
+    compare by dictionary rank (Dictionary.sort_keys), exactly like the
+    engine's sort operators."""
+
+    def __init__(self, locations: Sequence[str], buffer_id: int,
+                 types: Sequence[Type],
+                 dicts: Sequence[Optional[Dictionary]],
+                 page_capacity: int,
+                 orderings: Sequence[tuple],
+                 cancelled: Optional[threading.Event] = None):
+        self.locations = list(locations)
+        self.buffer_id = buffer_id
+        self.types = list(types)
+        self.dicts = list(dicts)
+        self.page_capacity = page_capacity
+        self.orderings = list(orderings)
+        self.cancelled = cancelled
+
+    def _row_iter(self, location: str):
+        """-> (sort key, row values tuple, row nulls tuple) per live row."""
+        from ..exec.grouped import _Cmp, _Neg, _Null
+
+        _NULLV = _Null()
+        ranks = {}
+        for ch, _d, _nf in self.orderings:
+            d = self.dicts[ch]
+            if d is not None and hasattr(d, "sort_keys"):
+                ranks[ch] = np.asarray(d.sort_keys())
+        src = StreamingRemoteSource([location], self.buffer_id, self.types,
+                                    self.dicts, self.page_capacity,
+                                    cancelled=self.cancelled)
+        for page in src:
+            mask = np.asarray(page.mask)
+            datas = [np.asarray(b.data) for b in page.blocks]
+            nulls = [None if b.nulls is None else np.asarray(b.nulls)
+                     for b in page.blocks]
+            for i in np.flatnonzero(mask):
+                key = []
+                for ch, desc, nf in self.orderings:
+                    isnull = nulls[ch] is not None and nulls[ch][i]
+                    if isnull:
+                        key.append((0 if nf else 1, _NULLV))
+                    else:
+                        v = datas[ch][i]
+                        if ch in ranks:
+                            v = ranks[ch][int(v)]
+                        key.append((1 if nf else 0,
+                                    _Neg(v) if desc else _Cmp(v)))
+                yield (tuple(key),
+                       tuple(d[i] for d in datas),
+                       tuple(False if n is None else bool(n[i])
+                             for n in nulls))
+
+    def __iter__(self) -> Iterator[Page]:
+        import heapq
+
+        from ..block import Block, Page as _Page
+
+        merged = heapq.merge(*(self._row_iter(loc) for loc in self.locations),
+                             key=lambda t: t[0])
+        ncols = len(self.types)
+        buf_vals: List[list] = [[] for _ in range(ncols)]
+        buf_nulls: List[list] = [[] for _ in range(ncols)]
+        n = 0
+
+        def flush():
+            blocks = []
+            for c in range(ncols):
+                data = np.asarray(buf_vals[c],
+                                  dtype=self.types[c].np_dtype)
+                nm = np.asarray(buf_nulls[c], dtype=bool)
+                blocks.append(Block(self.types[c], data,
+                                    nm if nm.any() else None,
+                                    self.dicts[c]))
+            return _Page(tuple(blocks), np.ones(n, dtype=bool))
+
+        for _key, vals, nls in merged:
+            for c in range(ncols):
+                buf_vals[c].append(vals[c])
+                buf_nulls[c].append(nls[c])
+            n += 1
+            if n >= self.page_capacity:
+                yield flush()
+                buf_vals = [[] for _ in range(ncols)]
+                buf_nulls = [[] for _ in range(ncols)]
+                n = 0
+        if n:
+            yield flush()
+
+    def close(self) -> None:
+        pass

@@ -109,23 +109,65 @@ def plan_subplan(subplan: SubPlan, metadata: MetadataManager, session: Session,
     own metadata — schema (types + dictionary identities) is a plan-time
     property agreed by construction, so neither types nor dictionaries ever
     ride the wire (the reference ships block encodings instead)."""
+    from ..sql.planner.plan import MERGE, SortNode
+
+    merge_frags = {f.id: f for f in subplan.fragments
+                   if f.output_kind == MERGE and f.output_orderings}
     frag_dicts: Dict[int, List[Optional[Dictionary]]] = {}
     plans = {}
     for frag in subplan.fragments:
+        # consumer half first: a Sort directly over a MERGE remote source is
+        # the N-way merge — drop the full re-sort, record the merge spec so
+        # the task wires a MergingRemoteSource into the slot
+        merge_slots: Dict[int, list] = {}
+        body = _strip_merge_sorts(frag.root, merge_frags, merge_slots)
+        if frag.id in merge_frags:
+            # producer half of the distributed sort: each task sorts ITS
+            # rows locally so the consumer can heap-merge streams instead
+            # of re-sorting everything (MergeOperator.java's contract)
+            body = SortNode(body, list(frag.output_orderings))
         if frag is subplan.root_fragment:
-            root = OutputNode(frag.root, subplan.column_names,
+            root = OutputNode(body, subplan.column_names,
                               subplan.output_symbols)
         else:
-            syms = frag.root.outputs()
-            root = OutputNode(frag.root, [s.name for s in syms], syms)
+            syms = body.outputs()
+            root = OutputNode(body, [s.name for s in syms], syms)
         lp = LocalExecutionPlanner(metadata, session,
                                    n_workers=task_counts.get(frag.id, 1),
                                    remote_dicts=frag_dicts)
         sf = sink_factory if frag.id == target_fragment_id else None
         ep = lp.plan(root, sink_factory=sf)
+        for fid, orderings in merge_slots.items():
+            slot = lp.remote_slots.get(fid)
+            if slot is not None:
+                producer_syms = merge_frags[fid].root.outputs()
+                names = [s.name for s in producer_syms]
+                slot.merge_orderings = [
+                    (names.index(o.symbol.name), o.descending, o.nulls_first)
+                    for o in orderings]
         frag_dicts[frag.id] = ep.output_dicts
         plans[frag.id] = (lp, ep)
     return plans
+
+
+def _strip_merge_sorts(node, merge_frags, out: Dict[int, list]):
+    """Replace SortNode(RemoteSourceNode(fid)) with the bare remote source
+    when fragment fid's output is MERGE (its tasks pre-sorted), recording
+    the orderings per fragment id."""
+    from ..sql.planner.plan import RemoteSourceNode, SortNode
+
+    if isinstance(node, SortNode) and \
+            isinstance(node.source, RemoteSourceNode) and \
+            node.source.fragment_id in merge_frags:
+        out[node.source.fragment_id] = list(node.orderings)
+        return node.source
+    children = node.children()
+    if not children:
+        return node
+    new_children = [_strip_merge_sorts(c, merge_frags, out) for c in children]
+    if all(a is b for a, b in zip(children, new_children)):
+        return node
+    return node.with_children(new_children)
 
 
 class TaskOutputOperator(Operator):
@@ -316,7 +358,16 @@ class SqlTask:
             dicts = plans[fid][1].output_dicts
             types = [s.type for s in self._producer_outputs(fid)]
 
-            def factory(worker, _locs=locations, _t=types, _d=dicts):
+            merge = getattr(slot, "merge_orderings", None)
+
+            def factory(worker, _locs=locations, _t=types, _d=dicts,
+                        _m=merge):
+                if _m:
+                    from .exchange_client import MergingRemoteSource
+
+                    return MergingRemoteSource(
+                        _locs, req.worker_index, _t, _d, page_cap, _m,
+                        cancelled=self.cancelled)
                 return StreamingRemoteSource(
                     _locs, req.worker_index, _t, _d, page_cap,
                     cancelled=self.cancelled)

@@ -52,8 +52,13 @@ class Dialect:
         raise NotImplementedError
 
     def columns(self, conn, schema: str,
-                table: str) -> List[Tuple[str, Type]]:
-        """-> [(column name, engine type)]"""
+                table: str) -> List[Tuple[str, Type, bool]]:
+        """-> [(column name, engine type, raw_substrate)].
+
+        `raw_substrate` marks columns that store the ENGINE's substrate
+        representation directly (e.g. sqlite DECINT columns holding the
+        unscaled decimal int) vs the remote database's native values —
+        the read and write paths convert accordingly."""
         raise NotImplementedError
 
     def quote(self, ident: str) -> str:
@@ -125,11 +130,13 @@ class SqliteDialect(Dialect):
         return [r[0] for r in cur.fetchall()]
 
     def columns(self, conn, schema: str,
-                table: str) -> List[Tuple[str, Type]]:
+                table: str) -> List[Tuple[str, Type, bool]]:
         cur = conn.execute(f"PRAGMA table_info({self.quote(table)})")
         out = []
         for _cid, name, decl, _notnull, _default, _pk in cur.fetchall():
-            out.append((name.lower(), _affinity_type(decl or "")))
+            d = (decl or "").upper()
+            out.append((name.lower(), _affinity_type(decl or ""),
+                        d.startswith("DECINT")))
         return out
 
     def qualified(self, schema: str, table: str) -> str:
@@ -214,12 +221,19 @@ class DbApiMetadata(ConnectorMetadata):
         if not cols:
             raise ValueError(f"no such table {name}")
         metas = []
-        for cname, ctype in cols:
+        for cname, ctype, _raw in cols:
             d = None
             if is_string(ctype):
                 d = self._dictionary(name, cname)
             metas.append(ColumnMetadata(cname, ctype, dictionary=d))
         return TableMetadata(name, tuple(metas))
+
+    def substrate_columns(self, name: SchemaTableName) -> set:
+        """Column names whose remote storage IS the engine substrate
+        (engine-created DECINT); external decimal columns convert."""
+        with self.conn_lock:
+            cols = self.dialect.columns(self._conn(), name.schema, name.table)
+        return {cname for cname, _t, raw in cols if raw}
 
     def _dictionary(self, name: SchemaTableName, column: str) -> Dictionary:
         """Plan-time dictionary via SELECT DISTINCT (bounded). Cached until
@@ -368,31 +382,32 @@ class DbApiPageSource(ConnectorPageSource):
         q = dialect.qualified(name.schema, name.table)
         from ...utils.batching import clamp_capacity
         cap = self.capacity
-        # fetch fully under the shared-connection lock: the cursor must not
-        # interleave with writers on other executor threads, and yielding
-        # mid-cursor while holding the lock could deadlock the query
+        substrate = self._metadata.substrate_columns(name)
+        # one batch per lock acquisition: streaming stays O(batch) in memory
+        # and writers on other executor threads interleave between batches
+        # (DB-API allows multiple live statements on one connection)
         with self._metadata.conn_lock:
             cur = self._metadata._conn().execute(
                 f"SELECT {sel} FROM {q}{where}", params)
-            batches = []
-            while True:
+        while True:
+            with self._metadata.conn_lock:
                 batch = cur.fetchmany(cap)
-                if not batch:
-                    break
-                batches.append(batch)
-        for batch in batches:
+            if not batch:
+                break
             n = len(batch)
             bcap = clamp_capacity(n, cap)
             blocks = []
             for j, c in enumerate(self.columns):
                 cm = meta.column(c.name)
                 vals = [row[j] for row in batch]
-                blocks.append(_typed_block(cm, vals, bcap))
+                blocks.append(_typed_block(cm, vals, bcap,
+                                           c.name in substrate))
             mask = np.arange(bcap) < n
             yield Page(tuple(blocks), mask)
 
 
-def _typed_block(cm: ColumnMetadata, vals: List[object], cap: int) -> Block:
+def _typed_block(cm: ColumnMetadata, vals: List[object], cap: int,
+                 raw_substrate: bool = False) -> Block:
     n = len(vals)
     nulls = None
     if any(v is None for v in vals):
@@ -416,9 +431,11 @@ def _typed_block(cm: ColumnMetadata, vals: List[object], cap: int) -> Block:
         if v is None:
             continue
         if isinstance(cm.type, DecimalType):
-            if isinstance(v, int):
-                arr[i] = v  # DECINT column: value IS the unscaled substrate
-            else:  # external NUMERIC/REAL decimal column: real-world value
+            if raw_substrate:
+                arr[i] = int(v)  # DECINT column: value IS the substrate
+            else:
+                # external decimal column: real-world value, whatever
+                # storage class sqlite gave it (int 5 for 5.00, float 5.25)
                 from decimal import Decimal
                 arr[i] = int(round(Decimal(str(v)).scaleb(cm.type.scale)))
         elif cm.type.name == "date" and isinstance(v, str):
@@ -458,6 +475,7 @@ class DbApiPageSink(ConnectorPageSink):
         self._metadata = metadata
         self._table = table
         self._meta = metadata.get_table_metadata(table)  # fixed for the sink
+        self._substrate = metadata.substrate_columns(table.schema_table)
         self.rows_written = 0
 
     def append_page(self, page: Page) -> None:
@@ -479,11 +497,13 @@ class DbApiPageSink(ConnectorPageSink):
                         else str(s) for i, s in enumerate(strs)]
             else:
                 from ...types import DecimalType
-                if isinstance(cm.type, DecimalType):
+                if isinstance(cm.type, DecimalType) and \
+                        cm.name in self._substrate:
                     # DECINT columns persist the unscaled int exactly
                     vals = [None if nulls is not None and nulls[i] else int(x)
                             for i, x in enumerate(data.tolist())]
                 else:
+                    # external columns get the remote-native value
                     vals = [None if nulls is not None and nulls[i]
                             else cm.type.to_python(x)
                             for i, x in enumerate(data.tolist())]

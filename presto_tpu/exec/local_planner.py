@@ -202,6 +202,10 @@ class RemoteSourceSlot:
         # cluster mode plugs a streaming HTTP source in here (callable
         # worker -> ConnectorPageSource); default is the deposited-pages replay
         self.source_factory = None
+        # set by plan_subplan for MERGE inputs: [(channel, desc, nulls_first)]
+        # — the cluster task wires a MergingRemoteSource instead of the
+        # interleaving StreamingRemoteSource
+        self.merge_orderings = None
 
     def set_pages(self, worker: int, pages: List[Page]) -> None:
         self._pages_by_worker[worker] = list(pages)

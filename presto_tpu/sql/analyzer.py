@@ -443,9 +443,15 @@ class ExpressionTranslator:
                 return Constant(DATE, _parse_date(inner.value))
             if isinstance(target, DecimalType):
                 # exact string -> scaled-int constant (a runtime CAST from
-                # a dictionary code cannot recover the digits)
-                from decimal import Decimal
-                v = Decimal(str(inner.value).strip()).scaleb(target.scale)
+                # a dictionary code cannot recover the digits); HALF_UP
+                # like the engine's runtime decimal rounding
+                from decimal import (Decimal, InvalidOperation, ROUND_HALF_UP)
+                try:
+                    v = Decimal(str(inner.value).strip()).scaleb(
+                        target.scale).quantize(Decimal(1), ROUND_HALF_UP)
+                except InvalidOperation:
+                    raise SemanticError(
+                        f"cannot cast {inner.value!r} to {target}")
                 return Constant(target, int(v))
         return cast_to(inner, target)
 

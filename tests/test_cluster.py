@@ -132,6 +132,12 @@ CLUSTER_QUERIES = [
     "where l_quantity < 24",
     # order by + limit through the gather
     "select c_name, c_acctbal from customer order by c_acctbal desc limit 7",
+    # ORDER BY without LIMIT: the distributed merge path — each worker
+    # sorts locally, the consumer N-way merges the sorted streams
+    # (MergeOperator.java analogue; plan_subplan + MergingRemoteSource)
+    "select o_orderkey, o_totalprice from orders "
+    "where o_totalprice > 150000.0 order by o_totalprice desc, o_orderkey",
+    "select n_name, n_regionkey from nation order by n_name",
 ]
 
 
