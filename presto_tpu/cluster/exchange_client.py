@@ -173,6 +173,7 @@ class MergingRemoteSource(ConnectorPageSource):
         self.page_capacity = page_capacity
         self.orderings = list(orderings)
         self.cancelled = cancelled
+        self._inner: List[StreamingRemoteSource] = []
 
     def _row_iter(self, location: str):
         """-> (sort key, row values tuple, row nulls tuple) per live row."""
@@ -187,6 +188,7 @@ class MergingRemoteSource(ConnectorPageSource):
         src = StreamingRemoteSource([location], self.buffer_id, self.types,
                                     self.dicts, self.page_capacity,
                                     cancelled=self.cancelled)
+        self._inner.append(src)
         for page in src:
             mask = np.asarray(page.mask)
             datas = [np.asarray(b.data) for b in page.blocks]
@@ -246,4 +248,8 @@ class MergingRemoteSource(ConnectorPageSource):
             yield flush()
 
     def close(self) -> None:
-        pass
+        # release producer-side buffers promptly on cancellation: an
+        # unclosed stream would leave producers parked in OutputBuffer
+        # backpressure until its timeout
+        for src in self._inner:
+            src.close()

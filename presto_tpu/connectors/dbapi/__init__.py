@@ -181,6 +181,7 @@ class DbApiMetadata(ConnectorMetadata):
         self.connector_id = connector_id
         self.dialect = dialect
         self._dicts: Dict[Tuple[SchemaTableName, str], Dictionary] = {}
+        self._substrate: Dict[SchemaTableName, set] = {}
         self._lock = threading.Lock()
         # ONE shared connection + RLock: the task executor migrates drivers
         # across threads and the sink's commit must see the pages inserted
@@ -230,10 +231,19 @@ class DbApiMetadata(ConnectorMetadata):
 
     def substrate_columns(self, name: SchemaTableName) -> set:
         """Column names whose remote storage IS the engine substrate
-        (engine-created DECINT); external decimal columns convert."""
+        (engine-created DECINT); external decimal columns convert. Cached —
+        every scan and sink asks — and invalidated with the dictionaries
+        on create/drop."""
+        with self._lock:
+            hit = self._substrate.get(name)
+        if hit is not None:
+            return hit
         with self.conn_lock:
             cols = self.dialect.columns(self._conn(), name.schema, name.table)
-        return {cname for cname, _t, raw in cols if raw}
+        out = {cname for cname, _t, raw in cols if raw}
+        with self._lock:
+            self._substrate[name] = out
+        return out
 
     def _dictionary(self, name: SchemaTableName, column: str) -> Dictionary:
         """Plan-time dictionary via SELECT DISTINCT (bounded). Cached until
@@ -284,6 +294,7 @@ class DbApiMetadata(ConnectorMetadata):
         with self._lock:  # a recreated table must not see stale dictionaries
             self._dicts = {k: v for k, v in self._dicts.items()
                            if k[0] != name}
+            self._substrate.pop(name, None)
 
     def begin_insert(self, table: TableHandle):
         return table
@@ -303,6 +314,7 @@ class DbApiMetadata(ConnectorMetadata):
         with self._lock:
             self._dicts = {k: v for k, v in self._dicts.items()
                            if k[0] != table.schema_table}
+            self._substrate.pop(table.schema_table, None)
 
 
 def _where_clause(dialect: Dialect, constraint: Constraint,
@@ -383,17 +395,22 @@ class DbApiPageSource(ConnectorPageSource):
         from ...utils.batching import clamp_capacity
         cap = self.capacity
         substrate = self._metadata.substrate_columns(name)
-        # one batch per lock acquisition: streaming stays O(batch) in memory
-        # and writers on other executor threads interleave between batches
-        # (DB-API allows multiple live statements on one connection)
+        # the whole result set is fetched under ONE lock hold: releasing
+        # between batches lets a writer on the SAME shared connection
+        # interleave, and the open cursor then observes its rows mid-scan
+        # (verified: INSERT INTO t SELECT FROM t would re-read its own
+        # inserts). Snapshot semantics beat O(batch) memory here; a remote
+        # dialect with real per-connection isolation can stream.
         with self._metadata.conn_lock:
             cur = self._metadata._conn().execute(
                 f"SELECT {sel} FROM {q}{where}", params)
-        while True:
-            with self._metadata.conn_lock:
-                batch = cur.fetchmany(cap)
-            if not batch:
-                break
+            batches = []
+            while True:
+                b = cur.fetchmany(cap)
+                if not b:
+                    break
+                batches.append(b)
+        for batch in batches:
             n = len(batch)
             bcap = clamp_capacity(n, cap)
             blocks = []

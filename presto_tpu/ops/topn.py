@@ -53,6 +53,12 @@ def _sort_key_arrays(page: Page, orders: Sequence[SortOrder]) -> Tuple[jnp.ndarr
                     f"ORDER BY over non-monotonic virtual dictionary {d!r}")
         if x.dtype == jnp.bool_:
             x = x.astype(jnp.int32)
+        if b.nulls is not None:
+            # neutralize the undefined payload under a null so null rows
+            # order by the REMAINING sort keys (ties among nulls break on
+            # the next ORDER BY column, matching the N-way merge comparator
+            # in cluster/exchange_client.py MergingRemoteSource)
+            x = jnp.where(b.nulls, jnp.zeros((), dtype=x.dtype), x)
         if o.descending:
             x = -x
         keys.append(x)

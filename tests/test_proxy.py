@@ -1,0 +1,73 @@
+"""Statement-protocol proxy (presto-proxy analogue): URI rewriting, header
+pass-through, backend errors surfaced as 502."""
+import json
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from presto_tpu.metadata import Session
+from presto_tpu.runner import LocalQueryRunner
+from presto_tpu.server import PrestoTpuServer
+from presto_tpu.server.proxy import ProxyServer
+
+
+@pytest.fixture(scope="module")
+def stack():
+    runner = LocalQueryRunner(session=Session(catalog="tpch", schema="tiny"))
+    srv = PrestoTpuServer(runner, port=0, page_rows=5)
+    srv.start()
+    proxy = ProxyServer(f"http://127.0.0.1:{srv.port}", port=0).start()
+    yield proxy
+    proxy.stop()
+    srv.stop()
+
+
+def _fetch(url, method="GET", data=None):
+    req = urllib.request.Request(url, data=data, method=method,
+                                 headers={"X-Presto-User": "proxied"})
+    with urllib.request.urlopen(req, timeout=15) as resp:
+        return json.loads(resp.read())
+
+
+def test_statement_through_proxy_rewrites_uris(stack):
+    base = f"http://127.0.0.1:{stack.port}"
+    resp = _fetch(f"{base}/v1/statement", method="POST",
+                  data=b"select n_name from nation where n_regionkey = 2 "
+                       b"order by n_name")
+    rows = list(resp.get("data") or [])
+    deadline = time.time() + 120
+    while resp.get("nextUri"):
+        # every URI the client sees must point at the PROXY
+        assert resp["nextUri"].startswith(base), resp["nextUri"]
+        resp = _fetch(resp["nextUri"])
+        rows.extend(resp.get("data") or [])
+        assert time.time() < deadline, "query did not finish through proxy"
+        if resp.get("stats", {}).get("state") == "QUEUED":
+            time.sleep(0.05)
+    assert [r[0] for r in rows] == ["CHINA", "INDIA", "INDONESIA", "JAPAN",
+                                    "VIETNAM"]
+
+
+def test_proxy_passes_info(stack):
+    base = f"http://127.0.0.1:{stack.port}"
+    info = _fetch(f"{base}/v1/info")
+    assert "nodeVersion" in info
+
+
+def test_proxy_404_outside_api(stack):
+    base = f"http://127.0.0.1:{stack.port}"
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _fetch(f"{base}/etc/passwd")
+    assert e.value.code == 404
+
+
+def test_proxy_backend_down_is_502():
+    proxy = ProxyServer("http://127.0.0.1:1", port=0).start()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _fetch(f"http://127.0.0.1:{proxy.port}/v1/info")
+        assert e.value.code == 502
+    finally:
+        proxy.stop()

@@ -1,0 +1,105 @@
+"""Validate the TPC-H generator against the specification's shape.
+
+The engine's generator (connectors/tpch/generator.py) is deliberately NOT
+dbgen-bit-compatible (correctness is proven against a sqlite oracle over
+the same data, and the CPU baseline shares the generator so benchmark
+ratios are fair). What MUST match the spec for the benchmark numbers to
+mean anything is the WORKLOAD SHAPE: per-table row counts (spec §4.2.5)
+and the selectivities of the north-star query predicates. This tool
+measures both and prints spec-vs-measured deltas; the results are recorded
+in BASELINE.md.
+
+Run: JAX_PLATFORMS=cpu python tools/tpch_spec_check.py [--schema sf0.1]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def main(argv=None) -> None:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from presto_tpu.metadata import Session
+    from presto_tpu.runner import LocalQueryRunner
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--schema", default="tiny")
+    args = ap.parse_args(argv)
+    sf = float(args.schema.replace("sf", "")) if args.schema != "tiny" \
+        else 0.01
+
+    r = LocalQueryRunner(session=Session(catalog="tpch", schema=args.schema))
+
+    def one(sql: str) -> float:
+        return float(r.execute(sql).rows[0][0])
+
+    report = {"schema": args.schema, "sf": sf, "row_counts": {},
+              "selectivities": {}}
+
+    # --- spec §4.2.5 table cardinalities (lineitem is approximate: spec
+    # says ~6M * SF with per-order variance)
+    spec_rows = {
+        "nation": 25, "region": 5,
+        "supplier": round(10_000 * sf), "customer": round(150_000 * sf),
+        "part": round(200_000 * sf), "partsupp": round(800_000 * sf),
+        "orders": round(1_500_000 * sf),
+        "lineitem": round(6_001_215 * sf),
+    }
+    for table, want in spec_rows.items():
+        got = one(f"select count(*) from {table}")
+        delta = (got - want) / want if want else 0.0
+        report["row_counts"][table] = {
+            "spec": want, "measured": int(got),
+            "delta_pct": round(100 * delta, 2)}
+
+    # --- north-star predicate selectivities (expected per spec comments /
+    # the reference's published plans; tolerance is the point of recording)
+    sels = {
+        # Q6: date year window * discount band (3 of 11 values) * qty < 24
+        "q6_lineitem": (
+            "select count(*) from lineitem where l_shipdate >= date "
+            "'1994-01-01' and l_shipdate < date '1995-01-01' and "
+            "l_discount between 0.05 and 0.07 and l_quantity < 24",
+            "lineitem", 0.019),
+        # Q1: ship date <= 1998-09-02 (all but the last ~90 days of 7 years)
+        "q1_lineitem": (
+            "select count(*) from lineitem where l_shipdate <= "
+            "date '1998-09-02'", "lineitem", 0.9862),
+        # Q3: orders before 1995-03-15 (~half the 7-year window)
+        "q3_orders": (
+            "select count(*) from orders where o_orderdate < "
+            "date '1995-03-15'", "orders", 0.4848),
+        # Q3: lineitems shipped after 1995-03-15
+        "q3_lineitem": (
+            "select count(*) from lineitem where l_shipdate > "
+            "date '1995-03-15'", "lineitem", 0.5373),
+        # Q3: one of 5 market segments
+        "q3_customer": (
+            "select count(*) from customer where c_mktsegment = 'BUILDING'",
+            "customer", 0.20),
+        # Q5: one region of 5
+        "q5_region_customers": (
+            "select count(*) from customer, nation, region "
+            "where c_nationkey = n_nationkey and n_regionkey = r_regionkey "
+            "and r_name = 'ASIA'", "customer", 0.20),
+    }
+    totals = {t: one(f"select count(*) from {t}")
+              for t in ("lineitem", "orders", "customer")}
+    for name, (sql, table, want) in sels.items():
+        got = one(sql) / totals[table]
+        report["selectivities"][name] = {
+            "spec": want, "measured": round(got, 4),
+            "delta_pct": round(100 * (got - want) / want, 2)}
+
+    print(json.dumps(report, indent=2))
+
+
+if __name__ == "__main__":
+    main()
