@@ -22,6 +22,16 @@ import jax as _jax
 # kernels deliberately stay in 32-bit — see ops/).
 _jax.config.update("jax_enable_x64", True)
 
+# The JAX_PLATFORMS env var must WIN: site-level customization (e.g. the
+# axon tunnel's sitecustomize) writes jax_platforms directly into jax's
+# config at interpreter start, which silently overrides the operator's
+# explicit environment. A server launched with JAX_PLATFORMS=cpu attaching
+# to a TPU tunnel instead is a hang, not a preference.
+import os as _os
+
+if _os.environ.get("JAX_PLATFORMS"):
+    _jax.config.update("jax_platforms", _os.environ["JAX_PLATFORMS"])
+
 # Persistent XLA compilation cache: TPU compiles go through the remote tunnel
 # at ~20-40s per kernel, and every fresh process (bench runs, cluster workers,
 # the CLI) would otherwise re-pay them. Measured: an 18s axon compile replays

@@ -263,8 +263,9 @@ def main(argv=None) -> None:
     args = ap.parse_args(argv)
     catalogs = None
     if args.etc:
-        from ..server.config import load_catalogs
+        from ..server.config import load_catalogs, load_plugins_for_etc
 
+        load_plugins_for_etc(args.etc)
         catalogs = load_catalogs(args.etc)
     server = WorkerServer(port=args.port, coordinator_uri=args.coordinator,
                           host=args.host, announce_host=args.announce_host,

@@ -62,6 +62,17 @@ WAREHOUSES = ["Conventional childr", "Important issues liv", "Doors canno",
               "Bad cards must make", "Rooms cook "]
 DAY_NAMES = ["Monday", "Tuesday", "Wednesday", "Thursday", "Friday",
              "Saturday", "Sunday"]
+STATES = ["AL", "CA", "GA", "IL", "KY", "LA", "MI", "MN", "MO", "MS",
+          "NC", "NM", "NY", "OH", "OK", "OR", "PA", "SC", "TN", "TX",
+          "VA", "WA", "WI", "WV"]
+COUNTIES = ["Williamson County", "Walker County", "Ziebach County",
+            "Franklin Parish", "Luce County", "Richland County",
+            "Bronx County", "Orange County", "Salem County",
+            "Fairfield County"]
+COUNTRIES = ["United States"]
+STREET_TYPES = ["Ave", "Blvd", "Cir", "Ct", "Dr", "Ln", "Pkwy", "RD",
+                "ST", "Way"]
+CHANNEL_FLAGS = ["N", "Y"]
 
 DICT_MARITAL = Dictionary(MARITAL)
 DICT_EDUCATION = Dictionary(sorted(EDUCATION))
@@ -74,6 +85,16 @@ DICT_STORE_NAME = Dictionary(sorted(STORE_NAMES))
 DICT_WAREHOUSE = Dictionary(sorted(WAREHOUSES))
 DICT_DAY_NAME = Dictionary(sorted(DAY_NAMES))
 DICT_COLOR = Dictionary(sorted(COLORS))
+DICT_STORE_ID = FormattedDictionary(
+    lambda c: np.asarray([f"AAAAAAAA{i:08d}" for i in c], dtype=object),
+    monotonic=True)
+DICT_SUITE = FormattedDictionary(
+    lambda c: np.asarray([f"Suite {i % 100}" for i in c], dtype=object))
+DICT_STATE = Dictionary(sorted(STATES))
+DICT_COUNTY = Dictionary(sorted(COUNTIES))
+DICT_COUNTRY = Dictionary(COUNTRIES)
+DICT_STREET_TYPE = Dictionary(sorted(STREET_TYPES))
+DICT_CHANNEL = Dictionary(CHANNEL_FLAGS)  # already sorted: N < Y
 DICT_ZIP = FormattedDictionary(
     lambda c: np.asarray([f"{i % 100000:05d}" for i in c], dtype=object))
 DICT_STREET_NUMBER = FormattedDictionary(
@@ -199,6 +220,33 @@ def _make_store() -> Table:
                DICT_CITY),
         Column("s_number_employees", INTEGER,
                lambda i, sf: _uniform(T, 4, i, 200, 300).astype(np.int32)),
+        Column("s_store_id", VARCHAR, lambda i, sf: (i + 1).astype(np.int64),
+               DICT_STORE_ID),
+        Column("s_company_id", INTEGER,
+               lambda i, sf: np.ones(len(i), dtype=np.int32)),
+        Column("s_gmt_offset", DEC,
+               lambda i, sf: -(_uniform(T, 5, i, 5, 8) * 100)),
+        Column("s_state", VARCHAR,
+               lambda i, sf: _sorted_codes(
+                   DICT_STATE, STATES,
+                   _uniform(T, 6, i, 0, len(STATES) - 1)), DICT_STATE),
+        Column("s_county", VARCHAR,
+               lambda i, sf: _sorted_codes(
+                   DICT_COUNTY, COUNTIES,
+                   _uniform(T, 7, i, 0, len(COUNTIES) - 1)), DICT_COUNTY),
+        Column("s_street_number", VARCHAR,
+               lambda i, sf: _uniform(T, 8, i, 0, 999), DICT_STREET_NUMBER),
+        Column("s_street_name", VARCHAR,
+               lambda i, sf: _sorted_codes(
+                   DICT_STREET, STREETS,
+                   _uniform(T, 9, i, 0, len(STREETS) - 1)), DICT_STREET),
+        Column("s_street_type", VARCHAR,
+               lambda i, sf: _sorted_codes(
+                   DICT_STREET_TYPE, STREET_TYPES,
+                   _uniform(T, 10, i, 0, len(STREET_TYPES) - 1)),
+               DICT_STREET_TYPE),
+        Column("s_suite_number", VARCHAR,
+               lambda i, sf: _uniform(T, 11, i, 0, 99), DICT_SUITE),
     ])
 
 
@@ -261,6 +309,16 @@ def _make_customer_address() -> Table:
                lambda i, sf: _uniform(T, 4, i, 0, 99999), DICT_ZIP),
         Column("ca_gmt_offset", DEC,
                lambda i, sf: -(_uniform(T, 5, i, 5, 8) * 100)),
+        Column("ca_state", VARCHAR,
+               lambda i, sf: _sorted_codes(
+                   DICT_STATE, STATES,
+                   _uniform(T, 6, i, 0, len(STATES) - 1)), DICT_STATE),
+        Column("ca_county", VARCHAR,
+               lambda i, sf: _sorted_codes(
+                   DICT_COUNTY, COUNTIES,
+                   _uniform(T, 7, i, 0, len(COUNTIES) - 1)), DICT_COUNTY),
+        Column("ca_country", VARCHAR,
+               lambda i, sf: np.zeros(len(i), dtype=np.int32), DICT_COUNTRY),
     ])
 
 
@@ -321,6 +379,16 @@ def _make_promotion() -> Table:
                lambda i, sf: (i + 1).astype(np.int64), DICT_PROMO_NAME),
         Column("p_response_target", INTEGER,
                lambda i, sf: np.ones(len(i), dtype=np.int32)),
+        # ~1/8 promos flag each channel Y (spec: mostly N)
+        Column("p_channel_email", VARCHAR,
+               lambda i, sf: (_uniform(T, 2, i, 0, 7) == 0).astype(np.int32),
+               DICT_CHANNEL),
+        Column("p_channel_event", VARCHAR,
+               lambda i, sf: (_uniform(T, 3, i, 0, 7) == 0).astype(np.int32),
+               DICT_CHANNEL),
+        Column("p_channel_dmail", VARCHAR,
+               lambda i, sf: (_uniform(T, 4, i, 0, 7) == 0).astype(np.int32),
+               DICT_CHANNEL),
     ])
 
 
@@ -365,6 +433,11 @@ def _make_store_sales() -> Table:
         Column("ss_coupon_amt", DEC, lambda i, sf: _uniform(T, 13, i, 0, 500)),
         Column("ss_net_profit", DEC,
                lambda i, sf: _uniform(T, 14, i, -5000, 5000)),
+        Column("ss_ext_sales_price", DEC,
+               lambda i, sf: (list_price(i, sf) - _uniform(T, 12, i, 0, 2000))
+               * _uniform(T, 9, i, 1, 100)),
+        Column("ss_ext_wholesale_cost", DEC,
+               lambda i, sf: wholesale(i, sf) * _uniform(T, 9, i, 1, 100)),
     ])
 
 
@@ -429,6 +502,8 @@ def _make_catalog_sales() -> Table:
                lambda i, sf: _uniform(T, 11, i, 1000, 2_000_000)),
         Column("cs_sales_price", DEC,
                lambda i, sf: _uniform(T, 12, i, 50, 30000)),
+        Column("cs_coupon_amt", DEC,
+               lambda i, sf: _uniform(T, 13, i, 0, 1000)),
     ])
 
 

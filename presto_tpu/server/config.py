@@ -119,6 +119,72 @@ def register_connector_factory(name: str, factory: Callable) -> None:
     FACTORIES[name] = factory
 
 
+def load_plugins(plugin_dir: str) -> list:
+    """Load EXTERNAL plugins from a directory (server/PluginManager.java:138
+    loading plugin/*/; python modules instead of jars).
+
+    Each ``<plugin_dir>/<name>.py`` (or ``<name>/__init__.py``) is imported
+    under ``presto_tpu_plugin_<name>``; every spi.connector.Plugin subclass
+    found in it is instantiated and its contributions registered:
+    connector factories into FACTORIES, functions into the scalar/aggregate
+    registry. Returns the Plugin instances (the plugin-toolkit contract:
+    drop a file in, name its connector in etc/catalog/*.properties).
+    """
+    import importlib.util
+    import inspect
+
+    from ..spi.connector import ConnectorFactory, Plugin
+
+    loaded = []
+    if not os.path.isdir(plugin_dir):
+        return loaded
+    for entry in sorted(os.listdir(plugin_dir)):
+        path = os.path.join(plugin_dir, entry)
+        if entry.endswith(".py"):
+            mod_name, file = entry[:-3], path
+        elif os.path.isfile(os.path.join(path, "__init__.py")):
+            mod_name, file = entry, os.path.join(path, "__init__.py")
+        else:
+            continue
+        spec = importlib.util.spec_from_file_location(
+            f"presto_tpu_plugin_{mod_name}", file)
+        module = importlib.util.module_from_spec(spec)
+        # package-style plugins resolve their own relative imports through
+        # sys.modules — register BEFORE exec (the standard importlib recipe)
+        import sys
+
+        sys.modules[spec.name] = module
+        spec.loader.exec_module(module)
+        for _n, cls in inspect.getmembers(module, inspect.isclass):
+            if not (issubclass(cls, Plugin) and cls is not Plugin
+                    and cls.__module__ == module.__name__):
+                continue
+            plugin = cls()
+            for fac in plugin.connector_factories():
+                if isinstance(fac, ConnectorFactory):
+                    FACTORIES[fac.name] = fac.create
+                else:  # (name, callable) pair
+                    FACTORIES[fac[0]] = fac[1]
+            for hook in plugin.functions():
+                # zero-arg registration hooks: plugins call
+                # sql.analyzer.register_scalar_function /
+                # ops.expressions.register_compiler themselves (the same
+                # registries presto_tpu.functions.* use)
+                if callable(hook):
+                    hook()
+            loaded.append(plugin)
+    return loaded
+
+
+def load_plugins_for_etc(etc_dir: str) -> list:
+    """Load plugins for BOTH supported layouts: <install>/plugin (the dist
+    layout, sibling of etc/) and <etc>/plugin."""
+    loaded = load_plugins(os.path.join(
+        os.path.dirname(os.path.abspath(etc_dir)), "plugin"))
+    loaded += load_plugins(os.path.join(etc_dir, "plugin"))
+    return loaded
+
+
 def load_catalogs(etc_dir: str) -> CatalogManager:
     """Build a CatalogManager from etc/catalog/*.properties."""
     catalogs = CatalogManager()

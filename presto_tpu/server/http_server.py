@@ -17,6 +17,7 @@ Run: python -m presto_tpu.server [--port 8080] [--distributed] [--schema sf1]
 """
 from __future__ import annotations
 
+import os
 import json
 import re
 import threading
@@ -286,9 +287,13 @@ def main(argv=None) -> None:
     port = args.port
     authenticator = None
     if args.etc:
-        from .config import load_catalogs, load_config, session_from_config
+        from .config import (load_catalogs, load_config,
+                             load_plugins_for_etc, session_from_config)
 
         conf = load_config(args.etc)
+        # external plugins first: they may contribute the very connector
+        # factories etc/catalog/*.properties name
+        load_plugins_for_etc(args.etc)
         catalogs = load_catalogs(args.etc)
         session = session_from_config(conf)
         if session.catalog is None:

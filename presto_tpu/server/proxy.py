@@ -20,8 +20,10 @@ import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
+# hop-by-hop plus headers send_response() emits itself (a duplicate Date/
+# Server violates RFC 9110's single-instance requirement)
 _HOP_HEADERS = {"connection", "keep-alive", "transfer-encoding", "host",
-                "content-length"}
+                "content-length", "date", "server"}
 
 
 class _ProxyHandler(BaseHTTPRequestHandler):

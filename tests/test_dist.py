@@ -1,0 +1,32 @@
+"""Server distribution assembly (presto-server / server-rpm analogue):
+tools/make_dist.py builds a tarball whose launcher can run the server from
+the unpacked layout."""
+import os
+import subprocess
+import sys
+import tarfile
+
+
+def test_dist_builds_and_boots(tmp_path):
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out = subprocess.run(
+        [sys.executable, os.path.join(repo, "tools", "make_dist.py"),
+         "--out", str(tmp_path)],
+        capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stderr
+    tar_path = out.stdout.split()[0]
+    assert os.path.isfile(tar_path)
+
+    with tarfile.open(tar_path) as tar:
+        names = tar.getnames()
+        tar.extractall(tmp_path, filter="data")
+    base = os.path.join(str(tmp_path), "presto-tpu-server-0.1")
+    assert f"presto-tpu-server-0.1/bin/launcher" in names
+    assert os.access(os.path.join(base, "bin", "launcher"), os.X_OK)
+    # the engine package is self-contained in lib/
+    assert os.path.isfile(os.path.join(
+        base, "lib", "presto_tpu", "runner.py"))
+    # launcher status on a fresh unpack reports not running (exit 3)
+    st = subprocess.run([os.path.join(base, "bin", "launcher"), "status"],
+                        capture_output=True, text=True, timeout=30)
+    assert st.returncode == 3 and "not running" in st.stdout

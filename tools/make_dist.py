@@ -1,0 +1,138 @@
+"""Build a standalone server distribution tarball.
+
+Analogue of presto-server (the assembly module) + presto-server-rpm: one
+artifact an operator unpacks and runs, with the reference's on-disk layout
+(bin/launcher, lib/, etc/ templates, plugin/):
+
+    presto-tpu-server-<version>/
+      bin/launcher            # start/stop/run/status, pid + log files
+      lib/presto_tpu/...      # the engine package (python, no jars)
+      etc/config.properties   # template: port, node id
+      etc/catalog/tpch.properties
+      plugin/                 # drop-in python plugins (load_plugins)
+      README.txt
+
+Run: python tools/make_dist.py [--out dist/]. The launcher fronts
+``python -m presto_tpu.server --etc etc`` the way bin/launcher fronts the
+airlift runner in the reference.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import shutil
+import sys
+import tarfile
+
+VERSION = "0.1"
+
+LAUNCHER = """#!/bin/sh
+# presto-tpu server launcher (bin/launcher analogue): start|stop|run|status
+BASE="$(cd "$(dirname "$0")/.." && pwd)"
+PIDFILE="$BASE/var/run/server.pid"
+LOGFILE="$BASE/var/log/server.log"
+mkdir -p "$BASE/var/run" "$BASE/var/log"
+export PYTHONPATH="$BASE/lib:$PYTHONPATH"
+
+case "$1" in
+  run)
+    exec python -m presto_tpu.server --etc "$BASE/etc"
+    ;;
+  start)
+    if [ -f "$PIDFILE" ] && kill -0 "$(cat "$PIDFILE")" 2>/dev/null; then
+      echo "already running (pid $(cat "$PIDFILE"))"; exit 0
+    fi
+    nohup python -m presto_tpu.server --etc "$BASE/etc" \
+        >> "$LOGFILE" 2>&1 &
+    echo $! > "$PIDFILE"
+    echo "started (pid $(cat "$PIDFILE"))"
+    ;;
+  stop)
+    if [ -f "$PIDFILE" ]; then
+      kill "$(cat "$PIDFILE")" 2>/dev/null; rm -f "$PIDFILE"; echo stopped
+    else
+      echo "not running"
+    fi
+    ;;
+  status)
+    if [ -f "$PIDFILE" ] && kill -0 "$(cat "$PIDFILE")" 2>/dev/null; then
+      echo "running (pid $(cat "$PIDFILE"))"
+    else
+      echo "not running"; exit 3
+    fi
+    ;;
+  *)
+    echo "usage: $0 {run|start|stop|status}"; exit 2
+    ;;
+esac
+"""
+
+CONFIG = """# presto-tpu server configuration (etc/config.properties template)
+http-server.http.port=8080
+node.id=node-1
+session.catalog=tpch
+session.schema=tiny
+# http-server.authentication.type=PASSWORD
+# password.file=etc/password.db
+"""
+
+TPCH_CATALOG = "connector.name=tpch\n"
+
+README = """presto-tpu server distribution %s
+
+  bin/launcher run      # foreground
+  bin/launcher start    # background (var/log/server.log, var/run/server.pid)
+  bin/launcher stop
+  bin/launcher status
+
+Catalogs live in etc/catalog/*.properties (connector.name= names a
+factory: tpch, tpcds, memory, blackhole, file, hive, kafka, sqlite, or
+one contributed by a python plugin dropped into plugin/).
+""" % VERSION
+
+
+def build(out_dir: str) -> str:
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    name = f"presto-tpu-server-{VERSION}"
+    stage = os.path.join(out_dir, name)
+    if os.path.isdir(stage):
+        shutil.rmtree(stage)
+    os.makedirs(os.path.join(stage, "bin"))
+    os.makedirs(os.path.join(stage, "etc", "catalog"))
+    os.makedirs(os.path.join(stage, "plugin"))
+
+    shutil.copytree(
+        os.path.join(repo, "presto_tpu"),
+        os.path.join(stage, "lib", "presto_tpu"),
+        ignore=shutil.ignore_patterns("__pycache__", "*.pyc", "*.so",
+                                      "build"))
+    launcher = os.path.join(stage, "bin", "launcher")
+    with open(launcher, "w") as f:
+        f.write(LAUNCHER)
+    os.chmod(launcher, 0o755)
+    with open(os.path.join(stage, "etc", "config.properties"), "w") as f:
+        f.write(CONFIG)
+    with open(os.path.join(stage, "etc", "catalog",
+                           "tpch.properties"), "w") as f:
+        f.write(TPCH_CATALOG)
+    with open(os.path.join(stage, "README.txt"), "w") as f:
+        f.write(README)
+
+    tar_path = os.path.join(out_dir, f"{name}.tar.gz")
+    with tarfile.open(tar_path, "w:gz") as tar:
+        tar.add(stage, arcname=name)
+    return tar_path
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default="dist")
+    args = ap.parse_args(argv)
+    os.makedirs(args.out, exist_ok=True)
+    tar_path = build(args.out)
+    size = os.path.getsize(tar_path)
+    print(f"{tar_path} ({size / 1e6:.1f} MB)")
+
+
+if __name__ == "__main__":
+    sys.exit(main())
