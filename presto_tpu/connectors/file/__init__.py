@@ -309,6 +309,58 @@ class FileMetadata(ConnectorMetadata):
             self._cache.pop(table.schema_table, None)
 
 
+
+
+def iter_pcol_pages(path: str, names, type_of, table_dicts, capacity: int,
+                    prefilter=None):
+    """One pcol file -> fixed-capacity masked pages, remapping per-file
+    varchar codes into the TABLE's unioned dictionaries. Shared by the file
+    and raptor connectors (one implementation of the chunk loop: columns are
+    read ONCE and sliced per chunk; `prefilter` ANDs into the row mask)."""
+    pf = PcolFile(path)
+    try:
+        if pf.rows == 0:
+            return
+        cols = {}
+        remap = {}
+        for n in names:
+            data, nulls, _d = pf.read_column(n)
+            cols[n] = (data, nulls)
+            e = pf.columns[n]
+            td = table_dicts.get(n)
+            if "dict" in e and td is not None and \
+                    list(e["dict"]) != list(td.values):
+                pos = {v: i for i, v in enumerate(td.values)}
+                remap[n] = np.asarray([pos[v] for v in e["dict"]],
+                                      dtype=np.int32)
+        for lo in range(0, pf.rows, capacity):
+            hi = min(lo + capacity, pf.rows)
+            n_rows = hi - lo
+            blocks = []
+            for cname in names:
+                data, nulls = cols[cname]
+                seg = np.array(data[lo:hi])
+                if cname in remap:
+                    seg = remap[cname][np.clip(seg.astype(np.int32), 0,
+                                               len(remap[cname]) - 1)]
+                if n_rows < capacity:
+                    seg = np.concatenate(
+                        [seg, np.zeros(capacity - n_rows, dtype=seg.dtype)])
+                nseg = None
+                if nulls is not None:
+                    nseg = np.zeros(capacity, dtype=bool)
+                    nseg[:n_rows] = nulls[lo:hi]
+                blocks.append(Block(type_of[cname], seg, nseg,
+                                    table_dicts.get(cname)))
+            mask = np.arange(capacity) < n_rows
+            if prefilter is not None:
+                mask = mask & np.pad(prefilter[lo:hi],
+                                     (0, capacity - n_rows))
+            yield Page(tuple(blocks), mask)
+    finally:
+        pf.close()
+
+
 class FileSplitManager(ConnectorSplitManager):
     """One split per file, pruned by header min/max vs the pushed-down
     constraint (the ORC stripe-statistics skip)."""
@@ -401,43 +453,13 @@ class FilePageSource(ConnectorPageSource):
             if pf.rows == 0:
                 return
             prefilter = self._native_prefilter(pf)
-            names = [c.name for c in self.columns]
-            remap = {}
-            for n in names:
-                e = pf.columns[n]
-                td = table_dicts.get(n)
-                if "dict" in e and td is not None and \
-                        list(e["dict"]) != list(td.values):
-                    pos = {v: i for i, v in enumerate(td.values)}
-                    remap[n] = np.asarray([pos[v] for v in e["dict"]],
-                                          dtype=np.int32)
-            for lo in range(0, pf.rows, self.capacity):
-                hi = min(lo + self.capacity, pf.rows)
-                n_rows = hi - lo
-                blocks = []
-                for cname in names:
-                    data, nulls, _d = pf.read_column(cname)
-                    seg = np.array(data[lo:hi])
-                    if cname in remap:
-                        seg = remap[cname][np.clip(seg.astype(np.int32), 0,
-                                                   len(remap[cname]) - 1)]
-                    if n_rows < self.capacity:
-                        seg = np.concatenate(
-                            [seg, np.zeros(self.capacity - n_rows,
-                                           dtype=seg.dtype)])
-                    nseg = None
-                    if nulls is not None:
-                        nseg = np.zeros(self.capacity, dtype=bool)
-                        nseg[:n_rows] = nulls[lo:hi]
-                    tt = info.metadata.column(cname).type
-                    blocks.append(Block(tt, seg, nseg, table_dicts.get(cname)))
-                mask = np.arange(self.capacity) < n_rows
-                if prefilter is not None:
-                    mask = mask & np.pad(prefilter[lo:hi],
-                                         (0, self.capacity - n_rows))
-                yield Page(tuple(blocks), mask)
         finally:
             pf.close()
+        names = [c.name for c in self.columns]
+        type_of = {c.name: info.metadata.column(c.name).type
+                   for c in self.columns}
+        yield from iter_pcol_pages(path, names, type_of, table_dicts,
+                                   self.capacity, prefilter)
 
     def _iter_external(self) -> Iterator[Page]:
         name, path, group = self.split.payload

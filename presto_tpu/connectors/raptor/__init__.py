@@ -1,0 +1,590 @@
+"""Raptor-class managed storage connector: engine-owned shards + metadata DB.
+
+Analogue of presto-raptor (RaptorConnector, RaptorMetadata backed by a
+metadata database, ShardManager/ShardOrganizer, storage/OrcStorageManager):
+unlike the file/hive connectors (which read whatever lives in a directory),
+THIS connector owns its storage — every table is a set of immutable PCOL
+shards with UUIDs, registered in a sqlite metadata database with per-shard
+row counts and column min/max statistics, exactly raptor's
+shards/tables/columns schema (narrowed).
+
+What that buys, mirroring raptor's feature set:
+- **metadata-DB source of truth**: table existence/schema/shard list come
+  from sqlite, not directory scans — orphan files are invisible, drops are
+  transactional;
+- **shard pruning**: scans prune shards on the metadata DB's min/max stats
+  with an SQL WHERE over the shards table (raptor prunes on its
+  shard_nodes/columns tables the same way);
+- **shard organization**: ``maintenance()`` compacts small shards into
+  bigger ones (ShardOrganizer/ShardCompactor) — the background job that
+  keeps write-heavy tables scan-friendly, runnable on demand or from a
+  background thread (``organize_interval_s``).
+
+Storage format is PCOL (the engine's native mmap format); raptor's ORC
+role. Each sink flush writes one shard; INSERT appends shards; CTAS
+creates the table row then appends.
+"""
+from __future__ import annotations
+
+import os
+import sqlite3
+import threading
+import uuid as uuidlib
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ...block import Dictionary, Page
+from ...formats.pcol import (PcolFile, _type_from_tag, _type_tag, write_pcol)
+from ...spi.connector import (ColumnHandle, ColumnMetadata, ColumnStatistics,
+                              Connector, ConnectorMetadata,
+                              ConnectorPageSink, ConnectorPageSinkProvider,
+                              ConnectorPageSource, ConnectorPageSourceProvider,
+                              ConnectorSplitManager, Constraint,
+                              SchemaTableName, Split, TableHandle,
+                              TableMetadata, TableStatistics)
+
+_SCHEMA = """
+create table if not exists tables (
+    table_id integer primary key autoincrement,
+    schema_name text not null,
+    table_name text not null,
+    unique (schema_name, table_name)
+);
+create table if not exists columns (
+    table_id integer not null,
+    ordinal integer not null,
+    column_name text not null,
+    type_tag text not null,
+    type_scale integer not null,
+    primary key (table_id, ordinal)
+);
+create table if not exists shards (
+    shard_uuid text primary key,
+    table_id integer not null,
+    row_count integer not null,
+    compacted integer not null default 0
+);
+create table if not exists shard_stats (
+    shard_uuid text not null,
+    column_name text not null,
+    min_value integer,
+    max_value integer,
+    primary key (shard_uuid, column_name)
+);
+create table if not exists deleted_shards (
+    shard_uuid text primary key,
+    dropped_at real not null
+);
+"""
+
+
+class ShardManager:
+    """The metadata database (raptor's ShardManager + MetadataDao)."""
+
+    def __init__(self, base_dir: str):
+        self.base = base_dir
+        os.makedirs(os.path.join(base_dir, "storage"), exist_ok=True)
+        self.lock = threading.RLock()
+        self._conn = sqlite3.connect(
+            os.path.join(base_dir, "metadata.db"), check_same_thread=False)
+        with self.lock:
+            self._conn.executescript(_SCHEMA)
+            self._conn.commit()
+
+    # ------------------------------------------------------------- tables
+
+    def create_table(self, name: SchemaTableName,
+                     columns: Sequence[ColumnMetadata]) -> int:
+        with self.lock:
+            cur = self._conn.execute(
+                "insert into tables (schema_name, table_name) values (?, ?)",
+                (name.schema, name.table))
+            tid = cur.lastrowid
+            for i, c in enumerate(columns):
+                tag, scale = _type_tag(c.type)
+                self._conn.execute(
+                    "insert into columns values (?, ?, ?, ?, ?)",
+                    (tid, i, c.name, tag, scale))
+            self._conn.commit()
+            return tid
+
+    def table_id(self, name: SchemaTableName) -> Optional[int]:
+        with self.lock:
+            row = self._conn.execute(
+                "select table_id from tables where schema_name = ? "
+                "and table_name = ?", (name.schema, name.table)).fetchone()
+        return row[0] if row else None
+
+    def list_tables(self, schema: Optional[str]) -> List[SchemaTableName]:
+        q = "select schema_name, table_name from tables"
+        args: tuple = ()
+        if schema:
+            q += " where schema_name = ?"
+            args = (schema,)
+        with self.lock:
+            rows = self._conn.execute(q + " order by 1, 2", args).fetchall()
+        return [SchemaTableName(s, t) for s, t in rows]
+
+    def list_schemas(self) -> List[str]:
+        with self.lock:
+            rows = self._conn.execute(
+                "select distinct schema_name from tables order by 1"
+            ).fetchall()
+        return [r[0] for r in rows] or ["default"]
+
+    def columns(self, tid: int) -> List[Tuple[str, object]]:
+        with self.lock:
+            rows = self._conn.execute(
+                "select column_name, type_tag, type_scale from columns "
+                "where table_id = ? order by ordinal", (tid,)).fetchall()
+        return [(n, _type_from_tag(tag, scale)) for n, tag, scale in rows]
+
+    def drop_table(self, tid: int) -> None:
+        with self.lock:
+            shards = [r[0] for r in self._conn.execute(
+                "select shard_uuid from shards where table_id = ?",
+                (tid,)).fetchall()]
+            self._conn.execute("delete from tables where table_id = ?",
+                               (tid,))
+            self._conn.execute("delete from columns where table_id = ?",
+                               (tid,))
+            self._conn.execute("delete from shards where table_id = ?",
+                               (tid,))
+            for u in shards:
+                self._conn.execute(
+                    "delete from shard_stats where shard_uuid = ?", (u,))
+            self._conn.commit()
+        for u in shards:
+            try:
+                os.unlink(self.shard_path(u))
+            except OSError:
+                pass
+
+    # ------------------------------------------------------------- shards
+
+    def shard_path(self, shard_uuid: str) -> str:
+        return os.path.join(self.base, "storage", f"{shard_uuid}.pcol")
+
+    def register_shard(self, tid: int, shard_uuid: str, rows: int,
+                       stats: Dict[str, Tuple[Optional[int], Optional[int]]],
+                       compacted: bool = False) -> None:
+        with self.lock:
+            self._conn.execute(
+                "insert into shards values (?, ?, ?, ?)",
+                (shard_uuid, tid, rows, 1 if compacted else 0))
+            for col, (mn, mx) in stats.items():
+                self._conn.execute(
+                    "insert into shard_stats values (?, ?, ?, ?)",
+                    (shard_uuid, col, mn, mx))
+            self._conn.commit()
+
+    def replace_shards(self, tid: int, old: Sequence[str], new_uuid: str,
+                       rows: int, stats: Dict, compacted: bool) -> None:
+        """Atomic swap for compaction (raptor's commitShards transaction).
+        Old shard FILES are not unlinked here: a query that already planned
+        its splits may still open them — they go to deleted_shards and are
+        purged by a later maintenance() after a grace period."""
+        import time
+
+        with self.lock:
+            for u in old:
+                self._conn.execute(
+                    "delete from shards where shard_uuid = ?", (u,))
+                self._conn.execute(
+                    "delete from shard_stats where shard_uuid = ?", (u,))
+                self._conn.execute(
+                    "insert into deleted_shards values (?, ?)",
+                    (u, time.time()))
+            self._conn.execute("insert into shards values (?, ?, ?, ?)",
+                               (new_uuid, tid, rows, 1 if compacted else 0))
+            for col, (mn, mx) in stats.items():
+                self._conn.execute(
+                    "insert into shard_stats values (?, ?, ?, ?)",
+                    (new_uuid, col, mn, mx))
+            self._conn.commit()
+
+    def purge_deleted(self, grace_s: float) -> int:
+        """Unlink files of shards dropped more than `grace_s` ago."""
+        import time
+
+        cutoff = time.time() - grace_s
+        with self.lock:
+            rows = self._conn.execute(
+                "select shard_uuid from deleted_shards where dropped_at < ?",
+                (cutoff,)).fetchall()
+            for (u,) in rows:
+                self._conn.execute(
+                    "delete from deleted_shards where shard_uuid = ?", (u,))
+            self._conn.commit()
+        for (u,) in rows:
+            try:
+                os.unlink(self.shard_path(u))
+            except OSError:
+                pass
+        return len(rows)
+
+    def shards(self, tid: int,
+               constraint: Optional[Constraint] = None) -> List[Tuple[str, int]]:
+        """-> [(uuid, rows)] pruned by the metadata DB's min/max stats — an
+        SQL anti-join against out-of-range shard_stats (raptor prunes in its
+        metadata DB exactly like this)."""
+        q = "select shard_uuid, row_count from shards where table_id = ?"
+        args: list = [tid]
+        if constraint and constraint.domains:
+            for col, dom in constraint.domains.items():
+                lo, hi = dom if isinstance(dom, tuple) else (None, None)
+                if (lo is None and hi is None) or isinstance(lo, float) or \
+                        isinstance(hi, float):
+                    continue
+                conds, cargs = [], []
+                if hi is not None:
+                    conds.append("min_value > ?")
+                    cargs.append(int(hi))
+                if lo is not None:
+                    conds.append("max_value < ?")
+                    cargs.append(int(lo))
+                q += (" and shard_uuid not in (select shard_uuid from "
+                      "shard_stats where column_name = ? and ("
+                      + " or ".join(conds) + "))")
+                args.append(col)
+                args.extend(cargs)
+        with self.lock:
+            return self._conn.execute(q, args).fetchall()
+
+    def table_rows(self, tid: int) -> int:
+        with self.lock:
+            row = self._conn.execute(
+                "select coalesce(sum(row_count), 0) from shards "
+                "where table_id = ?", (tid,)).fetchone()
+        return int(row[0])
+
+    def small_shards(self, tid: int, threshold_rows: int) -> List[str]:
+        """Every shard below the threshold is a merge candidate — including
+        prior compaction outputs (excluding them would strand tiny shards
+        forever under steady small inserts)."""
+        with self.lock:
+            rows = self._conn.execute(
+                "select shard_uuid from shards where table_id = ? "
+                "and row_count < ?", (tid, threshold_rows)).fetchall()
+        return [r[0] for r in rows]
+
+    def all_table_ids(self) -> List[int]:
+        with self.lock:
+            return [r[0] for r in self._conn.execute(
+                "select table_id from tables").fetchall()]
+
+
+def _shard_stats(path: str) -> Dict[str, Tuple[Optional[int], Optional[int]]]:
+    """Integer min/max per column from the pcol header (write-time stats)."""
+    pf = PcolFile(path)
+    try:
+        out = {}
+        for name in pf.columns:
+            mn, mx = pf.column_stats(name)
+            if mn is not None and not isinstance(mn, float):
+                out[name] = (int(mn), int(mx))
+        return out
+    finally:
+        pf.close()
+
+
+class RaptorMetadata(ConnectorMetadata):
+    def __init__(self, connector_id: str, shard_manager: ShardManager):
+        self.connector_id = connector_id
+        self.shards = shard_manager
+        self._dict_cache: Dict[int, Dict[str, Dictionary]] = {}
+        self._dict_versions: Dict[int, tuple] = {}
+        self._lock = threading.Lock()
+
+    def list_schemas(self) -> List[str]:
+        return self.shards.list_schemas()
+
+    def list_tables(self, schema: Optional[str] = None) -> List[SchemaTableName]:
+        return self.shards.list_tables(schema)
+
+    def get_table_handle(self, name: SchemaTableName) -> Optional[TableHandle]:
+        tid = self.shards.table_id(name)
+        if tid is None:
+            return None
+        return TableHandle(self.connector_id, name, extra=(tid,))
+
+    def _dictionaries(self, tid: int) -> Dict[str, Dictionary]:
+        """Union the shards' persisted varchar dictionaries (file-connector
+        pattern), cached against the shard list."""
+        shard_ids = tuple(u for u, _ in self.shards.shards(tid))
+        with self._lock:
+            if self._dict_versions.get(tid) == shard_ids:
+                return self._dict_cache[tid]
+        seen: Dict[str, Dict[str, int]] = {}
+        order: Dict[str, List[str]] = {}
+        for u in shard_ids:
+            pf = PcolFile(self.shards.shard_path(u))
+            try:
+                for name, e in pf.columns.items():
+                    if "dict" not in e:
+                        continue
+                    s = seen.setdefault(name, {})
+                    o = order.setdefault(name, [])
+                    for v in e["dict"]:
+                        if v not in s:
+                            s[v] = len(o)
+                            o.append(v)
+            finally:
+                pf.close()
+        dicts = {n: Dictionary(vals) for n, vals in order.items()}
+        with self._lock:
+            self._dict_cache[tid] = dicts
+            self._dict_versions[tid] = shard_ids
+        return dicts
+
+    def get_table_metadata(self, table: TableHandle) -> TableMetadata:
+        tid = table.extra[0]
+        dicts = self._dictionaries(tid)
+        cols = tuple(
+            ColumnMetadata(n, t, dictionary=dicts.get(n))
+            for n, t in self.shards.columns(tid))
+        return TableMetadata(table.schema_table, cols)
+
+    def get_table_statistics(self, table: TableHandle,
+                             constraint: Constraint) -> TableStatistics:
+        tid = table.extra[0]
+        return TableStatistics(row_count=float(self.shards.table_rows(tid)))
+
+    # --------------------------------------------------------------- writes
+
+    def create_table(self, metadata: TableMetadata, properties=None) -> None:
+        if properties:
+            raise ValueError("raptor tables take no properties")
+        if self.shards.table_id(metadata.name) is not None:
+            raise ValueError(f"table {metadata.name} already exists")
+        self.shards.create_table(metadata.name, metadata.columns)
+
+    def begin_insert(self, table: TableHandle):
+        return table
+
+    def finish_insert(self, handle, fragments) -> None:
+        with self._lock:  # new shards may extend dictionaries
+            self._dict_versions.pop(handle.extra[0], None)
+
+    def drop_table(self, table: TableHandle) -> None:
+        self.shards.drop_table(table.extra[0])
+        with self._lock:
+            self._dict_versions.pop(table.extra[0], None)
+
+
+class RaptorSplitManager(ConnectorSplitManager):
+    """One split per shard, pruned in the METADATA DB (raptor's
+    shard-predicate pushdown)."""
+
+    def __init__(self, connector_id: str, metadata: RaptorMetadata):
+        self.connector_id = connector_id
+        self._metadata = metadata
+
+    def get_splits(self, table: TableHandle, constraint: Constraint,
+                   desired_splits: int) -> List[Split]:
+        tid = table.extra[0]
+        return [
+            Split(self.connector_id, payload=(table.schema_table, tid, u))
+            for u, rows in self._metadata.shards.shards(tid, constraint)
+            if rows > 0]
+
+
+class RaptorPageSource(ConnectorPageSource):
+    def __init__(self, metadata: RaptorMetadata, split: Split,
+                 columns: Sequence[ColumnHandle], capacity: int):
+        self._metadata = metadata
+        self.split = split
+        self.columns = list(columns)
+        self.capacity = capacity
+
+    def __iter__(self) -> Iterator[Page]:
+        from ..file import iter_pcol_pages
+
+        name, tid, shard_uuid = self.split.payload
+        meta = self._metadata.get_table_metadata(
+            TableHandle(self._metadata.connector_id, name, extra=(tid,)))
+        table_dicts = {c.name: c.dictionary for c in meta.columns}
+        names = [c.name for c in self.columns]
+        type_of = {c.name: meta.column(c.name).type for c in self.columns}
+        yield from iter_pcol_pages(
+            self._metadata.shards.shard_path(shard_uuid), names, type_of,
+            table_dicts, self.capacity)
+
+
+class RaptorPageSourceProvider(ConnectorPageSourceProvider):
+    def __init__(self, metadata: RaptorMetadata):
+        self._metadata = metadata
+
+    def create_page_source(self, split: Split, columns: Sequence[ColumnHandle],
+                           page_capacity: int,
+                           constraint: Constraint = Constraint.all()
+                           ) -> ConnectorPageSource:
+        return RaptorPageSource(self._metadata, split, columns, page_capacity)
+
+
+class RaptorPageSink(ConnectorPageSink):
+    """Buffers pages; finish() writes ONE shard and registers it with its
+    stats in the metadata DB (OrcStorageManager.commit + ShardManager)."""
+
+    def __init__(self, metadata: RaptorMetadata, table: TableHandle):
+        self._metadata = metadata
+        self._table = table
+        self._pages: List[Page] = []
+        self.rows_written = 0
+
+    def append_page(self, page: Page) -> None:
+        import jax
+
+        host = jax.device_get(page)
+        self._pages.append(host)
+        self.rows_written += int(np.asarray(host.mask).sum())
+
+    def finish(self):
+        if not self._pages:
+            return []
+        from ..file import _materialize_dicts
+
+        tid = self._table.extra[0]
+        shards = self._metadata.shards
+        meta = self._metadata.get_table_metadata(self._table)
+        names = [c.name for c in meta.columns]
+        types = [c.type for c in meta.columns]
+        dicts, pages = _materialize_dicts(self._pages)
+        shard_uuid = str(uuidlib.uuid4())
+        path = shards.shard_path(shard_uuid)
+        rows = write_pcol(path, names, types, dicts, pages)
+        shards.register_shard(tid, shard_uuid, rows, _shard_stats(path))
+        return [shard_uuid]
+
+
+class RaptorPageSinkProvider(ConnectorPageSinkProvider):
+    def __init__(self, metadata: RaptorMetadata):
+        self._metadata = metadata
+
+    def create_page_sink(self, insert_handle) -> ConnectorPageSink:
+        return RaptorPageSink(self._metadata, insert_handle)
+
+
+class RaptorConnector(Connector):
+    def __init__(self, connector_id: str, base_dir: str,
+                 compaction_threshold_rows: int = 1 << 17,
+                 organize_interval_s: float = 0.0):
+        self.shard_manager = ShardManager(base_dir)
+        self._metadata = RaptorMetadata(connector_id, self.shard_manager)
+        self._splits = RaptorSplitManager(connector_id, self._metadata)
+        self._sources = RaptorPageSourceProvider(self._metadata)
+        self._sinks = RaptorPageSinkProvider(self._metadata)
+        self.compaction_threshold_rows = compaction_threshold_rows
+        self._organizer_stop = threading.Event()
+        if organize_interval_s > 0:
+            t = threading.Thread(target=self._organizer_loop,
+                                 args=(organize_interval_s,), daemon=True)
+            t.start()
+
+    # -------------------------------------------------------- organization
+
+    def maintenance(self, grace_s: float = 300.0) -> int:
+        """Compact small shards table by table (ShardOrganizer pass) and
+        purge shard files whose metadata rows were dropped more than
+        `grace_s` ago (deferred deletion keeps in-flight scans safe).
+        Returns the number of shards removed by compaction."""
+        self.shard_manager.purge_deleted(grace_s)
+        removed = 0
+        for tid in self.shard_manager.all_table_ids():
+            removed += self._compact_table(tid)
+        return removed
+
+    def _compact_table(self, tid: int) -> int:
+        sm = self.shard_manager
+        small = sm.small_shards(tid, self.compaction_threshold_rows)
+        if len(small) < 2:
+            return 0
+        cols = sm.columns(tid)
+        names = [n for n, _ in cols]
+        types = [t for _, t in cols]
+        # read every small shard fully and rewrite as ONE shard; the
+        # metadata swap is transactional so readers never see a gap
+        pages = []
+        dicts_per_col: List[Optional[Dictionary]] = [None] * len(names)
+        datas = {n: [] for n in names}
+        nullss = {n: [] for n in names}
+        dict_values: Dict[str, List[str]] = {}
+        total = 0
+        for u in small:
+            pf = PcolFile(sm.shard_path(u))
+            try:
+                for n in names:
+                    data, nulls, _ = pf.read_column(n)
+                    # read_column returns views over the file's mmap — COPY
+                    # before pf.close() unmaps, or concatenate reads freed
+                    # memory
+                    data = np.array(data)
+                    nulls = np.array(nulls) if nulls is not None else None
+                    e = pf.columns[n]
+                    if "dict" in e:
+                        vals = dict_values.setdefault(n, [])
+                        have = {v: i for i, v in enumerate(vals)}
+                        remap = np.empty(max(len(e["dict"]), 1),
+                                         dtype=np.int32)
+                        for i, v in enumerate(e["dict"]):
+                            if v not in have:
+                                have[v] = len(vals)
+                                vals.append(v)
+                            remap[i] = have[v]
+                        data = remap[np.clip(np.asarray(data, dtype=np.int64),
+                                             0, len(remap) - 1)]
+                    datas[n].append(np.asarray(data))
+                    nullss[n].append(
+                        np.asarray(nulls) if nulls is not None
+                        else np.zeros(pf.rows, dtype=bool))
+                total += pf.rows
+            finally:
+                pf.close()
+        from ...block import Block
+
+        blocks = []
+        for i, n in enumerate(names):
+            data = np.concatenate(datas[n]) if datas[n] else \
+                np.zeros(0, dtype=types[i].np_dtype)
+            nm = np.concatenate(nullss[n])
+            if n in dict_values:
+                dicts_per_col[i] = Dictionary(dict_values[n])
+                data = data.astype(np.int32)
+            blocks.append(Block(types[i], data.astype(types[i].np_dtype,
+                                                      copy=False),
+                                nm if nm.any() else None, dicts_per_col[i]))
+        page = Page(tuple(blocks), np.ones(total, dtype=bool))
+        pages = [page]
+        new_uuid = str(uuidlib.uuid4())
+        path = sm.shard_path(new_uuid)
+        write_pcol(path, names, types, dicts_per_col, pages)
+        # only outputs that reached the threshold stop being candidates —
+        # a still-small output must stay mergeable with later inserts
+        sm.replace_shards(tid, small, new_uuid, total, _shard_stats(path),
+                          compacted=total >= self.compaction_threshold_rows)
+        return len(small)
+
+    def _organizer_loop(self, interval_s: float) -> None:
+        while not self._organizer_stop.wait(interval_s):
+            try:
+                self.maintenance()
+            except Exception:
+                pass
+
+    def shutdown(self) -> None:
+        self._organizer_stop.set()
+
+    # ----------------------------------------------------------------- spi
+
+    def metadata(self) -> ConnectorMetadata:
+        return self._metadata
+
+    def split_manager(self) -> ConnectorSplitManager:
+        return self._splits
+
+    def page_source_provider(self) -> ConnectorPageSourceProvider:
+        return self._sources
+
+    def page_sink_provider(self) -> Optional[ConnectorPageSinkProvider]:
+        return self._sinks

@@ -88,17 +88,33 @@ DICT_COLOR = Dictionary(sorted(COLORS))
 DICT_STORE_ID = FormattedDictionary(
     lambda c: np.asarray([f"AAAAAAAA{i:08d}" for i in c], dtype=object),
     monotonic=True)
+# zero-padded so code order == lexicographic order (ORDER BY sorts raw codes)
 DICT_SUITE = FormattedDictionary(
-    lambda c: np.asarray([f"Suite {i % 100}" for i in c], dtype=object))
+    lambda c: np.asarray([f"Suite {i % 100:02d}" for i in c], dtype=object),
+    monotonic=True)
 DICT_STATE = Dictionary(sorted(STATES))
 DICT_COUNTY = Dictionary(sorted(COUNTIES))
 DICT_COUNTRY = Dictionary(COUNTRIES)
 DICT_STREET_TYPE = Dictionary(sorted(STREET_TYPES))
 DICT_CHANNEL = Dictionary(CHANNEL_FLAGS)  # already sorted: N < Y
-DICT_ZIP = FormattedDictionary(
-    lambda c: np.asarray([f"{i % 100000:05d}" for i in c], dtype=object))
+class _ZipDictionary(FormattedDictionary):
+    """5-digit zips: codes ARE the numeric value, so string constants
+    reverse-map by parsing (code_of) and substr(zip, 1, 5) is identity."""
+
+    def code_of(self, value: str) -> int:
+        v = str(value)
+        return int(v) if len(v) == 5 and v.isdigit() else -1
+
+
+DICT_ZIP = _ZipDictionary(
+    lambda c: np.asarray([f"{i % 100000:05d}" for i in c], dtype=object),
+    monotonic=True)
+DICT_ZIP.substr_rules[(1, 5)] = (DICT_ZIP, lambda c: c)
+# zero-padded, range capped at 999 so every value is exactly 3 chars and
+# code order == lexicographic order (sortable virtually)
 DICT_STREET_NUMBER = FormattedDictionary(
-    lambda c: np.asarray([str(i % 1000 + 1) for i in c], dtype=object))
+    lambda c: np.asarray([f"{i % 999 + 1:03d}" for i in c], dtype=object),
+    monotonic=True)
 DICT_PRODUCT_NAME = FormattedDictionary(
     lambda c: np.asarray([f"product{i:09d}" for i in c], dtype=object),
     monotonic=True)
@@ -235,7 +251,7 @@ def _make_store() -> Table:
                    DICT_COUNTY, COUNTIES,
                    _uniform(T, 7, i, 0, len(COUNTIES) - 1)), DICT_COUNTY),
         Column("s_street_number", VARCHAR,
-               lambda i, sf: _uniform(T, 8, i, 0, 999), DICT_STREET_NUMBER),
+               lambda i, sf: _uniform(T, 8, i, 0, 998), DICT_STREET_NUMBER),
         Column("s_street_name", VARCHAR,
                lambda i, sf: _sorted_codes(
                    DICT_STREET, STREETS,
@@ -296,7 +312,7 @@ def _make_customer_address() -> Table:
     return Table("customer_address", T, lambda sf: n_addresses(sf), [
         Column("ca_address_sk", BIGINT, lambda i, sf: i.astype(np.int64) + 1),
         Column("ca_street_number", VARCHAR,
-               lambda i, sf: _uniform(T, 1, i, 0, 999), DICT_STREET_NUMBER),
+               lambda i, sf: _uniform(T, 1, i, 0, 998), DICT_STREET_NUMBER),
         Column("ca_street_name", VARCHAR,
                lambda i, sf: _sorted_codes(
                    DICT_STREET, STREETS,

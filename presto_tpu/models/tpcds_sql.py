@@ -294,5 +294,117 @@ order by i_item_id
 limit 100
 """
 
-QUERIES = {3: Q3, 7: Q7, 19: Q19, 21: Q21, 25: Q25, 36: Q36, 42: Q42,
+Q13 = """
+select avg(ss_quantity), avg(ss_ext_sales_price), avg(ss_ext_wholesale_cost),
+       sum(ss_ext_wholesale_cost)
+from store_sales, store, customer_demographics, household_demographics,
+     customer_address, date_dim
+where s_store_sk = ss_store_sk and ss_sold_date_sk = d_date_sk
+  and d_year = 2001
+  and ((cd_demo_sk = ss_cdemo_sk and cd_marital_status = 'M'
+        and cd_education_status = 'Advanced Degree'
+        and ss_sales_price between 100.00 and 150.00
+        and hd_dep_count = 3)
+    or (cd_demo_sk = ss_cdemo_sk and cd_marital_status = 'S'
+        and cd_education_status = 'College'
+        and ss_sales_price between 50.00 and 100.00 and hd_dep_count = 1)
+    or (cd_demo_sk = ss_cdemo_sk and cd_marital_status = 'W'
+        and cd_education_status = '2 yr Degree'
+        and ss_sales_price between 150.00 and 200.00 and hd_dep_count = 1))
+  and ss_hdemo_sk = hd_demo_sk and ss_addr_sk = ca_address_sk
+  and ca_country = 'United States'
+  and ((ca_state in ('TX','OH','TX') and ss_net_profit between 100 and 200)
+    or (ca_state in ('OR','NM','KY') and ss_net_profit between 150 and 300)
+    or (ca_state in ('VA','TX','MS') and ss_net_profit between 50 and 250))
+"""
+Q15 = """
+select ca_zip, sum(cs_sales_price)
+from catalog_sales, customer, customer_address, date_dim
+where cs_bill_customer_sk = c_customer_sk
+  and c_current_addr_sk = ca_address_sk
+  and (substr(ca_zip, 1, 5) in ('85669','86197','88274','83405','86475',
+                                '85392','85460','80348','81792')
+       or ca_state in ('CA','WA','GA') or cs_sales_price > 500)
+  and cs_sold_date_sk = d_date_sk and d_qoy = 2 and d_year = 2001
+group by ca_zip order by ca_zip limit 100
+"""
+Q26 = """
+select i_item_id, avg(cs_quantity) agg1, avg(cs_list_price) agg2,
+       avg(cs_coupon_amt) agg3, avg(cs_sales_price) agg4
+from catalog_sales, customer_demographics, date_dim, item, promotion
+where cs_sold_date_sk = d_date_sk and cs_item_sk = i_item_sk
+  and cs_bill_cdemo_sk = cd_demo_sk and cs_promo_sk = p_promo_sk
+  and cd_gender = 'M' and cd_marital_status = 'S'
+  and cd_education_status = 'College'
+  and (p_channel_email = 'N' or p_channel_event = 'N') and d_year = 2000
+group by i_item_id order by i_item_id limit 100
+"""
+Q43 = """
+select s_store_name, s_store_id,
+       sum(case when d_day_name = 'Sunday' then ss_sales_price else null end) sun_sales,
+       sum(case when d_day_name = 'Monday' then ss_sales_price else null end) mon_sales,
+       sum(case when d_day_name = 'Tuesday' then ss_sales_price else null end) tue_sales,
+       sum(case when d_day_name = 'Wednesday' then ss_sales_price else null end) wed_sales,
+       sum(case when d_day_name = 'Thursday' then ss_sales_price else null end) thu_sales,
+       sum(case when d_day_name = 'Friday' then ss_sales_price else null end) fri_sales,
+       sum(case when d_day_name = 'Saturday' then ss_sales_price else null end) sat_sales
+from date_dim, store_sales, store
+where d_date_sk = ss_sold_date_sk and s_store_sk = ss_store_sk
+  and s_gmt_offset = -5 and d_year = 2000
+group by s_store_name, s_store_id
+order by s_store_name, s_store_id, sun_sales, mon_sales, tue_sales, wed_sales,
+         thu_sales, fri_sales, sat_sales
+limit 100
+"""
+Q48 = """
+select sum(ss_quantity)
+from store_sales, store, customer_demographics, customer_address, date_dim
+where s_store_sk = ss_store_sk and ss_sold_date_sk = d_date_sk
+  and d_year = 2000
+  and ((cd_demo_sk = ss_cdemo_sk and cd_marital_status = 'M'
+        and cd_education_status = '4 yr Degree'
+        and ss_sales_price between 100.00 and 150.00)
+    or (cd_demo_sk = ss_cdemo_sk and cd_marital_status = 'D'
+        and cd_education_status = '2 yr Degree'
+        and ss_sales_price between 50.00 and 100.00)
+    or (cd_demo_sk = ss_cdemo_sk and cd_marital_status = 'S'
+        and cd_education_status = 'College'
+        and ss_sales_price between 150.00 and 200.00))
+  and ((ss_addr_sk = ca_address_sk and ca_country = 'United States'
+        and ca_state in ('CO','OH','TX') and ss_net_profit between 0 and 2000)
+    or (ss_addr_sk = ca_address_sk and ca_country = 'United States'
+        and ca_state in ('OR','MN','KY') and ss_net_profit between 150 and 3000)
+    or (ss_addr_sk = ca_address_sk and ca_country = 'United States'
+        and ca_state in ('VA','CA','MS') and ss_net_profit between 50 and 25000))
+"""
+Q50 = """
+select s_store_name, s_company_id, s_street_number, s_street_name,
+       s_street_type, s_suite_number, s_city, s_county, s_state, s_zip,
+       sum(case when (sr_returned_date_sk - ss_sold_date_sk <= 30) then 1
+                else 0 end) as d30,
+       sum(case when (sr_returned_date_sk - ss_sold_date_sk > 30) and
+                     (sr_returned_date_sk - ss_sold_date_sk <= 60) then 1
+                else 0 end) as d60,
+       sum(case when (sr_returned_date_sk - ss_sold_date_sk > 60) and
+                     (sr_returned_date_sk - ss_sold_date_sk <= 90) then 1
+                else 0 end) as d90,
+       sum(case when (sr_returned_date_sk - ss_sold_date_sk > 90) and
+                     (sr_returned_date_sk - ss_sold_date_sk <= 120) then 1
+                else 0 end) as d120,
+       sum(case when (sr_returned_date_sk - ss_sold_date_sk > 120) then 1
+                else 0 end) as dmore
+from store_sales, store_returns, store, date_dim d1, date_dim d2
+where d2.d_year = 2001 and d2.d_moy = 8
+  and ss_ticket_number = sr_ticket_number and ss_item_sk = sr_item_sk
+  and ss_sold_date_sk = d1.d_date_sk and sr_returned_date_sk = d2.d_date_sk
+  and ss_customer_sk = sr_customer_sk and ss_store_sk = s_store_sk
+group by s_store_name, s_company_id, s_street_number, s_street_name,
+         s_street_type, s_suite_number, s_city, s_county, s_state, s_zip
+order by s_store_name, s_company_id, s_street_number, s_street_name,
+         s_street_type, s_suite_number, s_city, s_county, s_state, s_zip
+limit 100
+"""
+
+QUERIES = {3: Q3, 7: Q7, 13: Q13, 15: Q15, 19: Q19, 21: Q21, 25: Q25,
+           26: Q26, 36: Q36, 42: Q42, 43: Q43, 48: Q48, 50: Q50,
            52: Q52, 55: Q55, 64: Q64, 72: Q72, 82: Q82}

@@ -78,6 +78,20 @@ def _kafka_factory(catalog: str, config: Dict[str, str]):
                           config.get("kafka.default-schema", "default"))
 
 
+def _raptor_factory(catalog: str, config: Dict[str, str]):
+    from ..connectors.raptor import RaptorConnector
+
+    base = config.get("raptor.data.dir")
+    if not base:
+        raise ValueError(f"catalog {catalog}: raptor.data.dir is required")
+    return RaptorConnector(
+        catalog, base,
+        compaction_threshold_rows=int(
+            config.get("raptor.compaction.threshold-rows", 1 << 17)),
+        organize_interval_s=float(
+            config.get("raptor.organization.interval-seconds", 0)))
+
+
 def _memory_factory(catalog: str, config: Dict[str, str]):
     from ..connectors.memory import MemoryConnector
 
@@ -111,6 +125,7 @@ FACTORIES: Dict[str, Callable] = {
     "hive": _hive_factory,
     "kafka": _kafka_factory,
     "sqlite": _sqlite_factory,
+    "raptor": _raptor_factory,
 }
 
 

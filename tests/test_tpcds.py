@@ -85,11 +85,52 @@ def test_q72(runner, oracle):
     assert len(res.rows) > 0, "Q72 returned no rows — data correlation too thin"
 
 
-@pytest.mark.parametrize("qid", [3, 7, 19, 21, 25, 42, 52, 55, 82])
+@pytest.mark.parametrize("qid", [3, 7, 13, 15, 19, 21, 25, 26, 42, 43, 52,
+                                 55, 82])
 def test_breadth_query(runner, oracle, qid):
     from presto_tpu.models.tpcds_sql import QUERIES
 
     check(runner, oracle, QUERIES[qid], ordered=True)
+
+
+def test_q50_returns_latency(runner, oracle):
+    """Q50's store_sales x store_returns latency buckets. The oracle gets a
+    temp index on the return join keys (sqlite's planner otherwise nested-
+    loops 40k x 8k rows for minutes); the engine runs the plain query."""
+    from presto_tpu.models.tpcds_sql import Q50
+
+    oracle.conn.execute(
+        "create index if not exists sr_join_idx on store_returns "
+        "(sr_ticket_number, sr_item_sk, sr_customer_sk)")
+    got = runner.execute(Q50)
+    assert_rows_equal(got.rows, oracle.query(to_sqlite(Q50)), ordered=True)
+
+
+def test_q48_or_join(runner, oracle):
+    """Q48's OR of join-correlated predicate branches. The oracle runs the
+    algebraically factored form (common cd/ca join conjuncts pulled out of
+    the OR) because sqlite's planner otherwise falls into a cross-product
+    nested loop; the engine executes the ORIGINAL spec shape."""
+    from presto_tpu.models.tpcds_sql import Q48
+
+    got = runner.execute(Q48)
+    factored = """
+select sum(ss_quantity)
+from store_sales, store, customer_demographics, customer_address, date_dim
+where s_store_sk = ss_store_sk and ss_sold_date_sk = d_date_sk
+  and d_year = 2000 and cd_demo_sk = ss_cdemo_sk
+  and ss_addr_sk = ca_address_sk and ca_country = 'United States'
+  and ((cd_marital_status = 'M' and cd_education_status = '4 yr Degree'
+        and ss_sales_price between 100.00 and 150.00)
+    or (cd_marital_status = 'D' and cd_education_status = '2 yr Degree'
+        and ss_sales_price between 50.00 and 100.00)
+    or (cd_marital_status = 'S' and cd_education_status = 'College'
+        and ss_sales_price between 150.00 and 200.00))
+  and ((ca_state in ('CO','OH','TX') and ss_net_profit between 0 and 2000)
+    or (ca_state in ('OR','MN','KY') and ss_net_profit between 150 and 3000)
+    or (ca_state in ('VA','CA','MS') and ss_net_profit between 50 and 25000))
+"""
+    assert_rows_equal(got.rows, oracle.query(to_sqlite(factored)))
 
 
 def test_q36_rollup(runner, oracle):
