@@ -312,15 +312,18 @@ class FileMetadata(ConnectorMetadata):
 
 
 def iter_pcol_pages(path: str, names, type_of, table_dicts, capacity: int,
-                    prefilter=None):
+                    prefilter_fn=None):
     """One pcol file -> fixed-capacity masked pages, remapping per-file
     varchar codes into the TABLE's unioned dictionaries. Shared by the file
-    and raptor connectors (one implementation of the chunk loop: columns are
-    read ONCE and sliced per chunk; `prefilter` ANDs into the row mask)."""
+    and raptor connectors (one implementation of the chunk loop: the file
+    is opened ONCE, columns are read once and sliced per chunk).
+    `prefilter_fn(pf) -> bool mask | None` runs on the open file and ANDs
+    into the row mask (the native libpcol range scan)."""
     pf = PcolFile(path)
     try:
         if pf.rows == 0:
             return
+        prefilter = prefilter_fn(pf) if prefilter_fn is not None else None
         cols = {}
         remap = {}
         for n in names:
@@ -448,18 +451,11 @@ class FilePageSource(ConnectorPageSource):
         name, path = self.split.payload
         info = self._metadata._load(name)
         table_dicts = {c.name: c.dictionary for c in info.metadata.columns}
-        pf = PcolFile(path)
-        try:
-            if pf.rows == 0:
-                return
-            prefilter = self._native_prefilter(pf)
-        finally:
-            pf.close()
         names = [c.name for c in self.columns]
         type_of = {c.name: info.metadata.column(c.name).type
                    for c in self.columns}
         yield from iter_pcol_pages(path, names, type_of, table_dicts,
-                                   self.capacity, prefilter)
+                                   self.capacity, self._native_prefilter)
 
     def _iter_external(self) -> Iterator[Page]:
         name, path, group = self.split.payload

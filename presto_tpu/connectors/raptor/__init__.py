@@ -141,6 +141,11 @@ class ShardManager:
         return [(n, _type_from_tag(tag, scale)) for n, tag, scale in rows]
 
     def drop_table(self, tid: int) -> None:
+        """Metadata delete is immediate (the table vanishes); shard FILES
+        go through deleted_shards like compaction leftovers, so a query
+        that already planned splits against the table can still finish."""
+        import time
+
         with self.lock:
             shards = [r[0] for r in self._conn.execute(
                 "select shard_uuid from shards where table_id = ?",
@@ -154,12 +159,10 @@ class ShardManager:
             for u in shards:
                 self._conn.execute(
                     "delete from shard_stats where shard_uuid = ?", (u,))
+                self._conn.execute(
+                    "insert or replace into deleted_shards values (?, ?)",
+                    (u, time.time()))
             self._conn.commit()
-        for u in shards:
-            try:
-                os.unlink(self.shard_path(u))
-            except OSError:
-                pass
 
     # ------------------------------------------------------------- shards
 
@@ -194,7 +197,7 @@ class ShardManager:
                 self._conn.execute(
                     "delete from shard_stats where shard_uuid = ?", (u,))
                 self._conn.execute(
-                    "insert into deleted_shards values (?, ?)",
+                    "insert or replace into deleted_shards values (?, ?)",
                     (u, time.time()))
             self._conn.execute("insert into shards values (?, ?, ?, ?)",
                                (new_uuid, tid, rows, 1 if compacted else 0))
@@ -311,14 +314,21 @@ class RaptorMetadata(ConnectorMetadata):
 
     def _dictionaries(self, tid: int) -> Dict[str, Dictionary]:
         """Union the shards' persisted varchar dictionaries (file-connector
-        pattern), cached against the shard list."""
+        pattern). Shard dictionaries are immutable, so the cached union
+        extends INCREMENTALLY with only unseen shards; a shrinking shard
+        set (compaction swapped files) forces a full rebuild."""
         shard_ids = tuple(u for u, _ in self.shards.shards(tid))
         with self._lock:
-            if self._dict_versions.get(tid) == shard_ids:
+            cached = self._dict_versions.get(tid)
+            if cached is not None and cached[0] == shard_ids:
                 return self._dict_cache[tid]
-        seen: Dict[str, Dict[str, int]] = {}
-        order: Dict[str, List[str]] = {}
-        for u in shard_ids:
+            if cached is not None and set(cached[0]) <= set(shard_ids):
+                new_ids = [u for u in shard_ids if u not in set(cached[0])]
+                seen, order = cached[1], cached[2]
+            else:
+                new_ids = list(shard_ids)
+                seen, order = {}, {}
+        for u in new_ids:
             pf = PcolFile(self.shards.shard_path(u))
             try:
                 for name, e in pf.columns.items():
@@ -335,7 +345,7 @@ class RaptorMetadata(ConnectorMetadata):
         dicts = {n: Dictionary(vals) for n, vals in order.items()}
         with self._lock:
             self._dict_cache[tid] = dicts
-            self._dict_versions[tid] = shard_ids
+            self._dict_versions[tid] = (shard_ids, seen, order)
         return dicts
 
     def get_table_metadata(self, table: TableHandle) -> TableMetadata:
@@ -364,8 +374,9 @@ class RaptorMetadata(ConnectorMetadata):
         return table
 
     def finish_insert(self, handle, fragments) -> None:
-        with self._lock:  # new shards may extend dictionaries
-            self._dict_versions.pop(handle.extra[0], None)
+        # nothing to invalidate: _dictionaries detects the new shard ids and
+        # extends the cached union incrementally
+        pass
 
     def drop_table(self, table: TableHandle) -> None:
         self.shards.drop_table(table.extra[0])
@@ -476,6 +487,9 @@ class RaptorConnector(Connector):
         self._sources = RaptorPageSourceProvider(self._metadata)
         self._sinks = RaptorPageSinkProvider(self._metadata)
         self.compaction_threshold_rows = compaction_threshold_rows
+        # one compaction pass at a time: a background organizer racing an
+        # on-demand maintenance() would merge the same shards twice
+        self._organize_lock = threading.Lock()
         self._organizer_stop = threading.Event()
         if organize_interval_s > 0:
             t = threading.Thread(target=self._organizer_loop,
@@ -489,11 +503,12 @@ class RaptorConnector(Connector):
         purge shard files whose metadata rows were dropped more than
         `grace_s` ago (deferred deletion keeps in-flight scans safe).
         Returns the number of shards removed by compaction."""
-        self.shard_manager.purge_deleted(grace_s)
-        removed = 0
-        for tid in self.shard_manager.all_table_ids():
-            removed += self._compact_table(tid)
-        return removed
+        with self._organize_lock:
+            self.shard_manager.purge_deleted(grace_s)
+            removed = 0
+            for tid in self.shard_manager.all_table_ids():
+                removed += self._compact_table(tid)
+            return removed
 
     def _compact_table(self, tid: int) -> int:
         sm = self.shard_manager

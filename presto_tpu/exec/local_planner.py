@@ -441,6 +441,7 @@ class LocalExecutionPlanner:
             sources = self._page_sources(cur, constraint)
             fac = TableScanOperatorFactory(next(self._ids), sources,
                                            processor.output_types, processor)
+            fac.has_filter = processor.filter is not None
             return Chain([fac], list(out_symbols), processor.output_dicts)
         fac = FilterProjectOperatorFactory(next(self._ids), processor=processor)
         return Chain(base.factories + [fac], list(out_symbols),
@@ -538,11 +539,30 @@ class LocalExecutionPlanner:
 
     # ------------------------------------------------------------- joins
 
+    def _maybe_coalesce(self, chain: Chain) -> Chain:
+        """Insert a page-coalescing stage when the chain ends in a FILTERED
+        scan feeding a join: the join's per-page kernel work (and, on the
+        tunnel TPU, per-page dispatches) then scales with the filter's
+        survivors instead of the scanned capacity. The operator itself
+        adapts at runtime — an unselective filter switches it to permanent
+        pass-through after the first page (ops/coalesce.py)."""
+        if not self.session.get("coalesce_pages") or not chain.factories:
+            return chain
+        last = chain.factories[-1]
+        if not getattr(last, "has_filter", False):
+            return chain
+        from ..ops.coalesce import CoalesceOperatorFactory
+
+        fac = CoalesceOperatorFactory(
+            next(self._ids), [s.type for s in chain.symbols],
+            list(chain.dicts))
+        return Chain(chain.factories + [fac], chain.symbols, chain.dicts)
+
     def visit_JoinNode(self, node: JoinNode) -> Chain:
         if not node.criteria:
             return self._plan_cross_join(node)
-        probe_chain = self.visit(node.left)
-        build_chain = self.visit(node.right)
+        probe_chain = self._maybe_coalesce(self.visit(node.left))
+        build_chain = self._maybe_coalesce(self.visit(node.right))
 
         left_keys = [l for l, _ in node.criteria]
         right_keys = [r for _, r in node.criteria]

@@ -52,6 +52,10 @@ class Session:
         # (one sink file each) when the source is at least K * this many rows
         "scaled_writers": True,
         "writer_min_rows_per_driver": 1 << 20,
+        # pack filtered scans' surviving rows into full pages before the
+        # stateful operators (ops/coalesce.py) — downstream kernel work and
+        # per-page dispatches then scale with selectivity
+        "coalesce_pages": True,
     }
 
     def get(self, name: str, default=None):

@@ -1,0 +1,161 @@
+"""Page coalescing after selective filters: dense pages for downstream ops.
+
+The engine's pages are FIXED-capacity device arrays with row masks; a
+selective fused filter-scan emits pages whose live rows are a small
+fraction of capacity, and every downstream operator (join probe, hash
+aggregation) still pays full-capacity kernel work per page. This operator
+COMPACTS each input page on device and packs live rows into an
+accumulator, emitting only FULL pages (plus one tail) — the reference's
+PageProcessor output coalescing / MergePages.java, re-shaped for static
+XLA shapes:
+
+- compact: one scatter per page (block._compact), XLA-fused;
+- pack: `lax.dynamic_update_slice` at the accumulator's live count — a
+  dynamic OFFSET is fine under jit (shapes stay static);
+- overflow: concat(acc, incoming)[:C] emits, [C:] is the new accumulator —
+  all static shapes, one compiled kernel per schema.
+
+Downstream work drops by the filter's selectivity (a 0.02-selective Q6
+scan feeds ~50x fewer pages into the aggregation), and on the remote-
+tunnel TPU each page saved is a dispatch round-trip saved.
+"""
+from __future__ import annotations
+
+import functools
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..block import Block, Page, _compact
+from ..types import Type
+from .operator import Operator, OperatorContext, OperatorFactory, timed
+
+
+@functools.partial(jax.jit, donate_argnums=())
+def _pack(acc: Page, count, page: Page):
+    """(accumulator, live count, compacted incoming) ->
+    (emit page, emit flag, new accumulator, new count).
+
+    The incoming page is already compacted (live rows in prefix). Result
+    shapes are static: emit is capacity C; the combined view is 2C wide."""
+    cap = acc.capacity
+    n_in = jnp.sum(page.mask.astype(jnp.int32))
+
+    def combine(a, b):
+        return jnp.concatenate([a, b])
+
+    blocks = []
+    for ab, pb in zip(acc.blocks, page.blocks):
+        # place incoming prefix at offset `count` inside a 2C scratch
+        scratch = combine(ab.data, jnp.zeros_like(pb.data))
+        scratch = jax.lax.dynamic_update_slice(
+            scratch, pb.data, (count,))
+        nulls = None
+        if ab.nulls is not None or pb.nulls is not None:
+            ns = combine(ab.null_mask(), jnp.zeros_like(pb.null_mask()))
+            ns = jax.lax.dynamic_update_slice(ns, pb.null_mask(), (count,))
+            nulls = ns
+        blocks.append((scratch, nulls, ab))
+    total = count + n_in
+    emit = total >= cap
+    # emit the first C rows; the remainder [C:2C) becomes the accumulator
+    out_blocks = []
+    rest_blocks = []
+    for scratch, nulls, ab in blocks:
+        out_blocks.append(Block(ab.type, scratch[:cap],
+                                None if nulls is None else nulls[:cap],
+                                ab.dictionary))
+        # when not emitting, the accumulator keeps the packed prefix
+        keep = jnp.where(emit, scratch[cap:], scratch[:cap])
+        kn = None
+        if nulls is not None:
+            kn = jnp.where(emit, nulls[cap:], nulls[:cap])
+        rest_blocks.append(Block(ab.type, keep, kn, ab.dictionary))
+    idx = jnp.arange(cap, dtype=jnp.int32)
+    out_mask = idx < jnp.minimum(total, cap)
+    new_count = jnp.where(emit, total - cap, total)
+    rest_mask = idx < new_count
+    return (Page(tuple(out_blocks), out_mask), emit,
+            Page(tuple(rest_blocks), rest_mask), new_count)
+
+
+class CoalesceOperator(Operator):
+    def __init__(self, context: OperatorContext, types: List[Type], dicts):
+        super().__init__(context)
+        self._types = types
+        self._dicts = dicts
+        self._acc: Optional[Page] = None
+        self._count = None
+        self._pending: List[Page] = []
+        self._flushed = False
+
+    @property
+    def output_types(self) -> List[Type]:
+        return self._types
+
+    def needs_input(self) -> bool:
+        return not self._finishing and not self._pending
+
+    #: live fraction above which packing cannot pay for itself
+    PASSTHROUGH_SELECTIVITY = 0.5
+
+    _mode = None  # None (undecided) | "pack" | "pass"
+
+    @timed("add_input_ns")
+    def add_input(self, page: Page) -> None:
+        self.context.record_input(page, page.capacity)
+        if self._mode == "pass":
+            self._pending.append(page)
+            return
+        if self._mode is None:
+            # adapt on the FIRST page: an unselective filter makes packing
+            # pure overhead, so switch to permanent pass-through (per-scan
+            # selectivity is stationary — one decision suffices)
+            import numpy as np
+
+            frac = float(np.asarray(jnp.mean(
+                page.mask.astype(jnp.float32))))
+            if frac > self.PASSTHROUGH_SELECTIVITY:
+                self._mode = "pass"
+                self._pending.append(page)
+                return
+            self._mode = "pack"
+        compacted = _compact(page)
+        if self._acc is None:
+            self._acc = compacted
+            self._count = jnp.sum(compacted.mask.astype(jnp.int32))
+            return
+        out, emit, rest, new_count = _pack(self._acc, self._count, compacted)
+        self._acc, self._count = rest, new_count
+        # host sync on the 4-byte flag only; the page stays on device
+        if bool(emit):
+            self._pending.append(out)
+
+    @timed("get_output_ns")
+    def get_output(self) -> Optional[Page]:
+        if self._pending:
+            page = self._pending.pop(0)
+            self.context.record_output(page, page.capacity)
+            return page
+        if self._finishing and not self._flushed:
+            self._flushed = True
+            if self._acc is not None:
+                tail = self._acc
+                self._acc = None
+                self.context.record_output(tail, tail.capacity)
+                return tail
+        return None
+
+    def is_finished(self) -> bool:
+        return self._finishing and self._flushed and not self._pending
+
+
+class CoalesceOperatorFactory(OperatorFactory):
+    def __init__(self, operator_id: int, types: List[Type], dicts=None):
+        super().__init__(operator_id, "Coalesce")
+        self.types = types
+        self.dicts = dicts or [None] * len(types)
+
+    def create_operator(self, worker: int = 0) -> CoalesceOperator:
+        return CoalesceOperator(self.context(worker), self.types, self.dicts)

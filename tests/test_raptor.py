@@ -107,11 +107,15 @@ def test_compaction_merges_small_shards(runner, oracle, tmp_path):
     assert_rows_equal(got.rows, exp)
 
 
-def test_drop_table_removes_shard_files(runner, tmp_path):
+def test_drop_table_defers_file_removal(runner, tmp_path):
     runner.execute("create table raptor.default.tmp as select * from nation")
     files = os.listdir(str(tmp_path / "storage"))
     assert files
     runner.execute("drop table raptor.default.tmp")
-    assert os.listdir(str(tmp_path / "storage")) == []
     db = sqlite3.connect(str(tmp_path / "metadata.db"))
+    # metadata delete is immediate; FILES survive a grace period so queries
+    # that already planned splits can finish (deferred-deletion contract)
     assert db.execute("select count(*) from shards").fetchone()[0] == 0
+    assert os.listdir(str(tmp_path / "storage")) != []
+    _conn(runner).maintenance(grace_s=0.0)
+    assert os.listdir(str(tmp_path / "storage")) == []
