@@ -225,11 +225,28 @@ def bench_sql_query(query_id: int, schema: str, seconds_budget: float,
                 "output_rows": rows0}
 
     out = measure(schema)
-    # the escalated schema costs ~(warm-up + >=1 timed run + recompile slack)
-    # = >= 3x one run; guard on the full predicted spend, not a single run
-    if escalate_to and out["wall_s"] * escalate_ratio * 3 <= escalate_budget_s:
+    # the escalated schema costs ~(warm-up + >=1 timed run + recompile
+    # slack) = >= 3x one run; guard on the predicted spend. The wall-ratio
+    # prediction is far too pessimistic when the small-schema wall is FIXED
+    # overhead (dispatch, not per-row work) — on the CPU backend (local,
+    # cached compiles) predict from measured THROUGHPUT instead: per-row
+    # rate only improves at scale, so src_rows/rate upper-bounds one run;
+    # allow 2x the budget for that bound (measured: Q3 sf1 actual ~7s vs a
+    # ~320s wall-ratio prediction and a ~105s throughput bound).
+    import jax as _jax
+
+    if _jax.default_backend() == "cpu":
+        from presto_tpu.models import hand_queries as _hq
+
+        predicted = _hq.source_rows(f"q{query_id}", escalate_to or "sf1")             / max(out["rows_per_sec"], 1)
+        fits = predicted <= 2 * escalate_budget_s
+    else:
+        fits = out["wall_s"] * escalate_ratio * 3 <= escalate_budget_s
+    if escalate_to and fits:
         try:
-            out = measure(escalate_to)
+            escalated = measure(escalate_to)
+            escalated["small_schema"] = out
+            out = escalated
         except Exception as e:  # keep the small-schema number
             out["escalate_error"] = repr(e)[:200]
     return out

@@ -56,7 +56,14 @@ class _ExternalFile:
 
     def __init__(self, path: str):
         self.path = path
-        if path.endswith(".orc"):
+        if path.endswith(".rc"):
+            from ...formats.rcfile import RcTableFile
+            self._f = RcTableFile(path)
+            self.n_chunks = self._f.n_chunks
+            self.chunk_rows = self._f.chunk_rows
+            self.read_chunk = self._f.read_chunk
+            self.chunk_stats = self._f.chunk_stats
+        elif path.endswith(".orc"):
             from ...formats.orc import OrcFile
             self._f = OrcFile(path)
             self.n_chunks = self._f.n_stripes
@@ -126,7 +133,7 @@ class FileMetadata(ConnectorMetadata):
         if not os.path.isdir(d):
             return []
         return sorted(os.path.join(d, f) for f in os.listdir(d)
-                      if f.endswith((".pcol", ".parquet", ".orc")))
+                      if f.endswith((".pcol", ".parquet", ".orc", ".rc")))
 
     def _load(self, name: SchemaTableName) -> Optional[_TableInfo]:
         files = self._files_of(name)
@@ -143,7 +150,7 @@ class FileMetadata(ConnectorMetadata):
                 f"table {name} mixes {'/'.join(sorted(exts))} files — "
                 f"unsupported (write every file through one catalog "
                 f"with a consistent file.format)")
-        if exts in ({"parquet"}, {"orc"}):
+        if exts in ({"parquet"}, {"orc"}, {"rc"}):
             return self._load_external(name, files, sig)
         headers = []
         rows = 0
@@ -276,10 +283,11 @@ class FileMetadata(ConnectorMetadata):
 
     def begin_insert(self, table: TableHandle):
         files = self._files_of(table.schema_table)
-        if any(f.endswith(".orc") for f in files):
+        if any(f.endswith((".orc", ".rc")) for f in files):
             raise RuntimeError(
-                f"table {table.schema_table} is ORC-backed and read-only "
-                f"(the engine writes pcol or parquet; ORC is ingest-only)")
+                f"table {table.schema_table} is ORC/RCFile-backed and "
+                f"read-only (the engine writes pcol or parquet; ORC and "
+                f"RCFile are ingest-only)")
         has_parquet = any(f.endswith(".parquet") for f in files)
         if has_parquet and self.write_format != "parquet":
             raise RuntimeError(
@@ -301,6 +309,8 @@ class FileMetadata(ConnectorMetadata):
         d = self._table_dir(table.schema_table)
         for f in self._files_of(table.schema_table):
             os.unlink(f)
+            if f.endswith(".rc") and os.path.isfile(f + ".schema"):
+                os.unlink(f + ".schema")  # rcfile's sidecar type descriptor
         try:
             os.rmdir(d)
         except OSError:
@@ -375,7 +385,7 @@ class FileSplitManager(ConnectorSplitManager):
     def get_splits(self, table: TableHandle, constraint: Constraint,
                    desired_splits: int) -> List[Split]:
         info = self._metadata.table_info(table)
-        if info.files and info.files[0].endswith((".parquet", ".orc")):
+        if info.files and info.files[0].endswith((".parquet", ".orc", ".rc")):
             return self._external_splits(table, info, constraint)
         splits = []
         for b, f in enumerate(info.files):

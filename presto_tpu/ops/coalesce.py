@@ -122,6 +122,13 @@ class CoalesceOperator(Operator):
                 return
             self._mode = "pack"
         compacted = _compact(page)
+        if self._acc is not None and \
+                self._acc.capacity != compacted.capacity:
+            # sources with per-chunk capacities (parquet/orc clamp to the
+            # chunk's pow2 bucket) change shape mid-stream: flush the
+            # accumulator as a partial page and restart at the new capacity
+            self._pending.append(self._acc)
+            self._acc = None
         if self._acc is None:
             self._acc = compacted
             self._count = jnp.sum(compacted.mask.astype(jnp.int32))

@@ -1,0 +1,94 @@
+"""RCFile format: Hadoop layout round-trip, lazy column skip, SQL scans.
+
+Reference: presto-rcfile (RcFileReader + text SerDe) and Hive's
+RCFile.java layout (sync markers, run-length cell-length vints,
+per-column DefaultCodec compression).
+"""
+import pytest
+
+from presto_tpu.formats.rcfile import (RcFile, decode_cells, write_rcfile,
+                                       write_rcfile_table, write_vlong,
+                                       _Cursor)
+from presto_tpu.runner import LocalQueryRunner
+from presto_tpu.types import BIGINT, DOUBLE, DecimalType, VARCHAR
+from presto_tpu.utils.testing import SqliteOracle, assert_rows_equal
+
+
+def test_vlong_roundtrip():
+    cur = lambda v: _Cursor(write_vlong(v)).read_vlong()  # noqa: E731
+    for v in (0, 1, -1, 127, 128, -112, -113, 255, 1 << 20, -(1 << 20),
+              1 << 40, -(1 << 40), (1 << 62)):
+        assert cur(v) == v, v
+
+
+@pytest.mark.parametrize("compress", [False, True])
+def test_rcfile_roundtrip(tmp_path, compress):
+    path = str(tmp_path / "t.rc")
+    cols = [
+        [str(i) for i in range(1000)],                       # bigint text
+        [None if i % 7 == 0 else f"name{i % 5}" for i in range(1000)],
+        [f"{i}.25" for i in range(1000)],                    # decimal text
+    ]
+    write_rcfile(path, cols, rows_per_group=256, compress=compress)
+    f = RcFile(path)
+    assert f.num_rows == 1000 and f.n_groups == 4
+    assert f.compressed is compress
+    # lazy column read: only column 1 requested
+    raw = f.read_group(0, [1])
+    assert set(raw) == {1}
+    assert raw[1][0] is None and raw[1][1] == b"name1"
+    vals, nulls = decode_cells(raw[1], VARCHAR)
+    assert nulls[0] and not nulls[1]
+    # typed decode of numerics across all groups
+    total = 0
+    for g in range(f.n_groups):
+        arr, nl = decode_cells(f.read_group(g, [0])[0], BIGINT)
+        assert nl is None
+        total += int(arr.sum())
+    assert total == sum(range(1000))
+    dec, _ = decode_cells(f.read_group(0, [2])[2], DecimalType(10, 2))
+    assert dec[1] == 125  # "1.25" at scale 2
+
+
+def test_rcfile_sql_scan(tmp_path):
+    base = tmp_path / "wh" / "default" / "events"
+    base.mkdir(parents=True)
+    names = ["id", "name", "score"]
+    types = [BIGINT, VARCHAR, DOUBLE]
+    cols = [
+        [str(i) for i in range(50)],
+        [None if i == 13 else f"u{i % 4}" for i in range(50)],
+        [f"{i}.5" for i in range(50)],
+    ]
+    write_rcfile_table(str(base / "part0.rc"), names, types, cols,
+                       rows_per_group=16)
+    from presto_tpu.connectors.file import FileConnector
+
+    r = LocalQueryRunner()
+    r.catalogs.register("wh", FileConnector("wh", str(tmp_path / "wh")))
+    got = r.execute(
+        "select name, count(*), sum(score) from wh.default.events "
+        "where id >= 10 group by name order by name")
+    o = SqliteOracle()
+    o.conn.execute("create table events (id int, name text, score real)")
+    o.conn.executemany(
+        "insert into events values (?, ?, ?)",
+        [(int(cols[0][i]), cols[1][i], float(cols[2][i]))
+         for i in range(50)])
+    exp = o.query("select name, count(*), sum(score) from events "
+                  "where id >= 10 group by name order by name")
+    # unordered compare: the engine orders NULLS LAST (Presto default),
+    # sqlite NULLS FIRST — a dialect difference, not a wrong result
+    assert_rows_equal(got.rows, exp)
+
+
+def test_rcfile_is_ingest_only(tmp_path):
+    base = tmp_path / "wh" / "default" / "t"
+    base.mkdir(parents=True)
+    write_rcfile_table(str(base / "a.rc"), ["x"], [BIGINT], [["1", "2"]])
+    from presto_tpu.connectors.file import FileConnector
+
+    r = LocalQueryRunner()
+    r.catalogs.register("wh", FileConnector("wh", str(tmp_path / "wh")))
+    with pytest.raises(Exception, match="read-only"):
+        r.execute("insert into wh.default.t select 3")
