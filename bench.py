@@ -236,9 +236,8 @@ def bench_sql_query(query_id: int, schema: str, seconds_budget: float,
     import jax as _jax
 
     if _jax.default_backend() == "cpu":
-        from presto_tpu.models import hand_queries as _hq
-
-        predicted = _hq.source_rows(f"q{query_id}", escalate_to or "sf1")             / max(out["rows_per_sec"], 1)
+        predicted = (hq.source_rows(f"q{query_id}", escalate_to or "sf1")
+                     / max(out["rows_per_sec"], 1))
         fits = predicted <= 2 * escalate_budget_s
     else:
         fits = out["wall_s"] * escalate_ratio * 3 <= escalate_budget_s

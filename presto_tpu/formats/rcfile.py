@@ -43,6 +43,10 @@ VERSION = 1
 DEFLATE_CODEC = "org.apache.hadoop.io.compress.DefaultCodec"
 COLUMN_NUMBER_KEY = "hive.io.rcfile.column.number"
 NULL_TEXT = b"\\N"
+import re as _re
+
+#: cells that need (had) NULL escaping: one or more backslashes then N
+_SENTINEL_FAMILY = _re.compile(rb"\\+N")
 
 
 # ------------------------------------------------------------- hadoop vints
@@ -138,8 +142,11 @@ def write_rcfile(path: str, columns: Sequence[Sequence[Optional[str]]],
                     cells.append(NULL_TEXT)
                 else:
                     b = str(v).encode("utf-8")
-                    if b == NULL_TEXT:  # literal backslash-N data: escape so
-                        b = b"\\\\N"    # it never reads back as NULL
+                    # injective NULL escaping: any \...\N-shaped cell gains
+                    # one leading backslash so the sentinel never collides
+                    # with data (unescaping strips exactly one)
+                    if _SENTINEL_FAMILY.fullmatch(b):
+                        b = b"\\" + b
                     cells.append(b)
             col_cells.append(cells)
         key = bytearray(write_vlong(n))
@@ -185,9 +192,17 @@ class RcFile:
     """Row groups of text-serde cells; column-pruned, typed decoding."""
 
     def __init__(self, path: str):
+        import mmap
+
         self.path = path
-        with open(path, "rb") as f:
-            self._buf = f.read()
+        # mmap, not read(): the index walk touches only record headers and
+        # key buffers; value bytes page in lazily when a scan reads them
+        self._file = open(path, "rb")
+        try:
+            self._buf = mmap.mmap(self._file.fileno(), 0,
+                                  access=mmap.ACCESS_READ)
+        except ValueError:  # empty file
+            self._buf = b""
         cur = _Cursor(self._buf)
         if cur.read(3) != MAGIC:
             raise ValueError(f"{path}: not an RCFile (bad magic)")
@@ -276,8 +291,8 @@ class RcFile:
                     o += ln
                     if cell == NULL_TEXT:
                         cells.append(None)
-                    elif cell == b"\\\\N":  # escaped literal backslash-N
-                        cells.append(NULL_TEXT)
+                    elif _SENTINEL_FAMILY.fullmatch(cell):
+                        cells.append(cell[1:])  # strip the escape backslash
                     else:
                         cells.append(cell)
                 out[c] = cells
@@ -291,22 +306,29 @@ _OPEN_LOCK = __import__("threading").Lock()
 
 def open_rcfile(path: str) -> "RcFile":
     """Signature-cached open: the connector constructs a reader per split
-    and RcFile.__init__ reads + indexes the WHOLE file — without the cache
-    a G-group scan would re-read and re-decompress the index G+1 times."""
+    and RcFile.__init__ walks + key-decompresses the group index — the
+    cache makes a G-group scan index once, not G+1 times. Buffers are
+    mmap-backed (page cache, not heap), so cached entries pin only the
+    index, and construction happens OUTSIDE the lock."""
     import os
 
     st = os.stat(path)
     key = (path, st.st_mtime, st.st_size)
     with _OPEN_LOCK:
         f = _OPEN_CACHE.get(key)
-        if f is None:
-            stale = [k for k in _OPEN_CACHE if k[0] == path]
-            for k in stale:
-                del _OPEN_CACHE[k]
-            while len(_OPEN_CACHE) > 16:
-                del _OPEN_CACHE[next(iter(_OPEN_CACHE))]
-            f = RcFile(path)
-            _OPEN_CACHE[key] = f
+    if f is not None:
+        return f
+    f = RcFile(path)
+    with _OPEN_LOCK:
+        cur = _OPEN_CACHE.get(key)
+        if cur is not None:
+            return cur
+        stale = [k for k in _OPEN_CACHE if k[0] == path]
+        for k in stale:
+            del _OPEN_CACHE[k]
+        while len(_OPEN_CACHE) > 16:
+            del _OPEN_CACHE[next(iter(_OPEN_CACHE))]
+        _OPEN_CACHE[key] = f
     return f
 
 
@@ -403,7 +425,7 @@ def decode_cells(cells: Sequence[Optional[bytes]], t: Type
             arr[i] = int((dt - datetime.datetime(1970, 1, 1)
                           ).total_seconds() * 1000)
         elif t.name == "boolean":
-            arr[i] = s in ("true", "TRUE", "1")
+            arr[i] = s.lower() in ("true", "1")
         elif t.name in ("double", "real"):
             arr[i] = float(s)
         else:

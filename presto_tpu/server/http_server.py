@@ -128,7 +128,8 @@ class _Handler(BaseHTTPRequestHandler):
             sql, user=user,
             source=self.headers.get("X-Presto-Source", ""),
             catalog=self.headers.get("X-Presto-Catalog", ""),
-            schema=self.headers.get("X-Presto-Schema", ""))
+            schema=self.headers.get("X-Presto-Schema", ""),
+            trace_token=self.headers.get("X-Presto-Trace-Token", ""))
         self._send_json(self.manager.results_payload(info, 0, self._base_uri()))
 
     def do_GET(self) -> None:  # noqa: N802
@@ -211,6 +212,7 @@ class _Handler(BaseHTTPRequestHandler):
             "queryId": info.query_id,
             "state": info.state,
             "query": info.sql,
+            "traceToken": getattr(info, "trace_token", ""),
             "rowCount": info.row_count,
             "elapsedMillis": int(
                 ((info.end_time or time.time()) - info.create_time) * 1000),

@@ -47,6 +47,7 @@ class QueryInfo:
     source: str = ""
     catalog: str = ""    # per-query default-catalog override (JDBC/DBAPI)
     schema: str = ""
+    trace_token: str = ""   # X-Presto-Trace-Token correlation id
 
     def done(self) -> bool:
         return self.state in _DONE
@@ -87,18 +88,21 @@ class QueryManager:
     # ----------------------------------------------------------------- api
 
     def submit(self, sql: str, user: str = "", source: str = "",
-               catalog: str = "", schema: str = "") -> QueryInfo:
+               catalog: str = "", schema: str = "",
+               trace_token: str = "") -> QueryInfo:
         with self._lock:
             qid = f"q{next(self._ids)}_{int(time.time())}"
             info = QueryInfo(qid, sql, user=user, source=source,
-                             catalog=catalog, schema=schema)
+                             catalog=catalog, schema=schema,
+                             trace_token=trace_token)
             self._queries[qid] = info
             self._expire_locked()
         if self.monitor is not None:
             from ..spi.eventlistener import QueryCreatedEvent
 
             self.monitor.query_created(
-                QueryCreatedEvent(qid, sql, user=user, source=source))
+                QueryCreatedEvent(qid, sql, user=user, source=source,
+                                  trace_token=trace_token))
         from ..utils.metrics import METRICS
         METRICS.count("query_manager.submitted")
         threading.Thread(target=self._run, args=(info,), daemon=True).start()
@@ -219,6 +223,7 @@ class QueryManager:
 
                 self.monitor.query_completed(QueryCompletedEvent(
                     info.query_id, info.sql, state=info.state, user=info.user,
+                    trace_token=info.trace_token,
                     row_count=info.row_count,
                     wall_seconds=time.monotonic() - t0, error=info.error))
 

@@ -19,6 +19,10 @@ class QueryCreatedEvent:
     sql: str
     user: str = ""
     source: str = ""
+    # client-supplied correlation id (X-Presto-Trace-Token), threaded
+    # through events and /v1/query so external tracing can stitch a
+    # request to the engine's execution (QueryMonitor's trace token)
+    trace_token: str = ""
     create_time: float = dataclasses.field(default_factory=time.time)
 
 
@@ -28,6 +32,7 @@ class QueryCompletedEvent:
     sql: str
     state: str = "FINISHED"            # FINISHED | FAILED | CANCELED
     user: str = ""
+    trace_token: str = ""
     row_count: int = 0
     wall_seconds: float = 0.0
     error: Optional[Dict] = None

@@ -6,6 +6,7 @@ the presto-cli happy path."""
 import io
 import json
 import sys
+import time
 import urllib.request
 
 import pytest
@@ -212,3 +213,25 @@ def test_web_ui_served(base):
     # root also serves the dashboard (the reference redirects / to its UI)
     root = urllib.request.urlopen(f"{base}/", timeout=30).read().decode()
     assert "presto-tpu" in root
+
+
+def test_trace_token_threads_through(base):
+    """X-Presto-Trace-Token correlates a client request with the engine's
+    query record and events (QueryMonitor trace-token analogue)."""
+    import urllib.request
+
+    req = urllib.request.Request(
+        f"{base}/v1/statement", data=b"select 1",
+        headers={"X-Presto-User": "t", "X-Presto-Trace-Token": "trace-42"})
+    resp = json.loads(urllib.request.urlopen(req, timeout=10).read())
+    qid = resp["id"]
+    deadline = time.time() + 60
+    while resp.get("nextUri") and time.time() < deadline:
+        resp = json.loads(urllib.request.urlopen(urllib.request.Request(
+            resp["nextUri"], headers={"X-Presto-User": "t"}),
+            timeout=10).read())
+    queries = json.loads(urllib.request.urlopen(urllib.request.Request(
+        f"{base}/v1/query", headers={"X-Presto-User": "t"}),
+        timeout=10).read())
+    mine = [q for q in queries if q["queryId"] == qid]
+    assert mine and mine[0]["traceToken"] == "trace-42"
