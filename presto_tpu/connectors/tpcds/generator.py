@@ -73,6 +73,12 @@ COUNTRIES = ["United States"]
 STREET_TYPES = ["Ave", "Blvd", "Cir", "Ct", "Dr", "Ln", "Pkwy", "RD",
                 "ST", "Way"]
 CHANNEL_FLAGS = ["N", "Y"]
+FIRST_NAMES = ["James", "Mary", "John", "Patricia", "Robert", "Jennifer",
+               "Michael", "Linda", "William", "Elizabeth", "David",
+               "Barbara", "Richard", "Susan", "Joseph", "Jessica"]
+LAST_NAMES = ["Smith", "Johnson", "Williams", "Brown", "Jones", "Garcia",
+              "Miller", "Davis", "Rodriguez", "Martinez", "Hernandez",
+              "Lopez", "Gonzales", "Wilson", "Anderson", "Thomas"]
 
 DICT_MARITAL = Dictionary(MARITAL)
 DICT_EDUCATION = Dictionary(sorted(EDUCATION))
@@ -97,6 +103,8 @@ DICT_COUNTY = Dictionary(sorted(COUNTIES))
 DICT_COUNTRY = Dictionary(COUNTRIES)
 DICT_STREET_TYPE = Dictionary(sorted(STREET_TYPES))
 DICT_CHANNEL = Dictionary(CHANNEL_FLAGS)  # already sorted: N < Y
+DICT_FIRST_NAME = Dictionary(sorted(FIRST_NAMES))
+DICT_LAST_NAME = Dictionary(sorted(LAST_NAMES))
 class _ZipDictionary(FormattedDictionary):
     """5-digit zips: codes ARE the numeric value, so string constants
     reverse-map by parsing (code_of) and substr(zip, 1, 5) is identity."""
@@ -182,6 +190,11 @@ def _make_date_dim() -> Table:
                lambda i, sf: (((i % 365) // 92) % 4 + 1).astype(np.int32)),
         Column("d_day_name", VARCHAR, lambda i, sf: _day_name_codes(i),
                DICT_DAY_NAME),
+        # dsdgen convention: 0 = Sunday .. 6 = Saturday (the spec queries
+        # use d_dow in (6, 0) for weekends); 1998-01-01 was a Thursday = 4
+        Column("d_dow", INTEGER,
+               lambda i, sf: ((np.asarray(i, dtype=np.int64) + 4) % 7
+                              ).astype(np.int32)),
     ])
 
 
@@ -304,6 +317,16 @@ def _make_customer() -> Table:
                lambda i, sf: first_sales(i, sf) + 30),
         Column("c_birth_year", INTEGER,
                lambda i, sf: _uniform(T, 6, i, 1930, 1992).astype(np.int32)),
+        Column("c_first_name", VARCHAR,
+               lambda i, sf: _sorted_codes(
+                   DICT_FIRST_NAME, FIRST_NAMES,
+                   _uniform(T, 7, i, 0, len(FIRST_NAMES) - 1)),
+               DICT_FIRST_NAME),
+        Column("c_last_name", VARCHAR,
+               lambda i, sf: _sorted_codes(
+                   DICT_LAST_NAME, LAST_NAMES,
+                   _uniform(T, 8, i, 0, len(LAST_NAMES) - 1)),
+               DICT_LAST_NAME),
     ])
 
 
@@ -369,10 +392,14 @@ def _make_household_demographics() -> Table:
                lambda i, sf: _sorted_codes(
                    DICT_BUY_POTENTIAL, BUY_POTENTIAL,
                    (i // 20) % 6), DICT_BUY_POTENTIAL),
+        # divisors chosen so the FULL spec domains (dep 0..9, vehicle 0..5)
+        # appear within the 720-row table — (i//120)%10 never wrapped past
+        # 5, which made spec predicates like hd_vehicle_count > 2
+        # unsatisfiable at every scale
         Column("hd_dep_count", INTEGER,
-               lambda i, sf: ((i // 120) % 10).astype(np.int32)),
+               lambda i, sf: ((i // 72) % 10).astype(np.int32)),
         Column("hd_vehicle_count", INTEGER,
-               lambda i, sf: ((i // 240) % 6).astype(np.int32)),
+               lambda i, sf: ((i // 120) % 6).astype(np.int32)),
     ])
 
 

@@ -404,7 +404,57 @@ order by s_store_name, s_company_id, s_street_number, s_street_name,
          s_street_type, s_suite_number, s_city, s_county, s_state, s_zip
 limit 100
 """
+Q46 = """
+select c_last_name, c_first_name, ca_city, bought_city, ss_ticket_number, amt, profit
+from (select ss_ticket_number, ss_customer_sk, ca_city bought_city,
+             sum(ss_coupon_amt) amt, sum(ss_net_profit) profit
+      from store_sales, date_dim, store, household_demographics, customer_address
+      where ss_sold_date_sk = d_date_sk and ss_store_sk = s_store_sk
+        and ss_hdemo_sk = hd_demo_sk and ss_addr_sk = ca_address_sk
+        and (hd_dep_count = 4 or hd_vehicle_count = 3)
+        and d_dow in (6, 0) and d_year = 1999
+        and s_city in ('Fairview', 'Midway')
+      group by ss_ticket_number, ss_customer_sk, ss_addr_sk, ca_city) dn,
+     customer, customer_address current_addr
+where ss_customer_sk = c_customer_sk
+  and customer.c_current_addr_sk = current_addr.ca_address_sk
+  and current_addr.ca_city <> bought_city
+order by c_last_name, c_first_name, ca_city, bought_city, ss_ticket_number
+limit 100
+"""
+Q73 = """
+select c_birth_year, cnt
+from (select ss_ticket_number, ss_customer_sk, count(*) cnt
+      from store_sales, date_dim, store, household_demographics
+      where ss_sold_date_sk = d_date_sk and ss_store_sk = s_store_sk
+        and ss_hdemo_sk = hd_demo_sk
+        and d_dom between 1 and 2
+        and (hd_buy_potential = '>10000' or hd_buy_potential = 'Unknown')
+        and hd_vehicle_count > 0
+        and d_year = 2000 and s_county in ('Williamson County', 'Walker County')
+      group by ss_ticket_number, ss_customer_sk) dj, customer
+where ss_customer_sk = c_customer_sk and cnt between 1 and 5
+order by cnt desc, c_birth_year
+limit 100
+"""
+Q79 = """
+select c_birth_year, s_city, ss_ticket_number, amt, profit
+from (select ss_ticket_number, ss_customer_sk, s_city,
+             sum(ss_coupon_amt) amt, sum(ss_net_profit) profit
+      from store_sales, date_dim, store, household_demographics
+      where ss_sold_date_sk = d_date_sk and ss_store_sk = s_store_sk
+        and ss_hdemo_sk = hd_demo_sk
+        and (hd_dep_count = 6 or hd_vehicle_count > 2)
+        and d_dow = 1 and d_year = 2000
+        and s_number_employees between 200 and 295
+      group by ss_ticket_number, ss_customer_sk, ss_store_sk, s_city) ms,
+     customer
+where ss_customer_sk = c_customer_sk
+order by c_birth_year, amt, profit, ss_ticket_number
+limit 100
+"""
 
 QUERIES = {3: Q3, 7: Q7, 13: Q13, 15: Q15, 19: Q19, 21: Q21, 25: Q25,
-           26: Q26, 36: Q36, 42: Q42, 43: Q43, 48: Q48, 50: Q50,
-           52: Q52, 55: Q55, 64: Q64, 72: Q72, 82: Q82}
+           26: Q26, 36: Q36, 42: Q42, 43: Q43, 46: Q46, 48: Q48, 50: Q50,
+           52: Q52, 55: Q55, 64: Q64, 72: Q72, 73: Q73, 79: Q79,
+           82: Q82}

@@ -85,12 +85,15 @@ def test_q72(runner, oracle):
     assert len(res.rows) > 0, "Q72 returned no rows — data correlation too thin"
 
 
-@pytest.mark.parametrize("qid", [3, 7, 13, 15, 19, 21, 25, 26, 42, 43, 52,
-                                 55, 82])
+@pytest.mark.parametrize("qid", [3, 7, 13, 15, 19, 21, 25, 26, 42, 43, 46,
+                                 52, 55, 73, 79, 82])
 def test_breadth_query(runner, oracle, qid):
     from presto_tpu.models.tpcds_sql import QUERIES
 
-    check(runner, oracle, QUERIES[qid], ordered=True)
+    res = check(runner, oracle, QUERIES[qid], ordered=True)
+    # a query whose predicates select nothing verifies vacuously — every
+    # breadth query must actually exercise its operators on live rows
+    assert len(res.rows) > 0, f"Q{qid} returned no rows at the test scale"
 
 
 def test_q50_returns_latency(runner, oracle):
