@@ -47,6 +47,21 @@ from ..utils.testing import PageConsumerFactory
 from ..exec.driver import Driver
 
 
+# union dictionaries interned by VALUE so re-planning the same query
+# yields the same Dictionary object (stable token -> kernel caches hit)
+_UNION_DICTS: Dict[tuple, Dictionary] = {}
+
+
+def _intern_union_dict(values: List[str]) -> Dictionary:
+    key = tuple(values)
+    d = _UNION_DICTS.get(key)
+    if d is None:
+        if len(_UNION_DICTS) > 256:
+            _UNION_DICTS.clear()
+        d = _UNION_DICTS[key] = Dictionary(values)
+    return d
+
+
 def _extract_constraint(filter_parts, scan: TableScanNode) -> Constraint:
     """Scan-filter conjuncts -> per-column [lo, hi] domains (TupleDomain
     extraction, narrowed to constant comparisons — what file/split pruning
@@ -502,7 +517,11 @@ class LocalExecutionPlanner:
             [s.type for s in node.symbols], None)
         dicts = self.remote_dicts.get(node.fragment_id,
                                       [None] * len(node.symbols))
-        return Chain([fac], list(node.symbols), list(dicts))
+        out = Chain([fac], list(node.symbols), list(dicts))
+        if node.fragment_id not in self.remote_dicts:
+            # unknown producer dicts: None entries may hide LIVE codes
+            out.unreliable_dicts = True
+        return out
 
     def visit_ValuesNode(self, node: ValuesNode) -> Chain:
         cap = max(len(node.rows), 1)
@@ -841,23 +860,86 @@ class LocalExecutionPlanner:
         """Materialized concatenation: each child pipeline drains into a page
         buffer; the union 'scan' replays the buffers (plan/UnionNode; the
         reference streams through an exchange — the local-exchange rev will)."""
-        buffers: List[PageConsumerFactory] = []
-        dicts: Optional[List[Optional[Dictionary]]] = None
+        chains: List[Chain] = []
         for child, mapping in zip(node.sources, node.symbol_mappings):
             chain = self.visit(child)
             if [s.name for s in chain.symbols] != [m.name for m in mapping]:
                 chain = self._append_project(
                     chain, [(m, symbol_ref(m.name, m.type)) for m in mapping])
-            if dicts is None:
-                dicts = list(chain.dicts)
-            else:
-                for a, b in zip(dicts, chain.dicts):
-                    if a is not b:
-                        raise NotImplementedError(
-                            "UNION across distinct dictionaries requires a "
-                            "re-encode pass (planned rev)")
+            chains.append(chain)
+        # dictionary unification across branches (the re-encode pass):
+        # - a branch whose column carries NO dictionary (e.g. a GROUPING
+        #   SETS null branch: all-NULL constants) adopts the other
+        #   branches' dictionary — its codes are dead under the null mask;
+        # - two DIFFERENT real dictionaries union their values and the
+        #   minority branches re-encode codes on device;
+        # - virtual (formatted) dictionaries can't union — same object only.
+        ncols = len(node.symbol_mappings[0])
+        # a dict-less varchar column is only safe to ADOPT a sibling's
+        # dictionary when its codes are provably dead (NULL constants from
+        # GROUPING SETS); remote-source chains fall back to unknown dicts
+        # with LIVE codes — adopting would decode them through the wrong
+        # dictionary, so keep the loud error for those
+        for ch in chains:
+            if getattr(ch, "unreliable_dicts", False) and any(
+                    ch.dicts[c] is None and any(
+                        other.dicts[c] is not None for other in chains)
+                    for c in range(len(node.symbol_mappings[0]))):
+                raise NotImplementedError(
+                    "UNION dictionary unification over a remote source "
+                    "with unknown dictionaries")
+        dicts: List[Optional[Dictionary]] = []
+        remaps: List[List[Optional[np.ndarray]]] = [
+            [None] * ncols for _ in chains]
+        for c in range(ncols):
+            branch_dicts = [ch.dicts[c] for ch in chains]
+            real = [d for d in branch_dicts if d is not None]
+            if not real:
+                dicts.append(None)
+                continue
+            if all(d is real[0] for d in real):
+                dicts.append(real[0])
+                continue
+            if any(not hasattr(d, "values") for d in real):
+                raise NotImplementedError(
+                    "UNION across distinct VIRTUAL dictionaries has no "
+                    "re-encode (formatted columns must share one source)")
+            seen: Dict[str, int] = {}
+            values: List[str] = []
+            for d in real:
+                for v in d.values:
+                    if v not in seen:
+                        seen[v] = len(values)
+                        values.append(v)
+            union = _intern_union_dict(values)
+            for bi, d in enumerate(branch_dicts):
+                if d is not None and list(d.values) != values:
+                    remap = np.asarray([seen[v] for v in d.values],
+                                       dtype=np.int32)
+                    # the prefix-majority branch gets an identity mapping:
+                    # a dictionary REBIND suffices, skip the device gather
+                    if not np.array_equal(remap,
+                                          np.arange(len(remap),
+                                                    dtype=np.int32)):
+                        remaps[bi][c] = remap
+                    else:
+                        branch_dicts[bi] = None  # force rebind-only below
+            dicts.append(union)
+        buffers: List[PageConsumerFactory] = []
+        for bi, (chain, mapping) in enumerate(
+                zip(chains, node.symbol_mappings)):
+            facs = list(chain.factories)
+            needs_rebind = any(
+                dicts[c] is not None and chain.dicts[c] is not dicts[c]
+                for c in range(ncols))
+            if needs_rebind or any(r is not None for r in remaps[bi]):
+                from ..ops.coalesce import DictionaryRemapOperatorFactory
+
+                facs.append(DictionaryRemapOperatorFactory(
+                    next(self._ids), [m.type for m in mapping], remaps[bi],
+                    target_dicts=dicts))
             buf = PageConsumerFactory(next(self._ids), [m.type for m in mapping])
-            self.pipelines.append(chain.factories + [buf])  # union: keep 1 driver (replay ordering)
+            self.pipelines.append(facs + [buf])  # union: keep 1 driver (replay ordering)
             buffers.append(buf)
 
         class _ReplaySource(ConnectorPageSource):

@@ -453,8 +453,22 @@ where ss_customer_sk = c_customer_sk
 order by c_birth_year, amt, profit, ss_ticket_number
 limit 100
 """
+Q27 = """
+select i_item_id, s_state, grouping(s_state) g_state,
+       avg(ss_quantity) agg1, avg(ss_list_price) agg2,
+       avg(ss_coupon_amt) agg3, avg(ss_sales_price) agg4
+from store_sales, customer_demographics, date_dim, store, item
+where ss_sold_date_sk = d_date_sk and ss_item_sk = i_item_sk
+  and ss_store_sk = s_store_sk and ss_cdemo_sk = cd_demo_sk
+  and cd_gender = 'M' and cd_marital_status = 'S'
+  and cd_education_status = 'College'
+  and d_year = 2000
+group by rollup (i_item_id, s_state)
+order by i_item_id, s_state
+limit 100
+"""
 
 QUERIES = {3: Q3, 7: Q7, 13: Q13, 15: Q15, 19: Q19, 21: Q21, 25: Q25,
-           26: Q26, 36: Q36, 42: Q42, 43: Q43, 46: Q46, 48: Q48, 50: Q50,
+           26: Q26, 27: Q27, 36: Q36, 42: Q42, 43: Q43, 46: Q46, 48: Q48, 50: Q50,
            52: Q52, 55: Q55, 64: Q64, 72: Q72, 73: Q73, 79: Q79,
            82: Q82}

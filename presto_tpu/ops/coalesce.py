@@ -166,3 +166,74 @@ class CoalesceOperatorFactory(OperatorFactory):
 
     def create_operator(self, worker: int = 0) -> CoalesceOperator:
         return CoalesceOperator(self.context(worker), self.types, self.dicts)
+
+
+class DictionaryRemapOperator(Operator):
+    """Re-encode dictionary codes through per-channel remap arrays (the
+    UNION dictionary-unification pass: minority branches map their codes
+    into the union dictionary on device, one gather per column)."""
+
+    def __init__(self, context: OperatorContext, types: List[Type], remaps,
+                 target_dicts=None):
+        super().__init__(context)
+        self._types = types
+        self._remaps = [None if r is None else jnp.asarray(r)
+                        for r in remaps]
+        self._target_dicts = target_dicts or [None] * len(types)
+        self._pending: List[Page] = []
+
+    @property
+    def output_types(self) -> List[Type]:
+        return self._types
+
+    def needs_input(self) -> bool:
+        return not self._finishing and not self._pending
+
+    @timed("add_input_ns")
+    def add_input(self, page: Page) -> None:
+        self.context.record_input(page, page.capacity)
+        blocks = []
+        for b, r in zip(page.blocks, self._remaps):
+            # explicit None test: a virtual FormattedDictionary has len 0
+            # and would be dropped by a truthiness check
+            td = self._target_dicts[len(blocks)]
+            if td is None:
+                td = b.dictionary
+            if r is None:
+                # no code translation needed, but the block must still
+                # carry the UNION dictionary: downstream page merges take
+                # the FIRST block's dictionary, and a null-branch block
+                # with none would strip decoding from the whole column
+                if td is b.dictionary:
+                    blocks.append(b)
+                else:
+                    blocks.append(Block(b.type, b.data, b.nulls, td))
+            else:
+                data = jnp.take(r, jnp.clip(b.data.astype(jnp.int32), 0,
+                                            r.shape[0] - 1))
+                blocks.append(Block(b.type, data, b.nulls, td))
+        self._pending.append(Page(tuple(blocks), page.mask))
+
+    @timed("get_output_ns")
+    def get_output(self):
+        if self._pending:
+            page = self._pending.pop(0)
+            self.context.record_output(page, page.capacity)
+            return page
+        return None
+
+    def is_finished(self) -> bool:
+        return self._finishing and not self._pending
+
+
+class DictionaryRemapOperatorFactory(OperatorFactory):
+    def __init__(self, operator_id: int, types: List[Type], remaps,
+                 target_dicts=None):
+        super().__init__(operator_id, "DictionaryRemap")
+        self.types = types
+        self.remaps = remaps
+        self.target_dicts = target_dicts
+
+    def create_operator(self, worker: int = 0) -> DictionaryRemapOperator:
+        return DictionaryRemapOperator(self.context(worker), self.types,
+                                       self.remaps, self.target_dicts)

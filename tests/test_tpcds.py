@@ -136,6 +136,38 @@ where s_store_sk = ss_store_sk and ss_sold_date_sk = d_date_sk
     assert_rows_equal(got.rows, oracle.query(to_sqlite(factored)))
 
 
+def test_q27_rollup(runner, oracle):
+    """Q27's ROLLUP over (item_id, state) — exercises the UNION
+    dictionary-unification pass (null branches drop the s_state
+    dictionary). The compare is ORDERED: the oracle's (col IS NULL)
+    ORDER BY prefixes force sqlite into the engine's NULLS LAST
+    placement so the LIMIT selects the same 100-row prefix."""
+    from presto_tpu.models.tpcds_sql import Q27
+
+    got = runner.execute(Q27)
+    assert len(got.rows) > 0
+    base = """from store_sales, customer_demographics, date_dim, store, item
+      where ss_sold_date_sk = d_date_sk and ss_item_sk = i_item_sk
+        and ss_store_sk = s_store_sk and ss_cdemo_sk = cd_demo_sk
+        and cd_gender = 'M' and cd_marital_status = 'S'
+        and cd_education_status = 'College'
+        and d_year = 2000"""
+    sel = ("avg(ss_quantity), avg(ss_list_price), avg(ss_coupon_amt), "
+           "avg(ss_sales_price)")
+    # (col IS NULL) prefixes force sqlite into the engine's NULLS LAST
+    # placement so the LIMIT selects the same 100-row prefix
+    exp = oracle.query(f"""
+      select * from (
+        select i_item_id, s_state, 0, {sel} {base}
+          group by i_item_id, s_state
+        union all
+        select i_item_id, null, 1, {sel} {base} group by i_item_id
+        union all
+        select null, null, 1, {sel} {base})
+      order by (i_item_id is null), 1, (s_state is null), 2 limit 100""")
+    assert_rows_equal(got.rows, exp, ordered=True)
+
+
 def test_q36_rollup(runner, oracle):
     """Q36's ROLLUP + grouping() — sqlite has no ROLLUP, so the oracle runs
     the manual union desugaring of the same query."""
