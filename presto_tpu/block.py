@@ -143,6 +143,36 @@ class Dictionary:
         return f"Dictionary({len(self.values)} entries)"
 
 
+class ArrayValues(Dictionary):
+    """Host-side store of ragged ARRAY/MAP values; device blocks hold int32
+    handles into it (the exact design varchar uses: codes + host store).
+
+    The collect aggregation computes the ragged (offsets, values) pair on
+    device, then installs each group's slice here and hands the handle array
+    to the output block — spi/block/ArrayBlock.java's offsets+child layout,
+    with the host boundary at materialization instead of per-operator.
+    `mode` controls decoding: "array" -> list, "map" -> dict (entries are
+    stored as hashable tuples so handles dedup via the inherited index)."""
+
+    def __init__(self, mode: str = "array"):
+        super().__init__([])
+        self.mode = mode
+
+    def lookup(self, codes: "np.ndarray") -> "np.ndarray":
+        out = np.empty(len(codes), dtype=object)
+        for i, c in enumerate(np.asarray(codes, dtype=np.int64)):
+            if c < 0:
+                out[i] = None
+            elif self.mode == "map":
+                out[i] = dict(self.values[c])
+            else:
+                out[i] = list(self.values[c])
+        return out
+
+    def __repr__(self):
+        return f"ArrayValues({len(self.values)} {self.mode} entries)"
+
+
 @jax.tree_util.register_pytree_node_class
 @dataclasses.dataclass
 class Block:

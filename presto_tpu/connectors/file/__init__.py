@@ -98,7 +98,7 @@ class _TableInfo:
 class FileMetadata(ConnectorMetadata):
     def __init__(self, connector_id: str, base_dir: str,
                  write_format: str = "pcol"):
-        if write_format not in ("pcol", "parquet"):
+        if write_format not in ("pcol", "parquet", "orc"):
             raise ValueError(f"unknown file write format {write_format!r}")
         self.connector_id = connector_id
         self.base = base_dir
@@ -277,28 +277,27 @@ class FileMetadata(ConnectorMetadata):
             from ...formats.parquet_writer import write_parquet
             write_parquet(os.path.join(d, "00000000.parquet"),
                           names, types, dicts, [])
+        elif self.write_format == "orc":
+            from ...formats.orc_writer import write_orc
+            write_orc(os.path.join(d, "00000000.orc"),
+                      names, types, dicts, [])
         else:
             write_pcol(os.path.join(d, "00000000.pcol"),
                        names, types, dicts, [])
 
     def begin_insert(self, table: TableHandle):
         files = self._files_of(table.schema_table)
-        if any(f.endswith((".orc", ".rc")) for f in files):
+        if any(f.endswith(".rc") for f in files):
             raise RuntimeError(
-                f"table {table.schema_table} is ORC/RCFile-backed and "
-                f"read-only (the engine writes pcol or parquet; ORC and "
-                f"RCFile are ingest-only)")
-        has_parquet = any(f.endswith(".parquet") for f in files)
-        if has_parquet and self.write_format != "parquet":
+                f"table {table.schema_table} is RCFile-backed and "
+                f"read-only (RCFile is ingest-only)")
+        exts = {os.path.splitext(f)[1].lstrip(".") for f in files}
+        if exts and exts != {self.write_format}:
+            have = "/".join(sorted(exts))
             raise RuntimeError(
-                f"table {table.schema_table} is parquet-backed and this "
-                f"catalog writes pcol — formats cannot mix (set "
-                f"file.format=parquet in the catalog properties to write "
-                f"parquet tables)")
-        if not has_parquet and files and self.write_format == "parquet":
-            raise RuntimeError(
-                f"table {table.schema_table} is pcol-backed and this "
-                f"catalog writes parquet — formats cannot mix")
+                f"table {table.schema_table} is {have}-backed and this "
+                f"catalog writes {self.write_format} — formats cannot mix "
+                f"(set file.format={have} in the catalog properties)")
         return table
 
     def finish_insert(self, handle, fragments) -> None:
@@ -606,6 +605,10 @@ class FilePageSink(ConnectorPageSink):
             from ...formats.parquet_writer import write_parquet
             path = os.path.join(d, f"{uuid.uuid4().hex[:12]}.parquet")
             write_parquet(path, names, types, dicts, pages)
+        elif self._metadata.write_format == "orc":
+            from ...formats.orc_writer import write_orc
+            path = os.path.join(d, f"{uuid.uuid4().hex[:12]}.orc")
+            write_orc(path, names, types, dicts, pages)
         else:
             path = os.path.join(d, f"{uuid.uuid4().hex[:12]}.pcol")
             write_pcol(path, names, types, dicts, pages)

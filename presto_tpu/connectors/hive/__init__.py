@@ -435,10 +435,6 @@ class HiveMetadata(ConnectorMetadata):
         snap = self.snapshot(table.schema_table)
         if snap is None:
             raise ValueError(f"no such hive table {table.schema_table}")
-        if snap.desc.format == "orc":
-            raise RuntimeError(
-                f"hive table {table.schema_table} is ORC-backed and "
-                f"read-only (the engine writes pcol or parquet)")
         return table
 
     def finish_insert(self, handle, fragments) -> None:
@@ -881,6 +877,10 @@ class HivePageSink(ConnectorPageSink):
                 from ...formats.parquet_writer import write_parquet
                 path = os.path.join(d, stem + ".parquet")
                 write_parquet(path, names, types, dicts, pages)
+            elif desc.format == "orc":
+                from ...formats.orc_writer import write_orc
+                path = os.path.join(d, stem + ".orc")
+                write_orc(path, names, types, dicts, pages)
             else:
                 path = os.path.join(d, stem + ".pcol")
                 write_pcol(path, names, types, dicts, pages)

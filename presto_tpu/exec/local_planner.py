@@ -800,7 +800,8 @@ class LocalExecutionPlanner:
             arg_ch = [src.channel(a.name) for a in ac.args]
             mask_ch = src.channel(ac.filter.name) if ac.filter is not None else None
             out_dict = None
-            if ac.name in ("min", "max", "arbitrary", "any_value") and arg_ch \
+            if ac.name in ("min", "max", "arbitrary", "any_value",
+                           "min_by", "max_by") and arg_ch \
                     and src.dicts[arg_ch[0]] is not None:
                 out_dict = src.dicts[arg_ch[0]]
             if fn.output_dict is not None:  # string-producing aggregates

@@ -384,7 +384,10 @@ def decode_rlev2(data: bytes, count: int, signed: bool) -> np.ndarray:
             run = ((b0 & 1) << 8 | data[pos + 1]) + 1
             vals = _bits_be(data[pos + 2:], 0, run, width)
             if signed:
-                vals = (vals >> 1) ^ -(vals & 1)
+                # LOGICAL shift for the zigzag decode: 64-bit-wide values set
+                # the int64 sign bit and an arithmetic >> would sign-extend
+                vals = (vals.view(np.uint64) >> np.uint64(1)).view(
+                    np.int64) ^ -(vals & 1)
             out[filled:filled + run] = vals
             filled += run
             pos += 2 + (run * width + 7) // 8

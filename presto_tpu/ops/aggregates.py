@@ -28,6 +28,12 @@ from ..types import (BIGINT, BOOLEAN, DOUBLE, REAL, Type, DecimalType, UNKNOWN,
 
 # reduce kinds understood by the grouping kernels
 SUM, MIN, MAX = "sum", "min", "max"
+# paired (joint) kinds: an AMIN/AMAX state column holds an order-preserving
+# int64 ordering key; the IMMEDIATELY FOLLOWING column must be ACARRY and
+# receives the payload of the row that won the ordering (min_by/max_by,
+# reference operator/aggregation/minmaxby/AbstractMinMaxBy.java). The
+# grouping kernels reduce the pair jointly (segment argmin/argmax + gather).
+AMIN, AMAX, ACARRY = "amin", "amax", "acarry"
 
 _I64_MAX = np.int64(2**63 - 1)
 _I64_MIN = np.int64(-(2**63))
@@ -71,6 +77,12 @@ class AggregateFunction:
     # RESOLVE time so the plan layout can reference it; final_map fills it
     # with the actual values (codes index into it) when the query runs
     output_dict: object = None
+    # which arg indices' NULLs exclude the row from the aggregate; None =
+    # all (the @SqlNullable default). min_by/max_by skip only NULL ORDERING
+    # rows — a NULL payload still participates and can win.
+    null_skip_channels: Optional[tuple] = None
+    # input_map is called as input_map(args, arg_null_masks, mask) when set
+    needs_arg_nulls: bool = False
 
 
 def _ones_i64(args, mask):
@@ -184,6 +196,66 @@ def _resolve_aggregate(name: str, arg_types: Sequence[Type],
                                           jnp.where(mask, jnp.int64(1), jnp.int64(0))),
             lambda s: (s[0], s[1] == 0),
             [t, BIGINT])
+
+    if name in ("min_by", "max_by"):
+        # min_by(x, y): x of the row with minimal y. State = (sortable-int64
+        # ordering key, carried payload, count); the kernels reduce the
+        # AMIN/ACARRY pair jointly. Reference:
+        # operator/aggregation/minmaxby/AbstractMinMaxBy.java.
+        if len(arg_types) != 2:
+            raise NotImplementedError(
+                f"{name} takes exactly 2 arguments (the top-n form is not "
+                f"supported)")
+        tx, ty = arg_types[0], arg_types[1]
+        is_min = name == "min_by"
+        okind = AMIN if is_min else AMAX
+        oident = _I64_MAX if is_min else _I64_MIN
+        carry_dtype = np.dtype(np.int32) if is_string(tx) else tx.np_dtype
+        carry_ident = False if carry_dtype.kind == "b" else carry_dtype.type(0)
+
+        def input_map(args, arg_nulls, mask, _oident=oident):
+            x, y = args[0], args[1]
+            ys = jnp.where(mask, _sortable_i64(y), jnp.int64(_oident))
+            carry = jnp.where(mask, x, jnp.asarray(carry_ident,
+                                                   dtype=carry_dtype))
+            xn = arg_nulls[0]
+            carry_null = jnp.where(mask, xn.astype(jnp.int64), jnp.int64(0))
+            return (ys, carry, carry_null,
+                    jnp.where(mask, jnp.int64(1), jnp.int64(0)))
+
+        return AggregateFunction(
+            name, tx,
+            [StateColumn(np.dtype(np.int64), okind, oident),
+             StateColumn(carry_dtype, ACARRY, carry_ident),
+             StateColumn(np.dtype(np.int64), ACARRY, 0),
+             StateColumn(np.dtype(np.int64), SUM, 0)],
+            input_map,
+            lambda s: (s[1], (s[3] == 0) | (s[2] != 0)),
+            [], splittable=False,
+            null_skip_channels=(1,), needs_arg_nulls=True)
+
+    if name in ("array_agg", "map_agg", "histogram"):
+        # ragged collectors (ArrayAggregationFunction.java:50,
+        # MapAggregationFunction.java, histogram/Histogram.java): routed to
+        # ops/collect_agg.CollectAggregationBuilder; the state column is the
+        # int32 HANDLE into the host ArrayValues store allocated here
+        from ..block import ArrayValues
+        from ..types import ArrayType, MapType
+        if name == "array_agg":
+            out_t = ArrayType(arg_types[0])
+            store = ArrayValues("array")
+        elif name == "map_agg":
+            out_t = MapType(arg_types[0], arg_types[1])
+            store = ArrayValues("map")
+        else:
+            out_t = MapType(arg_types[0], BIGINT)
+            store = ArrayValues("map")
+        return AggregateFunction(
+            name, out_t,
+            [StateColumn(np.dtype(np.int32), "collect", -1)],
+            None,  # the collect builder bypasses input_map
+            lambda s: (s[0], s[0] < 0),
+            [], splittable=False, output_dict=store)
 
     if name in ("stddev", "stddev_samp", "stddev_pop", "variance", "var_samp", "var_pop"):
         pop = name.endswith("_pop")
@@ -406,6 +478,21 @@ EXTERNAL_AGGREGATES: dict = {}
 
 def register_aggregate(name: str, resolver) -> None:
     EXTERNAL_AGGREGATES[name.lower()] = resolver
+
+
+def _sortable_i64(y):
+    """Order-preserving map of a column into int64 (min_by/max_by ordering
+    key). Integers/dates/bools widen; floats use the IEEE-754 total-order
+    bit trick (negative values flip all bits, positives flip the sign bit,
+    then re-biased into signed order)."""
+    if jnp.issubdtype(y.dtype, jnp.floating):
+        u = jax.lax.bitcast_convert_type(
+            y.astype(jnp.float64), jnp.uint64)
+        u = jnp.where((u >> jnp.uint64(63)) == 1, ~u,
+                      u | jnp.uint64(1) << jnp.uint64(63))
+        return jax.lax.bitcast_convert_type(
+            u ^ (jnp.uint64(1) << jnp.uint64(63)), jnp.int64)
+    return y.astype(jnp.int64)
 
 
 def _hash_to_u64(a0):

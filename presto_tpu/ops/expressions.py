@@ -494,6 +494,27 @@ class ExpressionCompiler:
             return fn, None
         if name == "substr" or name == "substring":
             return self._compile_substr(expr)
+        if name == "cardinality":
+            # dynamic ARRAY/MAP handle column: per-handle lengths gathered
+            # from the host ArrayValues store (a compile-time constant —
+            # the kernel cache keys on the store's (token, len) version)
+            f, d = self._compile(expr.args[0])
+            if d is None or not hasattr(d, "values"):
+                raise NotImplementedError(
+                    "cardinality() needs an array/map handle column")
+            lengths = np.asarray([len(v) for v in d.values],
+                                 dtype=np.int64)
+            lengths = np.concatenate([lengths, [0]])  # slot for handle -1
+
+            def fn(datas, nulls, _l=lengths):
+                data, n = f(datas, nulls)
+                codes = data.astype(jnp.int32)
+                out = jnp.take(jnp.asarray(_l),
+                               jnp.clip(codes, 0, len(_l) - 1))
+                neg = codes < 0
+                n = neg if n is None else (n | neg)
+                return out, n
+            return fn, None
         if name == "abs":
             f = self._compile(expr.args[0])[0]
             return (lambda datas, nulls: ((lambda d, n: (jnp.abs(d), n))(*f(datas, nulls)))), None

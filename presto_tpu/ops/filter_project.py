@@ -30,23 +30,31 @@ class PageProcessor:
 
     def __init__(self, layout: InputLayout, filter_expr: Optional[RowExpression],
                  projections: Sequence[RowExpression], compact_output: bool = False):
+        self._filter_expr = filter_expr
+        self._projection_exprs = list(projections)
+        self.compact_output = compact_output
+        self._build(layout)
+
+    def _build(self, layout: InputLayout) -> None:
         from ..utils import kernel_cache as kc
 
+        filter_expr = self._filter_expr
+        projections = self._projection_exprs
         compiler = ExpressionCompiler(layout)
         self.filter = compiler.compile(filter_expr) if filter_expr is not None else None
         self.projections = [compiler.compile(p) for p in projections]
         self.output_types_ = [p.type for p in self.projections]
         self.output_dicts = [p.dictionary for p in self.projections]
-        self.compact_output = compact_output
         # global kernel cache (PageFunctionCompiler.java:97's expression cache):
         # equal (layout, exprs) compile to behaviorally identical closures, so
         # repeated queries share one jitted kernel instead of re-tracing +
         # re-compiling per plan (~0.5s/query host overhead otherwise)
+        self._layout_key = kc.layout_key(layout.types, layout.dictionaries)
         self.cache_key = ("page-processor",
-                          kc.layout_key(layout.types, layout.dictionaries),
+                          self._layout_key,
                           kc.expr_key(filter_expr),
                           tuple(kc.expr_key(p) for p in projections),
-                          compact_output)
+                          self.compact_output)
         self._jitted = kc.get_or_install(self.cache_key,
                                          lambda: jax.jit(self._process))
 
@@ -72,6 +80,18 @@ class PageProcessor:
         return out
 
     def __call__(self, page: Page) -> Page:
+        from ..utils import kernel_cache as kc
+
+        # dictionaries can gain entries between plan time and this page
+        # (INSERT-extended dictionaries; ArrayValues stores populated by an
+        # upstream collect aggregation mid-query): expressions resolve
+        # dictionary CONTENTS at compile time, so a version change must
+        # rebuild against the live layout (cheap key compare per page)
+        cur = kc.layout_key([b.type for b in page.blocks],
+                            [b.dictionary for b in page.blocks])
+        if cur != self._layout_key:
+            self._build(InputLayout([b.type for b in page.blocks],
+                                    [b.dictionary for b in page.blocks]))
         return self._jitted(page)
 
     @property

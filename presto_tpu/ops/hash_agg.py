@@ -34,7 +34,7 @@ import numpy as np
 from ..block import Block, Dictionary, Page
 from ..types import BIGINT, BOOLEAN, Type, is_string
 from ..utils import kernel_cache
-from .aggregates import MAX, MIN, SUM, AggregateCall
+from .aggregates import ACARRY, AMAX, AMIN, MAX, MIN, SUM, AggregateCall
 from .operator import Operator, OperatorContext, OperatorFactory, timed
 from .sorting import lexsort_fast
 
@@ -100,6 +100,62 @@ def _reduce_contrib(kind: str, c, gid, num_segments: int, width: int,
     return _segment_reduce(kind, c, gid, num_segments)
 
 
+def _reduce_all(contribs, kinds, identities, widths, gid, out_groups):
+    """Reduce every contribution column into (out_groups,) states, handling
+    the AMIN/AMAX + ACARRY pairs jointly: segment argmin/argmax over the
+    ordering key, then gather the winning row's payload (min_by/max_by).
+    Rows routed to the trash segment (gid == out_groups) are excluded."""
+    n_seg = out_groups + 1
+    states = []
+    i = 0
+    while i < len(kinds):
+        kind = kinds[i]
+        if kind in (AMIN, AMAX):
+            y = contribs[i]
+            seg = jax.ops.segment_min if kind == AMIN else jax.ops.segment_max
+            m = seg(y, gid, num_segments=n_seg)
+            nr = y.shape[0]
+            idx = jnp.arange(nr, dtype=jnp.int32)
+            best = jnp.where(y == m[gid], idx, nr)
+            first = jax.ops.segment_min(best, gid, num_segments=n_seg)
+            win = jnp.clip(first, 0, max(nr - 1, 0))
+            states.append(m[:out_groups])
+            i += 1
+            while i < len(kinds) and kinds[i] == ACARRY:
+                states.append(contribs[i][win][:out_groups])
+                i += 1
+            continue
+        states.append(_reduce_contrib(kind, contribs[i], gid, n_seg,
+                                      widths[i], identities[i])[:out_groups])
+        i += 1
+    return states
+
+
+def _merge_tables(kinds, old, new):
+    """Element-wise combine of two same-shape state tables (cross-page fold
+    of the direct builder), joint over AMIN/AMAX + ACARRY pairs."""
+    out = []
+    i = 0
+    while i < len(kinds):
+        kind = kinds[i]
+        if kind in (AMIN, AMAX):
+            better = (new[i] < old[i]) if kind == AMIN else (new[i] > old[i])
+            out.append(jnp.where(better, new[i], old[i]))
+            i += 1
+            while i < len(kinds) and kinds[i] == ACARRY:
+                out.append(jnp.where(better, new[i], old[i]))
+                i += 1
+            continue
+        if kind == SUM:
+            out.append(old[i] + new[i])
+        elif kind == MIN:
+            out.append(jnp.minimum(old[i], new[i]))
+        else:
+            out.append(jnp.maximum(old[i], new[i]))
+        i += 1
+    return out
+
+
 def _where_valid(gvalid, s, ident):
     """Identity-fill invalid group slots, broadcasting over vector states."""
     cond = gvalid[:, None] if s.ndim == 2 else gvalid
@@ -143,19 +199,26 @@ def _call_contributions(calls, page: Page, from_intermediate: bool):
                 contribs.append(datas[ch])
         else:
             args = []
-            for c in call.input_channels:
+            for ai, c in enumerate(call.input_channels):
                 a = datas[c]
                 d = page.blocks[c].dictionary
-                if call.function.name in ("min", "max") and d is not None \
-                        and not d.is_sorted():
+                name = call.function.name
+                ordering_arg = name in ("min", "max") or \
+                    (name in ("min_by", "max_by") and ai == 1)
+                if ordering_arg and d is not None and not d.is_sorted():
                     # codes of an INSERT-extended dictionary are append-ordered,
-                    # not lexicographic — compare RANKS instead; the output
-                    # path maps the winning rank back to a code
+                    # not lexicographic — compare RANKS instead; min/max's
+                    # output path maps the winning rank back to a code
+                    # (min_by/max_by discard the ordering state, so no
+                    # back-mapping is needed there)
                     a = jnp.asarray(d.sort_keys())[a]
                 args.append(a)
             args = tuple(args)
             m = mask
-            for c in call.input_channels:
+            skip = call.function.null_skip_channels
+            for ai, c in enumerate(call.input_channels):
+                if skip is not None and ai not in skip:
+                    continue  # NULL here does not exclude the row (min_by x)
                 if page.blocks[c].nulls is not None:
                     m = m & ~page.blocks[c].nulls
             if call.mask_channel is not None:
@@ -163,7 +226,12 @@ def _call_contributions(calls, page: Page, from_intermediate: bool):
                 if page.blocks[call.mask_channel].nulls is not None:
                     mc = mc & ~page.blocks[call.mask_channel].nulls
                 m = m & mc
-            contribs.extend(call.function.input_map(args, m))
+            if call.function.needs_arg_nulls:
+                arg_nulls = tuple(page.blocks[c].null_mask()
+                                  for c in call.input_channels)
+                contribs.extend(call.function.input_map(args, arg_nulls, m))
+            else:
+                contribs.extend(call.function.input_map(args, m))
     return contribs
 
 
@@ -199,10 +267,7 @@ def sort_group_reduce(keys: Tuple[jnp.ndarray, ...], mask: jnp.ndarray,
     gid = jnp.where(sv, gid, out_groups)  # trash bin
     gid = jnp.minimum(gid, out_groups)    # overflow also lands in the bin
 
-    states = []
-    for c, kind, ident, w in zip(sc, kinds, identities, widths):
-        s = _reduce_contrib(kind, c, gid, out_groups + 1, w, ident)[:out_groups]
-        states.append(s)
+    states = _reduce_all(sc, kinds, identities, widths, gid, out_groups)
     gkeys = []
     for k in sk:
         out = jnp.zeros(out_groups, dtype=k.dtype)
@@ -409,9 +474,29 @@ class GroupedAggregationBuilder:
         # why it spilled); _build_result pages it out page-capacity at a time
         out_keys = tuple(k[starts] for k in keys)
         out_states = []
-        for s, kind in zip(states, self.kinds):
+        i = 0
+        nrows = len(keys[0])
+        while i < len(self.kinds):
+            kind = self.kinds[i]
+            s = states[i]
+            if kind in (AMIN, AMAX):
+                y = states[i]
+                red = np.minimum if kind == AMIN else np.maximum
+                m = red.reduceat(y, starts)
+                counts = np.diff(np.append(starts, nrows))
+                cand = np.where(y == np.repeat(m, counts),
+                                np.arange(nrows), nrows)
+                win = np.clip(np.minimum.reduceat(cand, starts), 0,
+                              max(nrows - 1, 0))
+                out_states.append(m)
+                i += 1
+                while i < len(self.kinds) and self.kinds[i] == ACARRY:
+                    out_states.append(states[i][win])
+                    i += 1
+                continue
             red = {SUM: np.add, MIN: np.minimum, MAX: np.maximum}[kind]
             out_states.append(red.reduceat(s, starts))
+            i += 1
         n = len(starts)
         return out_keys, tuple(out_states), np.ones(n, dtype=bool)
 
@@ -495,16 +580,9 @@ class DirectAggregationBuilder:
             gid = gid * dom + code
         gid = jnp.where(mask, gid, self.D)  # dead rows -> trash segment
         contribs = _call_contributions(self.calls, page, self.from_intermediate)
-        new_table = []
-        for c, kind, ident, w, t in zip(contribs, self.kinds, self.identities,
-                                        self.widths, table):
-            part = _reduce_contrib(kind, c, gid, self.D + 1, w, ident)[: self.D]
-            if kind == SUM:
-                new_table.append(t + part)
-            elif kind == MIN:
-                new_table.append(jnp.minimum(t, part))
-            else:
-                new_table.append(jnp.maximum(t, part))
+        parts = _reduce_all(contribs, self.kinds, self.identities,
+                            self.widths, gid, self.D)
+        new_table = _merge_tables(self.kinds, table, parts)
         new_seen = seen | (jax.ops.segment_sum(
             mask.astype(jnp.int32), gid, num_segments=self.D + 1)[: self.D] > 0)
         return tuple(new_table), new_seen
@@ -568,9 +646,28 @@ class GlobalAggregationBuilder:
     def _accumulate(self, page: Page, state):
         mask = page.mask
         contribs = _call_contributions(self.calls, page, self.from_intermediate)
+        state = self._state_or(state)
         new_state = []
-        for c, kind, ident, w, s in zip(contribs, self.kinds, self.identities,
-                                        self.widths, self._state_or(state)):
+        i = 0
+        while i < len(self.kinds):
+            kind = self.kinds[i]
+            c = contribs[i]
+            ident = self.identities[i]
+            w = self.widths[i]
+            s = state[i]
+            if kind in (AMIN, AMAX):
+                # joint pair reduce over rows, then combine with the state
+                y = contribs[i]
+                am = (jnp.argmin if kind == AMIN else jnp.argmax)(y)
+                red_y = y[am]
+                better = (red_y < s) if kind == AMIN else (red_y > s)
+                new_state.append(jnp.where(better, red_y, s))
+                i += 1
+                while i < len(self.kinds) and self.kinds[i] == ACARRY:
+                    new_state.append(jnp.where(better, contribs[i][am],
+                                               state[i]))
+                    i += 1
+                continue
             if isinstance(c, tuple):
                 bucket, vals = c
                 base = jnp.full((w,), ident, dtype=vals.dtype)
@@ -586,6 +683,7 @@ class GlobalAggregationBuilder:
                        MAX: jnp.max}[kind](c, axis=0)
             new_state.append({SUM: lambda a, b: a + b,
                               MIN: jnp.minimum, MAX: jnp.maximum}[kind](s, red))
+            i += 1
         return tuple(new_state)
 
     def _state_or(self, state):
@@ -758,6 +856,12 @@ def make_builder(key_types, key_dicts, key_domains, calls, page_capacity,
                  max_groups=1 << 20, from_intermediate=False,
                  direct_domain_limit=1 << 16):
     """Strategy pick (LocalExecutionPlanner's group-by-hash choice analogue)."""
+    from .collect_agg import COLLECT_NAMES, CollectAggregationBuilder
+    if any(c.function.name in COLLECT_NAMES for c in calls):
+        # ragged collectors keep every row; one sorted pass at finish
+        return CollectAggregationBuilder(key_types, key_dicts, calls,
+                                         page_capacity, max_groups,
+                                         from_intermediate)
     if not key_types:
         return GlobalAggregationBuilder(calls, from_intermediate)
     wide = any(w > 1 for w in _state_widths(calls))

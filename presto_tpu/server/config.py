@@ -116,8 +116,20 @@ def _tpcds_factory(catalog: str, config: Dict[str, str]):
     return TpcdsConnectorFactory().create(catalog, config)
 
 
+def _remote_factory(catalog: str, config: Dict[str, str]):
+    from ..connectors.remote import RemoteConnector
+
+    uris = config.get("remote.uri")
+    if not uris:
+        raise ValueError(f"catalog {catalog}: remote.uri is required")
+    timeout = float(config.get("remote.timeout-s", "30"))
+    return RemoteConnector(catalog, [u.strip() for u in uris.split(",")],
+                           timeout_s=timeout)
+
+
 FACTORIES: Dict[str, Callable] = {
     "tpch": _tpch_factory,
+    "remote": _remote_factory,
     "tpcds": _tpcds_factory,
     "memory": _memory_factory,
     "blackhole": _blackhole_factory,

@@ -28,7 +28,8 @@ AGGREGATE_NAMES = {"count", "sum", "avg", "min", "max", "stddev", "stddev_samp",
                    "stddev_pop", "variance", "var_samp", "var_pop", "corr",
                    "covar_samp", "covar_pop", "approx_distinct", "count_if",
                    "bool_and", "bool_or", "every", "arbitrary", "any_value",
-                   "approx_percentile"}
+                   "approx_percentile", "min_by", "max_by",
+                   "array_agg", "map_agg", "histogram"}
 
 # pluggable scalar functions (the FunctionManager/function-namespace
 # analogue, metadata/FunctionManager.java): plugin modules register a typer
@@ -515,9 +516,14 @@ class ExpressionTranslator:
             # over the fixed-length constructor the length is a literal
             if args and isinstance(args[0], Call) and args[0].name == "array":
                 return Constant(BIGINT, len(args[0].args))
+            from ..types import ArrayType, MapType
+            if args and isinstance(args[0].type, (ArrayType, MapType)):
+                # dynamic array/map HANDLE column (array_agg output): the
+                # compiler gathers lengths from the host ArrayValues store
+                return Call(BIGINT, "cardinality", args)
             raise SemanticError(
-                "cardinality() supports ARRAY[..] constructors (dynamic "
-                "arrays have no device representation)")
+                "cardinality() supports ARRAY[..] constructors and "
+                "array_agg/map_agg columns")
         if name in ("substr", "substring"):
             return Call(VARCHAR, "substr", args)
         if name == "abs":
@@ -609,6 +615,21 @@ def aggregate_output_type(name: str, arg_types: Sequence[Type]) -> Type:
         return DOUBLE
     if name in ("min", "max", "arbitrary", "any_value"):
         return arg_types[0]
+    if name in ("min_by", "max_by"):
+        if len(arg_types) != 2:
+            raise SemanticError(
+                f"{name} takes exactly 2 arguments (the {name}(x, y, n) "
+                f"top-n form is not supported)")
+        return arg_types[0]
+    if name == "array_agg":
+        from ..types import ArrayType
+        return ArrayType(arg_types[0])
+    if name == "map_agg":
+        from ..types import MapType
+        return MapType(arg_types[0], arg_types[1])
+    if name == "histogram":
+        from ..types import MapType
+        return MapType(arg_types[0], BIGINT)
     if name == "approx_percentile":
         return DOUBLE if is_floating(arg_types[0]) else arg_types[0]
     if name in ("stddev", "stddev_samp", "stddev_pop", "variance", "var_samp",

@@ -222,11 +222,13 @@ class CharType(VarcharType):
 class ArrayType(Type):
     """ARRAY(element) — spi/type/ArrayType.java analogue.
 
-    TPU-first stance: variable-width array VALUES never materialize on device
-    (no ragged blocks); array expressions exist at PLAN time only, where
-    unnest/cardinality over the fixed-length ARRAY[..] constructor lower to
-    static unions/constants (sql/planner/planner.py). Dynamic arrays
-    (array_agg output) are future work and rejected at analysis."""
+    TPU-first stance: ragged array VALUES never ride the device as
+    variable-width blocks. Static ARRAY[..] constructors exist at PLAN time
+    only (unnest/cardinality lower to unions/constants). DYNAMIC arrays
+    (array_agg output) use the same design as varchar: the device column is
+    an int32 HANDLE into a host-side ArrayValues store (block.ArrayValues);
+    the ragged (offsets, values) pair is computed on device by the collect
+    aggregation and materialized host-side at the output boundary."""
 
     element: Type = None
 
@@ -235,8 +237,28 @@ class ArrayType(Type):
 
     @property
     def np_dtype(self) -> np.dtype:
-        raise NotImplementedError(
-            "array values have no device representation; unnest them")
+        return np.dtype(np.int32)  # handle into a host ArrayValues store
+
+    def display_name(self) -> str:
+        return self.name
+
+
+@dataclasses.dataclass(frozen=True)
+class MapType(Type):
+    """MAP(key, value) — spi/type/MapType.java analogue. Device
+    representation is the same int32 handle scheme as ArrayType (map_agg /
+    histogram outputs decode through block.ArrayValues)."""
+
+    key: Type = None
+    value: Type = None
+
+    def __post_init__(self):
+        object.__setattr__(self, "name",
+                           f"map({self.key.name}, {self.value.name})")
+
+    @property
+    def np_dtype(self) -> np.dtype:
+        return np.dtype(np.int32)
 
     def display_name(self) -> str:
         return self.name

@@ -1,0 +1,158 @@
+"""array_agg / map_agg / histogram — ragged collectors over the sort-based
+grouping engine (ops/collect_agg.py).
+
+Reference: operator/aggregation/arrayagg/ArrayAggregationFunction.java:50,
+MapAggregationFunction.java, histogram/Histogram.java. Output columns are
+int32 handles into a host ArrayValues store (the varchar codes+dictionary
+scheme); element order inside an array is engine-defined (the reference's is
+arrival order and equally unspecified across drivers), so comparisons are
+multiset-based."""
+from collections import Counter
+
+import numpy as np
+import pytest
+
+from presto_tpu.metadata import Session
+from presto_tpu.runner import LocalQueryRunner
+
+
+@pytest.fixture(scope="module")
+def runner():
+    return LocalQueryRunner(session=Session(catalog="tpch", schema="tiny"))
+
+
+def test_array_agg_grouped(runner):
+    got = runner.execute(
+        "select n_regionkey, array_agg(n_name) from tpch.tiny.nation "
+        "group by n_regionkey order by n_regionkey").rows
+    rows = runner.execute(
+        "select n_regionkey, n_name from tpch.tiny.nation").rows
+    want = {}
+    for rk, nm in rows:
+        want.setdefault(rk, []).append(nm)
+    assert len(got) == len(want)
+    for rk, arr in got:
+        assert isinstance(arr, list)
+        assert Counter(arr) == Counter(want[rk])
+
+
+def test_array_agg_global_and_empty(runner):
+    got = runner.execute(
+        "select array_agg(r_name) from tpch.tiny.region").rows
+    assert len(got) == 1
+    assert Counter(got[0][0]) == Counter(
+        ["AFRICA", "AMERICA", "ASIA", "EUROPE", "MIDDLE EAST"])
+    # empty input -> NULL (not an empty array), matching the reference
+    got = runner.execute(
+        "select array_agg(r_name) from tpch.tiny.region "
+        "where r_regionkey > 99").rows
+    assert got == [[None]]
+
+
+def test_array_agg_includes_nulls():
+    r = LocalQueryRunner(session=Session(catalog="memory", schema="default"))
+    r.execute("create table memory.default.seedc as "
+              "select o_orderkey as k, o_custkey as v "
+              "from tpch.tiny.orders limit 0")
+    r.execute("create table memory.default.ca as "
+              "select * from memory.default.seedc")
+    for k, v in [(1, 10), (1, None), (2, None), (2, None)]:
+        vv = "null" if v is None else str(v)
+        r.execute(f"insert into memory.default.ca values ({k}, {vv})")
+    got = dict(r.execute(
+        "select k, array_agg(v) from memory.default.ca group by k").rows)
+    assert Counter(got[1]) == Counter([10, None])
+    assert got[2] == [None, None]
+
+
+def test_array_agg_with_algebraic_mix(runner):
+    got = runner.execute(
+        "select n_regionkey, count(*), array_agg(n_nationkey), "
+        "sum(n_nationkey) from tpch.tiny.nation "
+        "group by n_regionkey order by n_regionkey").rows
+    rows = runner.execute(
+        "select n_regionkey, n_nationkey from tpch.tiny.nation").rows
+    want = {}
+    for rk, nk in rows:
+        want.setdefault(rk, []).append(nk)
+    for rk, cnt, arr, s in got:
+        assert cnt == len(want[rk])
+        assert sorted(arr) == sorted(want[rk])
+        assert s == sum(want[rk])
+
+
+def test_array_agg_filter(runner):
+    got = runner.execute(
+        "select array_agg(n_name) filter (where n_regionkey = 1) "
+        "from tpch.tiny.nation").rows
+    rows = runner.execute(
+        "select n_name from tpch.tiny.nation where n_regionkey = 1").rows
+    assert Counter(got[0][0]) == Counter(r[0] for r in rows)
+
+
+def test_map_agg(runner):
+    got = runner.execute(
+        "select map_agg(n_name, n_nationkey) from tpch.tiny.nation").rows
+    rows = runner.execute(
+        "select n_name, n_nationkey from tpch.tiny.nation").rows
+    assert got[0][0] == {n: k for n, k in rows}
+
+
+def test_map_agg_grouped(runner):
+    got = runner.execute(
+        "select n_regionkey, map_agg(n_name, n_nationkey) "
+        "from tpch.tiny.nation group by n_regionkey "
+        "order by n_regionkey").rows
+    rows = runner.execute(
+        "select n_regionkey, n_name, n_nationkey "
+        "from tpch.tiny.nation").rows
+    want = {}
+    for rk, nm, nk in rows:
+        want.setdefault(rk, {})[nm] = nk
+    assert {rk: m for rk, m in got} == want
+
+
+def test_histogram(runner):
+    got = runner.execute(
+        "select histogram(o_orderstatus) from tpch.tiny.orders").rows
+    rows = runner.execute(
+        "select o_orderstatus from tpch.tiny.orders").rows
+    want = Counter(r[0] for r in rows)
+    assert got[0][0] == dict(want)
+
+
+def test_histogram_grouped(runner):
+    got = runner.execute(
+        "select o_orderpriority, histogram(o_orderstatus) "
+        "from tpch.tiny.orders group by o_orderpriority").rows
+    rows = runner.execute(
+        "select o_orderpriority, o_orderstatus from tpch.tiny.orders").rows
+    want = {}
+    for p, s in rows:
+        want.setdefault(p, Counter())[s] += 1
+    assert {p: m for p, m in got} == {p: dict(c) for p, c in want.items()}
+
+
+def test_array_agg_order_by_after(runner):
+    """ORDER BY / LIMIT downstream of the collect output: handles are plain
+    int32 block data, so the sort permutes them like any column."""
+    got = runner.execute(
+        "select n_regionkey, array_agg(n_nationkey) as a "
+        "from tpch.tiny.nation group by n_regionkey "
+        "order by n_regionkey desc limit 2").rows
+    assert [r[0] for r in got] == [4, 3]
+    rows = runner.execute(
+        "select n_regionkey, n_nationkey from tpch.tiny.nation").rows
+    want = {}
+    for rk, nk in rows:
+        want.setdefault(rk, []).append(nk)
+    for rk, arr in got:
+        assert sorted(arr) == sorted(want[rk])
+
+
+def test_cardinality_of_array_agg(runner):
+    got = runner.execute(
+        "select n_regionkey, cardinality(array_agg(n_name)) "
+        "from tpch.tiny.nation group by n_regionkey "
+        "order by n_regionkey").rows
+    assert all(c == 5 for _, c in got)

@@ -1,0 +1,231 @@
+"""ORC writer (formats/orc_writer.py): round trips through the engine's own
+reader AND through pyarrow (interop proof — pyarrow is the *verifier* here,
+never the writer), plus hive/file-connector CTAS into ORC.
+
+Reference analogue: presto-orc's write side
+(presto-orc/src/main/java/com/facebook/presto/orc/OrcWriter.java:76)."""
+import numpy as np
+import pytest
+
+from presto_tpu.block import Block, Dictionary, Page
+from presto_tpu.connectors.file import FileConnector
+from presto_tpu.connectors.hive import HiveConnector
+from presto_tpu.connectors.tpch.connector import TpchConnector
+from presto_tpu.formats.orc import OrcFile
+from presto_tpu.formats.orc_writer import (encode_byte_rle, encode_rlev2,
+                                           write_orc)
+from presto_tpu.formats.orc import decode_byte_rle, decode_rlev2
+from presto_tpu.metadata import CatalogManager, Session
+from presto_tpu.runner import LocalQueryRunner
+from presto_tpu.types import (BIGINT, BOOLEAN, DATE, DOUBLE, INTEGER, REAL,
+                              SMALLINT, VARCHAR, DecimalType)
+
+
+def _page(n, cols, mask=None):
+    blocks = tuple(Block(t, np.asarray(data), nulls, d)
+                   for t, data, nulls, d in cols)
+    return Page(blocks, np.ones(n, dtype=bool) if mask is None else mask)
+
+
+def _mixed_pages(n=5000, seed=0):
+    rng = np.random.default_rng(seed)
+    d = Dictionary(["gamma", "alpha", "delta", "beta"])  # unsorted on purpose
+    nulls = (np.arange(n) % 7) == 0
+    snulls = (np.arange(n) % 11) == 0
+    cols = [
+        (BIGINT, rng.integers(-2**40, 2**40, n), None, None),
+        (INTEGER, rng.integers(-2**30, 2**30, n).astype(np.int32), None,
+         None),
+        (SMALLINT, rng.integers(-2**14, 2**14, n).astype(np.int16), None,
+         None),
+        (DOUBLE, rng.standard_normal(n), None, None),
+        (REAL, rng.standard_normal(n).astype(np.float32), None, None),
+        (BOOLEAN, rng.integers(0, 2, n).astype(bool), None, None),
+        (DATE, rng.integers(8000, 12000, n).astype(np.int32), None, None),
+        (DecimalType(12, 2), rng.integers(-10**6, 10**6, n), None, None),
+        (VARCHAR, rng.integers(0, 4, n).astype(np.int32), None, d),
+        (BIGINT, np.where(nulls, 0, np.arange(n)), nulls, None),
+        (VARCHAR, rng.integers(0, 4, n).astype(np.int32), snulls, d),
+    ]
+    names = ["c_i64", "c_i32", "c_i16", "c_f64", "c_f32", "c_bool",
+             "c_date", "c_dec", "c_str", "c_null", "c_strnull"]
+    types = [c[0] for c in cols]
+    dicts = [c[3] for c in cols]
+    return names, types, dicts, [_page(n, cols)], cols
+
+
+def _read_all(path, names):
+    f = OrcFile(path)
+    got = {}
+    nulls_out = {}
+    for s in range(f.n_stripes):
+        part = f.read_stripe(s, names)
+        for k, (v, nl) in part.items():
+            got.setdefault(k, []).append(v)
+            nulls_out.setdefault(k, []).append(
+                nl if nl is not None else np.zeros(len(v), dtype=bool))
+    f.close()
+    return ({k: np.concatenate(v) for k, v in got.items()},
+            {k: np.concatenate(v) for k, v in nulls_out.items()})
+
+
+@pytest.mark.parametrize("codec", ["none", "zlib"])
+def test_roundtrip_own_reader(tmp_path, codec):
+    names, types, dicts, pages, cols = _mixed_pages()
+    path = str(tmp_path / "t.orc")
+    n = write_orc(path, names, types, dicts, pages, codec=codec)
+    assert n == 5000
+    got, gnulls = _read_all(path, names)
+    for name, (t, data, nulls, d) in zip(names, cols):
+        vals = got[name]
+        nl = gnulls[name]
+        if nulls is not None:
+            assert np.array_equal(nl, nulls)
+        else:
+            assert not nl.any()
+        live = ~nl
+        if d is not None:
+            want = np.asarray([d.values[int(c)] for c in data], dtype=object)
+            assert list(vals[live]) == list(want[live])
+        elif t.name == "real":
+            assert np.allclose(vals[live], np.asarray(data)[live])
+        else:
+            assert np.array_equal(np.asarray(vals)[live],
+                                  np.asarray(data)[live])
+    # engine types survive the round trip
+    f = OrcFile(path)
+    schema = dict(f.schema)
+    assert schema["c_i64"] is BIGINT and schema["c_date"] is DATE
+    assert schema["c_i16"] is SMALLINT and schema["c_i32"] is INTEGER
+    assert isinstance(schema["c_dec"], DecimalType)
+    assert schema["c_dec"].scale == 2
+    f.close()
+
+
+def test_roundtrip_pyarrow(tmp_path):
+    """pyarrow/liborc reads the engine-written file — proves the protobuf
+    metadata, chunk framing, RLEv2 runs and stream layout are
+    spec-conformant."""
+    pa_orc = pytest.importorskip("pyarrow.orc")
+    names, types, dicts, pages, cols = _mixed_pages(n=3000)
+    path = str(tmp_path / "t.orc")
+    write_orc(path, names, types, dicts, pages, codec="zlib")
+    tbl = pa_orc.ORCFile(path).read()
+    assert tbl.num_rows == 3000
+    assert np.array_equal(tbl["c_i64"].to_numpy(),
+                          np.asarray(cols[0][1]))
+    assert np.array_equal(tbl["c_i32"].to_numpy(),
+                          np.asarray(cols[1][1]))
+    assert np.allclose(tbl["c_f64"].to_numpy(), cols[3][1])
+    assert np.array_equal(tbl["c_bool"].to_numpy(), cols[5][1])
+    d = dicts[8]
+    want = [d.values[int(c)] for c in cols[8][1]]
+    assert tbl["c_str"].to_pylist() == want
+    # nullable column: null positions survive
+    nulls = cols[9][2]
+    pl = tbl["c_null"].to_pylist()
+    assert [v is None for v in pl] == list(nulls)
+
+
+def test_rle_encoders_roundtrip():
+    rng = np.random.default_rng(1)
+    # byte RLE: repeats, literals, alternating tails
+    for arr in (np.full(1000, 7, dtype=np.uint8),
+                rng.integers(0, 256, 999).astype(np.uint8),
+                np.tile([1, 1, 1, 1, 2, 3], 100).astype(np.uint8),
+                np.asarray([5, 6], dtype=np.uint8)):
+        enc = encode_byte_rle(arr)
+        assert np.array_equal(decode_byte_rle(enc, len(arr)), arr)
+    # RLEv2: signed/unsigned, wide/narrow, exact multiples of 512
+    for arr, signed in (
+            (rng.integers(-2**50, 2**50, 1024), True),
+            (rng.integers(0, 2**8, 513), False),
+            (np.zeros(512, dtype=np.int64), True),
+            (np.asarray([-1, 0, 1, -2**62, 2**62], dtype=np.int64), True)):
+        enc = encode_rlev2(np.asarray(arr, dtype=np.int64), signed)
+        assert np.array_equal(decode_rlev2(enc, len(arr), signed),
+                              np.asarray(arr, dtype=np.int64))
+
+
+def test_multi_stripe_and_stats(tmp_path):
+    n = 10_000
+    data = np.arange(n, dtype=np.int64) * 3
+    path = str(tmp_path / "t.orc")
+    write_orc(path, ["k"], [BIGINT], [None],
+              [_page(n, [(BIGINT, data, None, None)])],
+              stripe_rows=4096)
+    f = OrcFile(path)
+    assert f.n_stripes == 3
+    assert f.num_rows == n
+    # stripe statistics drive pruning (OrcPredicate analogue)
+    lo, hi = f.stripe_col_stats(0, "k")
+    assert lo == 0 and hi == 4095 * 3
+    lo, hi = f.stripe_col_stats(2, "k")
+    assert lo == 8192 * 3 and hi == (n - 1) * 3
+    got, _ = _read_all(path, ["k"])
+    assert np.array_equal(got["k"], data)
+    f.close()
+
+
+def test_hive_ctas_orc_roundtrip(tmp_path):
+    """CTAS WITH (format='orc') on the hive catalog writes ORC through the
+    engine's own writer, reads back row-exact via the engine's own reader."""
+    catalogs = CatalogManager()
+    catalogs.register("tpch", TpchConnector("tpch"))
+    catalogs.register("hive", HiveConnector("hive", str(tmp_path / "wh")))
+    runner = LocalQueryRunner(
+        session=Session(catalog="tpch", schema="tiny"), catalogs=catalogs)
+    runner.execute(
+        "create table hive.default.nation_orc "
+        "with (format = 'orc') as "
+        "select n_nationkey, n_name, n_regionkey from tpch.tiny.nation")
+    got = runner.execute(
+        "select n_nationkey, n_name, n_regionkey "
+        "from hive.default.nation_orc order by n_nationkey")
+    want = runner.execute(
+        "select n_nationkey, n_name, n_regionkey "
+        "from tpch.tiny.nation order by n_nationkey")
+    assert got.rows == want.rows
+    # the files on disk really are ORC
+    import glob
+    import os
+    files = glob.glob(str(tmp_path / "wh" / "default" / "nation_orc" / "*"))
+    assert any(p.endswith(".orc") for p in files)
+    assert all(not p.endswith((".pcol", ".parquet")) for p in files
+               if not os.path.basename(p).startswith("."))
+    # INSERT appends a second ORC file and both read back
+    runner.execute(
+        "insert into hive.default.nation_orc "
+        "select n_nationkey + 100, n_name, n_regionkey "
+        "from tpch.tiny.nation")
+    total = runner.execute(
+        "select count(*) from hive.default.nation_orc")
+    assert total.rows[0][0] == 50
+
+
+def test_file_connector_orc_writes(tmp_path):
+    catalogs = CatalogManager()
+    catalogs.register("tpch", TpchConnector("tpch"))
+    catalogs.register("fs", FileConnector("fs", str(tmp_path / "data"),
+                                          write_format="orc"))
+    runner = LocalQueryRunner(
+        session=Session(catalog="tpch", schema="tiny"), catalogs=catalogs)
+    runner.execute(
+        "create table fs.s.region_orc as select r_regionkey, r_name "
+        "from tpch.tiny.region")
+    got = runner.execute(
+        "select r_regionkey, r_name from fs.s.region_orc "
+        "order by r_regionkey")
+    assert len(got.rows) == 5
+    assert got.rows[0][1] == "AFRICA"
+
+
+def test_empty_table_roundtrip(tmp_path):
+    path = str(tmp_path / "e.orc")
+    n = write_orc(path, ["a", "b"], [BIGINT, VARCHAR],
+                  [None, Dictionary(["x"])], [])
+    assert n == 0
+    f = OrcFile(path)
+    assert f.num_rows == 0 and f.n_stripes == 0
+    assert dict(f.schema)["a"] is BIGINT
+    f.close()

@@ -1,0 +1,144 @@
+"""Remote-service connector (connectors/remote): SQL over tables served by
+an out-of-process JSON-RPC service, verified against the sqlite oracle.
+
+Reference analogue: presto-thrift-connector
+(presto-thrift-connector/.../ThriftConnector.java:33) with its testing
+server (presto-thrift-testing-server) — the "connector backed by a remote
+service" architecture: batched splits and row batches with continuation
+tokens, multi-endpoint failover."""
+import numpy as np
+import pytest
+
+from presto_tpu.connectors.remote import (RemoteClient, RemoteConnector,
+                                          RemoteTestingService)
+from presto_tpu.metadata import CatalogManager, Session
+from presto_tpu.runner import LocalQueryRunner
+from presto_tpu.utils.testing import SqliteOracle, assert_rows_equal
+
+
+def _load_oracle(oracle, name, cols, data):
+    names = [n for n, _ in cols]
+    oracle.conn.execute(f"CREATE TABLE {name} ({', '.join(names)})")
+    n = len(next(iter(data.values())))
+    rows = [tuple(data[c][i] for c in names) for i in range(n)]
+    oracle.conn.executemany(
+        f"INSERT INTO {name} VALUES ({','.join('?' * len(names))})", rows)
+    oracle.conn.commit()
+
+
+@pytest.fixture()
+def service():
+    svc = RemoteTestingService(rows_per_batch=100, n_splits=3)
+    rng = np.random.default_rng(7)
+    n = 1000
+    svc.add_table(
+        "sales", "orders",
+        [("o_id", "bigint"), ("o_total", "double"),
+         ("o_status", "varchar"), ("o_region", "varchar"),
+         ("o_discount", "bigint")],
+        {
+            "o_id": list(range(n)),
+            "o_total": [round(float(x), 2)
+                        for x in rng.uniform(1, 1000, n)],
+            "o_status": [["OPEN", "SHIPPED", "DONE"][i % 3]
+                         for i in range(n)],
+            "o_region": [None if i % 10 == 0 else
+                         ["east", "west"][i % 2] for i in range(n)],
+            "o_discount": [None if i % 7 == 0 else int(i % 5)
+                           for i in range(n)],
+        })
+    svc.add_table("sales", "tiny",
+                  [("k", "bigint")], {"k": [1, 2, 3]})
+    endpoint = svc.start()
+    yield svc, endpoint
+    svc.stop()
+
+
+def _runner(endpoint):
+    catalogs = CatalogManager()
+    catalogs.register("svc", RemoteConnector("svc", [endpoint]))
+    return LocalQueryRunner(
+        session=Session(catalog="svc", schema="sales"), catalogs=catalogs)
+
+
+def test_metadata_discovery(service):
+    svc, endpoint = service
+    runner = _runner(endpoint)
+    tables = runner.execute("show tables")
+    assert sorted(r[0] for r in tables.rows) == ["orders", "tiny"]
+    cols = runner.execute("show columns from orders")
+    assert [r[0] for r in cols.rows] == [
+        "o_id", "o_total", "o_status", "o_region", "o_discount"]
+
+
+def test_scan_and_aggregate_vs_oracle(service):
+    svc, endpoint = service
+    runner = _runner(endpoint)
+    cols, data = svc.tables[("sales", "orders")]
+    oracle = SqliteOracle()
+    _load_oracle(oracle, "orders", cols, data)
+    sql = ("select o_status, count(*) as c, sum(o_total) as s "
+           "from orders where o_total > 100 "
+           "group by o_status order by o_status")
+    got = runner.execute(sql)
+    want = oracle.query(sql)
+    assert_rows_equal(got.rows, want)
+
+
+def test_null_semantics_and_join(service):
+    svc, endpoint = service
+    runner = _runner(endpoint)
+    cols, data = svc.tables[("sales", "orders")]
+    oracle = SqliteOracle()
+    _load_oracle(oracle, "orders", cols, data)
+    # nullable varchar + nullable bigint: NULL group keys and filters
+    sql = ("select o_region, sum(o_discount) as d, count(o_discount) as c "
+           "from orders group by o_region order by o_region nulls first")
+    got = runner.execute(sql)
+    want = oracle.query(sql.replace("nulls first", ""))
+    # sqlite sorts NULL first by default in ASC — same contract
+    assert_rows_equal(got.rows, want)
+    # self join through the engine's hash join on remote-sourced pages
+    sql2 = ("select a.o_status, count(*) as c from orders a "
+            "join orders b on a.o_id = b.o_id "
+            "group by a.o_status order by a.o_status")
+    got2 = runner.execute(sql2)
+    want2 = oracle.query(sql2)
+    assert_rows_equal(got2.rows, want2)
+
+
+def test_continuation_tokens_exercised(service):
+    """rows_per_batch=100 over ~333-row split ranges forces multiple row
+    batches per split AND multiple split batches (2 per RPC, 3 splits)."""
+    svc, endpoint = service
+    runner = _runner(endpoint)
+    before = svc.request_count
+    got = runner.execute("select count(*) from orders")
+    assert got.rows[0][0] == 1000
+    # at least: 1 metadata + 2 split batches + 3 splits * 4 row batches
+    assert svc.request_count - before >= 10
+
+
+def test_failover_to_live_endpoint(service):
+    svc, endpoint = service
+    # dead endpoint first: every call must fail over to the live one
+    client = RemoteClient(["http://127.0.0.1:1", endpoint],
+                          timeout_s=2.0)
+    assert client.call("list_schemas") == ["sales"]
+    catalogs = CatalogManager()
+    catalogs.register("svc", RemoteConnector(
+        "svc", ["http://127.0.0.1:1", endpoint], timeout_s=2.0))
+    runner = LocalQueryRunner(
+        session=Session(catalog="svc", schema="sales"), catalogs=catalogs)
+    got = runner.execute("select sum(k) from tiny")
+    assert got.rows[0][0] == 6
+
+
+def test_server_catalog_factory(tmp_path, service):
+    """etc/catalog/*.properties with connector.name=remote builds the
+    connector through the server config path."""
+    svc, endpoint = service
+    from presto_tpu.server.config import FACTORIES
+    conn = FACTORIES["remote"]("svc", {"remote.uri": endpoint})
+    names = conn.metadata().list_schemas()
+    assert names == ["sales"]
