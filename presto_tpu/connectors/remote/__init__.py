@@ -133,8 +133,8 @@ class RemoteMetadata(ConnectorMetadata):
             if d is None:
                 vals = self.client.call(
                     "column_values", schema=name.schema, table=name.table,
-                    column=column, limit=_DICT_LIMIT)
-                if len(vals) >= _DICT_LIMIT:
+                    column=column, limit=_DICT_LIMIT + 1)
+                if len(vals) > _DICT_LIMIT:
                     raise ValueError(
                         f"remote varchar column {name}.{column} exceeds the "
                         f"{_DICT_LIMIT}-value dictionary bound")

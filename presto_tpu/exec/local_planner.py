@@ -272,7 +272,9 @@ class LocalExecutionPlanner:
                  devices=None, bucket_filter: Optional[int] = None):
         self.metadata = metadata
         self.session = session
-        self.page_capacity = int(session.get("page_capacity"))
+        from ..metadata import default_page_capacity
+        cap = session.get("page_capacity")
+        self.page_capacity = int(cap) if cap else default_page_capacity()
         self.n_workers = n_workers
         # grouped (lifespan) execution: restrict every scan to this bucket's
         # splits (exec/grouped.py drives one planner per lifespan)

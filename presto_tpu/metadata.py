@@ -26,7 +26,9 @@ class Session:
 
     # engine defaults (the SystemSessionProperties subset that matters here)
     DEFAULTS = {
-        "page_capacity": 1 << 16,
+        # None = platform default (default_page_capacity), resolved only
+        # when execution actually needs the backend
+        "page_capacity": None,
         "task_concurrency": 4,
         # intra-pipeline driver parallelism: AUTO = task_concurrency on
         # accelerators, 1 on the CPU backend (XLA-CPU already uses all cores);
@@ -69,6 +71,17 @@ class Session:
         props = dict(self.properties)
         props.update(kw)
         return dataclasses.replace(self, properties=props)
+
+
+def default_page_capacity() -> int:
+    """Platform default page size, resolved at execution time. Pages are the
+    unit of dispatch: on an accelerator every page costs kernel-launch
+    round-trips (over a remote tunnel each is a network RTT), so pages are
+    sized to make the page COUNT small — SF1 lineitem is 2 x 4M-row pages
+    instead of 23 x 256k. XLA-CPU prefers cache-sized batches (256k)."""
+    import jax
+
+    return (1 << 22) if jax.default_backend() != "cpu" else (1 << 18)
 
 
 @dataclasses.dataclass(frozen=True)

@@ -381,16 +381,33 @@ class GroupedAggregationBuilder:
         if self._acc is not None:
             parts.append(self._acc)
             self._acc = None
-        keys = tuple(jnp.concatenate([p[0][i] for p in parts])
-                     for i in range(len(self.key_types)))
-        states = tuple(jnp.concatenate([p[1][i] for p in parts])
-                       for i in range(len(self.kinds)))
-        valid = jnp.concatenate([p[2] for p in parts])
-        size = self._table_size or _pow2(min(int(valid.shape[0]), self.max_groups))
+        # pad the part count to its pow2 bucket with zero-row dummies so the
+        # fused combine kernel's trace signature is bounded by O(log parts)
+        # distinct counts, not one compile per exact count
+        n_parts = len(parts)
+        want = _pow2_count(n_parts)
+        if want > n_parts:
+            z_keys = tuple(jnp.zeros(0, dtype=p.dtype)
+                           for p in parts[0][0])
+            z_states = tuple(
+                jnp.zeros((0,) + tuple(s.shape[1:]), dtype=s.dtype)
+                for s in parts[0][1])
+            z_valid = jnp.zeros(0, dtype=jnp.bool_)
+            parts = parts + [(z_keys, z_states, z_valid)] * (want - n_parts)
+        key_parts = tuple(tuple(p[0][i] for p in parts)
+                          for i in range(len(self.key_types)))
+        state_parts = tuple(tuple(p[1][i] for p in parts)
+                            for i in range(len(self.kinds)))
+        valid_parts = tuple(p[2] for p in parts)
+        total_rows = sum(int(v.shape[0]) for v in valid_parts)
+        size = self._table_size or _pow2(min(total_rows, self.max_groups))
         while True:
-            gkeys, gstates, gvalid, ngroups = _combine_kernel(
-                keys, valid, states, self.kinds, self.identities, size,
-                self.widths)
+            # concat + sort + reduce in ONE jitted dispatch (the eager
+            # per-column concatenates were a dispatch each — costly on a
+            # remote accelerator)
+            gkeys, gstates, gvalid, ngroups = _combine_parts_kernel(
+                key_parts, valid_parts, state_parts, self.kinds,
+                self.identities, size, self.widths)
             n = int(ngroups)
             if n <= size or size >= self.max_groups:
                 break
@@ -399,9 +416,11 @@ class GroupedAggregationBuilder:
             # more live groups than the device table can hold: move the (still
             # complete) input rows to host and keep accumulating fresh
             self._spilled.append((
-                tuple(np.asarray(k) for k in keys),
-                tuple(np.asarray(s) for s in states),
-                np.asarray(valid)))
+                tuple(np.concatenate([np.asarray(x) for x in kp])
+                      for kp in key_parts),
+                tuple(np.concatenate([np.asarray(x) for x in sp])
+                      for sp in state_parts),
+                np.concatenate([np.asarray(v) for v in valid_parts])))
             self._table_size = None
             return
         # shrink the table to the true group count's bucket: gvalid is a prefix,
@@ -524,6 +543,25 @@ class GroupedAggregationBuilder:
         return out
 
 
+@functools.partial(jax.jit, static_argnames=("cap", "dtypes"))
+def _slice_result_page(arrs, nulls, valid, lo, cap, dtypes):
+    """Assemble one output page: per-column [lo, lo+cap) slice, pad, and
+    dtype cast, in a single dispatch (the eager-slice loop cost one device
+    round-trip per column)."""
+    def seg(a, dt):
+        n = a.shape[0]
+        padded = jnp.concatenate([a, jnp.zeros(cap, dtype=a.dtype)])
+        return jax.lax.dynamic_slice_in_dim(
+            padded, jnp.clip(lo, 0, n), cap)
+
+    datas = tuple(seg(a, dt).astype(dt)
+                  for a, dt in zip(arrs, dtypes))
+    nmasks = tuple(None if nl is None else seg(nl, jnp.bool_)
+                   for nl in nulls)
+    m = seg(valid, jnp.bool_)
+    return datas, nmasks, m
+
+
 @functools.partial(jax.jit, static_argnames=("kinds", "identities",
                                              "max_groups", "widths"))
 def _combine_kernel(keys, valid, states, kinds, identities, max_groups,
@@ -532,8 +570,26 @@ def _combine_kernel(keys, valid, states, kinds, identities, max_groups,
                              max_groups, widths)
 
 
+@functools.partial(jax.jit, static_argnames=("kinds", "identities",
+                                             "max_groups", "widths"))
+def _combine_parts_kernel(key_parts, valid_parts, state_parts, kinds,
+                          identities, max_groups, widths=None):
+    """_combine_kernel with the cross-part concatenation fused in: one
+    dispatch folds N pending partials into the compact table."""
+    keys = tuple(jnp.concatenate(list(kp)) for kp in key_parts)
+    states = tuple(jnp.concatenate(list(sp)) for sp in state_parts)
+    valid = jnp.concatenate(list(valid_parts))
+    return sort_group_reduce(keys, valid, states, kinds, identities,
+                             max_groups, widths)
+
+
 def _pow2(n: int) -> int:
     return 1 << max(4, (n - 1).bit_length())
+
+
+def _pow2_count(n: int) -> int:
+    """Next power of two >= n (no floor) — part-count bucketing."""
+    return 1 << max(0, (n - 1).bit_length())
 
 
 class DirectAggregationBuilder:
@@ -830,22 +886,17 @@ class HashAggregationOperator(Operator):
                 out_cols.append((call.function.output_type,
                                  jnp.asarray(out, dtype=call.function.output_type.np_dtype),
                                  d, nulls))
+        dtypes = tuple(np.dtype(t.np_dtype) for (t, _a, _d, _n) in out_cols)
+        arrs = tuple(a for (_t, a, _d, _n) in out_cols)
+        nulls_in = tuple(n for (_t, _a, _d, n) in out_cols)
         for lo in range(0, max(total, 1), cap):
-            hi = min(lo + cap, total)
-            blocks = []
-            for (t, arr, d, nulls) in out_cols:
-                seg = arr[lo:hi]
-                nseg = nulls[lo:hi] if nulls is not None else None
-                if hi - lo < cap:
-                    seg = jnp.concatenate(
-                        [seg, jnp.zeros(cap - (hi - lo), dtype=seg.dtype)])
-                    if nseg is not None:
-                        nseg = jnp.concatenate(
-                            [nseg, jnp.zeros(cap - (hi - lo), dtype=jnp.bool_)])
-                blocks.append(Block(t, seg.astype(t.np_dtype), nseg, d))
-            m = valid[lo:hi]
-            if hi - lo < cap:
-                m = jnp.concatenate([m, jnp.zeros(cap - (hi - lo), dtype=jnp.bool_)])
+            # one fused dispatch assembles the whole output page (slice +
+            # pad + dtype cast across every column)
+            datas, nmasks, m = _slice_result_page(
+                arrs, nulls_in, valid, jnp.asarray(lo, jnp.int32), cap,
+                dtypes)
+            blocks = [Block(t, dd, nn, d) for (t, _a, d, _n), dd, nn
+                      in zip(out_cols, datas, nmasks)]
             pages.append(Page(tuple(blocks), m))
             if total == 0:
                 break

@@ -242,29 +242,55 @@ class JoinBuildOperator(Operator):
                 sorted_key=jnp.full(1, np.iinfo(np.int64).max, dtype=jnp.int64),
                 sorted_row=jnp.zeros(1, dtype=jnp.int32),
                 payload_nulls=tuple(None for _ in self.f.payload_meta))
-        keys = [jnp.concatenate([p.blocks[i].data for p in self._pages])
-                for i in range(kc)]
-        payload = []
-        payload_nulls = []
-        for i in range(len(self.f.payload_channels)):
-            payload.append(jnp.concatenate([p.blocks[kc + i].data for p in self._pages]))
-            if any(p.blocks[kc + i].nulls is not None for p in self._pages):
-                payload_nulls.append(jnp.concatenate(
-                    [p.blocks[kc + i].null_mask() for p in self._pages]))
-            else:
-                payload_nulls.append(None)
-        mask = jnp.concatenate([p.mask for p in self._pages])
-        n = int(jnp.sum(mask.astype(jnp.int32)))
-        total = mask.shape[0]
-
+        # one fused kernel: concat across pages + count + (dense table | key
+        # sort). On the device this is ONE dispatch instead of one eager
+        # concatenate per column plus a host count sync — the TPU build wall
+        # is dispatch round-trips, not FLOPs (operator/PagesHash.java:34's
+        # role, re-shaped for a remote accelerator).
+        null_cols = tuple(i for i in range(len(self.f.payload_channels))
+                          if any(p.blocks[kc + i].nulls is not None
+                                 for p in self._pages))
+        # pad the page count to its pow2 bucket with a zero-row dummy so the
+        # fused build kernel's trace signature is bounded by O(log pages)
+        # distinct counts (remote compiles cost seconds each)
+        pages = list(self._pages)
+        want = 1 << max(0, (len(pages) - 1).bit_length())
+        if want > len(pages):
+            p0 = pages[0]
+            zb = tuple(Block(b.type,
+                             jnp.zeros((0,), dtype=b.data.dtype),
+                             jnp.zeros((0,), dtype=jnp.bool_)
+                             if b.nulls is not None else None,
+                             b.dictionary)
+                       for b in p0.blocks)
+            zp = Page(zb, jnp.zeros((0,), dtype=jnp.bool_))
+            pages.extend([zp] * (want - len(pages)))
+        pages = tuple(pages)
         if self.f.strategy == "dense" and kc == 1:
-            src = _build_dense(keys[0], tuple(payload), mask, n,
-                               self.f.dense_min, self.f.dense_max,
-                               self.f.payload_meta, self.f.unique)
+            keys, payload, pnulls, mask, n_dev, table = _fused_build_dense(
+                pages, kc, null_cols, self.f.dense_min,
+                int(self.f.dense_max - self.f.dense_min + 1))
+            src = LookupSource(
+                kind="dense", key_arrays=keys, payload=payload,
+                payload_meta=self.f.payload_meta,
+                build_count=n_dev.astype(jnp.int32), unique=self.f.unique,
+                table=table, base=self.f.dense_min)
+        elif kc == 1:
+            keys, payload, pnulls, mask, n_dev, sorted_key, sorted_row = \
+                _fused_build_sorted(pages, kc, null_cols)
+            src = LookupSource(
+                kind="sorted", key_arrays=keys, payload=payload,
+                payload_meta=self.f.payload_meta,
+                build_count=n_dev.astype(jnp.int32), unique=self.f.unique,
+                sorted_key=sorted_key, sorted_row=sorted_row)
         else:
-            src = _build_sorted(tuple(keys), tuple(payload), mask, n,
+            # multi-key: the bijective packing plan needs host min/max
+            keys, payload, pnulls, mask, n_dev = _concat_parts(
+                pages, kc, null_cols)
+            src = _build_sorted(tuple(keys), tuple(payload), mask,
+                                n_dev.astype(jnp.int32),
                                 self.f.payload_meta, self.f.unique)
-        src.payload_nulls = tuple(payload_nulls)
+        src.payload_nulls = tuple(pnulls)
         src.has_null_key = bool(self._saw_null_key) if self._saw_null_key is not None else False
         if self._null_key_pages:
             nmask = np.concatenate([np.asarray(p.mask)
@@ -303,6 +329,50 @@ def _compact_for_build(page: Page, key_channels: Tuple[int, ...],
 
 
 _compact_jit = jax.jit(lambda p: p.compact())
+
+
+def _concat_parts_impl(pages, kc: int, null_cols):
+    """Concat compacted build pages into flat key/payload/nulls/mask arrays."""
+    keys = tuple(jnp.concatenate([p.blocks[i].data for p in pages])
+                 for i in range(kc))
+    npayload = len(pages[0].blocks) - kc
+    payload = tuple(jnp.concatenate([p.blocks[kc + i].data for p in pages])
+                    for i in range(npayload))
+    pnulls = tuple(
+        jnp.concatenate([p.blocks[kc + i].null_mask() for p in pages])
+        if i in null_cols else None
+        for i in range(npayload))
+    mask = jnp.concatenate([p.mask for p in pages])
+    n = jnp.sum(mask.astype(jnp.int32))
+    return keys, payload, pnulls, mask, n
+
+
+_concat_parts = functools.partial(jax.jit, static_argnames=(
+    "kc", "null_cols"))(_concat_parts_impl)
+
+
+@functools.partial(jax.jit, static_argnames=("kc", "null_cols", "base",
+                                             "domain"))
+def _fused_build_dense(pages, kc, null_cols, base, domain):
+    keys, payload, pnulls, mask, n = _concat_parts_impl(pages, kc, null_cols)
+    key = keys[0]
+    idx = (key.astype(jnp.int64) - base).astype(jnp.int32)
+    idx = jnp.where(mask, idx, domain)  # dropped
+    table = jnp.full(domain, -1, dtype=jnp.int32)
+    rows = jnp.arange(key.shape[0], dtype=jnp.int32)
+    table = table.at[idx].set(rows, mode="drop")
+    return keys, payload, pnulls, mask, n, table
+
+
+@functools.partial(jax.jit, static_argnames=("kc", "null_cols"))
+def _fused_build_sorted(pages, kc, null_cols):
+    keys, payload, pnulls, mask, n = _concat_parts_impl(pages, kc, null_cols)
+    ck = combined_key(keys)
+    big = jnp.int64(np.iinfo(np.int64).max)
+    ck = jnp.where(mask, ck, big)
+    order = jnp.argsort(ck)
+    return (keys, payload, pnulls, mask, n,
+            ck[order], order.astype(jnp.int32))
 
 
 @functools.partial(jax.jit, static_argnames=("domain",))

@@ -62,15 +62,10 @@ class LocalQueryRunner:
     def __init__(self, session: Optional[Session] = None,
                  catalogs: Optional[CatalogManager] = None,
                  page_capacity: Optional[int] = None):
-        if page_capacity is None:
-            # pages are the unit of dispatch: on an accelerator every page
-            # costs kernel-launch round-trips (and over a remote tunnel each
-            # is a network RTT), so size pages to make the page COUNT small —
-            # SF1 lineitem is 2 x 4M-row pages instead of 23 x 256k. XLA-CPU
-            # prefers cache-sized batches, so the host backend keeps 256k.
-            import jax as _jax
-            page_capacity = (1 << 22) if _jax.default_backend() != "cpu" \
-                else (1 << 18)
+        # page_capacity None = platform default, resolved LAZILY at local
+        # planning (metadata.default_page_capacity) — the constructor must
+        # not touch the jax backend: metadata/DDL-only callers would hang on
+        # a wedged device tunnel before running a single kernel
         if catalogs is None:
             catalogs = CatalogManager()
             catalogs.register("tpch", TpchConnector("tpch"))
@@ -83,8 +78,10 @@ class LocalQueryRunner:
         self.catalogs = catalogs
         self.metadata = MetadataManager(catalogs)
         self.session = session or Session(catalog="tpch", schema="tiny")
-        if "page_capacity" not in self.session.properties:
-            self.session = self.session.with_properties(page_capacity=page_capacity)
+        if page_capacity is not None and \
+                "page_capacity" not in self.session.properties:
+            self.session = self.session.with_properties(
+                page_capacity=page_capacity)
         self.parser = SqlParser()
         # bucket count of the last grouped (lifespan) execution, None if the
         # last query ran ungrouped — observability for tests and EXPLAIN
@@ -258,15 +255,14 @@ class LocalQueryRunner:
         exec_plan = local.plan(plan)
 
         from .types import ArrayType, MapType
-        if not isinstance(stmt, t.DropTable):
-            for n, tt in zip(exec_plan.output_names, exec_plan.output_types):
-                if isinstance(tt, (ArrayType, MapType)):
-                    # handles index a query-lifetime host store; persisting
-                    # them would write dangling int32s (no file format here
-                    # serializes ragged values yet)
-                    raise ValueError(
-                        f"column {n}: {tt.name} values cannot be persisted "
-                        f"(array_agg/map_agg outputs are query-scoped)")
+        for n, tt in zip(exec_plan.output_names, exec_plan.output_types):
+            if isinstance(tt, (ArrayType, MapType)):
+                # handles index a query-lifetime host store; persisting
+                # them would write dangling int32s (no file format here
+                # serializes ragged values yet)
+                raise ValueError(
+                    f"column {n}: {tt.name} values cannot be persisted "
+                    f"(array_agg/map_agg outputs are query-scoped)")
 
         created = False
         if isinstance(stmt, t.CreateTableAsSelect):
