@@ -150,7 +150,7 @@ class ClusterQueryRunner:
             try:
                 source = StreamingRemoteSource(
                     [root.location], 0, types, dicts,
-                    int(self.session.get("page_capacity")))
+                    int(self.session.get("page_capacity") or (1 << 16)))
                 for page in source:
                     rows.extend(page.to_pylists())
             except BaseException as e:  # noqa: BLE001

@@ -352,7 +352,9 @@ class SqlTask:
         self.output_types = own_plan.output_types
         self.output_dicts = own_plan.output_dicts
         # wire remote sources to streaming HTTP pulls
-        page_cap = int(req.session.get("page_capacity"))
+        from ..metadata import default_page_capacity
+        page_cap = int(req.session.get("page_capacity")
+                       or default_page_capacity())
         for fid, slot in own_lp.remote_slots.items():
             locations = req.input_locations.get(fid, [])
             dicts = plans[fid][1].output_dicts

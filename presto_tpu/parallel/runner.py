@@ -11,9 +11,11 @@ where the reference boots N HTTP servers, here "workers" are mesh devices:
 
 The data plane between fragments is the real XLA collective — the engine's
 answer to the reference's HTTP+LZ4 shuffle (PartitionedOutputOperator.java:380,
-ExchangeClient.java). Worker tasks within a fragment currently run sequentially
-on the host control thread (the task-executor rev threads them); the collective
-itself always runs as one SPMD program over all workers.
+ExchangeClient.java). Within a fragment, EVERY worker's drivers are enqueued
+on one shared TaskExecutor and time-slice across its runner threads (so 8
+virtual workers never host-serialize; build/probe pipelines of different
+workers overlap); the collective itself always runs as one SPMD program over
+all workers.
 """
 from __future__ import annotations
 
@@ -145,7 +147,8 @@ class DistributedQueryRunner:
             routed[frag.id] = run_exchange(
                 self.mesh, frag.output_kind, key_idx, per_worker,
                 ep.output_types, ep.output_dicts,
-                page_capacity=int(self.session.get("page_capacity")),
+                page_capacity=int(self.session.get("page_capacity")
+                                  or (1 << 14)),
                 orderings=orderings)
             frag_dicts[frag.id] = ep.output_dicts
         raise AssertionError("root fragment must terminate execution")

@@ -222,24 +222,172 @@ def _wrap_filter(node: PlanNode, conjuncts: List[RowExpression]) -> PlanNode:
 # cardinality estimation (cost/StatsCalculator analogue, heavily narrowed)
 # ---------------------------------------------------------------------------
 
+def _resolve_scan_column(node: PlanNode, name: str):
+    """Follow identity projections/filters down to (TableScanNode, column
+    name), or None when the symbol is computed (the reference's
+    symbol-to-source-column provenance in cost/ScalarStatsCalculator)."""
+    if isinstance(node, TableScanNode):
+        for s, ch in node.assignments:
+            if s.name == name:
+                return node, ch.name
+        return None
+    if isinstance(node, ProjectNode):
+        for s, e in node.assignments:
+            if s.name == name:
+                if isinstance(e, SymbolRef):
+                    return _resolve_scan_column(node.source, e.name)
+                return None
+        return None
+    if isinstance(node, FilterNode):
+        return _resolve_scan_column(node.source, name)
+    return None
+
+
+def _column_stats(source: PlanNode, name: str, metadata: MetadataManager):
+    """-> spi ColumnStatistics for the symbol, or None."""
+    hit = _resolve_scan_column(source, name)
+    if hit is None:
+        return None
+    scan, col = hit
+    stats = metadata.get_table_statistics(scan.table)
+    return stats.columns.get(col)
+
+
+def _const_value(e) -> Optional[float]:
+    if isinstance(e, Constant) and isinstance(e.value, (int, float)) \
+            and not isinstance(e.value, bool):
+        return float(e.value)
+    return None
+
+
+_CMP_FLIP = {"less_than": "greater_than",
+             "less_than_or_equal": "greater_than_or_equal",
+             "greater_than": "less_than",
+             "greater_than_or_equal": "less_than_or_equal",
+             "equal": "equal", "not_equal": "not_equal"}
+
+
+def conjunct_selectivity(e: RowExpression, source: PlanNode,
+                         metadata: MetadataManager) -> float:
+    """FilterStatsCalculator.java analogue: per-conjunct selectivity from
+    connector column statistics (min/max for ranges, NDV for equality,
+    null fraction for IS NULL), falling back to the fixed default."""
+    if isinstance(e, SpecialForm):
+        if e.form == "AND":
+            out = 1.0
+            for a in e.args:
+                out *= conjunct_selectivity(a, source, metadata)
+            return out
+        if e.form == "OR":
+            miss = 1.0
+            for a in e.args:
+                miss *= 1.0 - conjunct_selectivity(a, source, metadata)
+            return 1.0 - miss
+        if e.form == "NOT":
+            return 1.0 - conjunct_selectivity(e.args[0], source, metadata)
+        if e.form == "IS_NULL" and isinstance(e.args[0], SymbolRef):
+            cs = _column_stats(source, e.args[0].name, metadata)
+            return cs.null_fraction if cs is not None else 0.1
+        if e.form == "BETWEEN" and isinstance(e.args[0], SymbolRef):
+            lo = _range_selectivity(source, e.args[0].name,
+                                    "greater_than_or_equal", e.args[1],
+                                    metadata)
+            hi = _range_selectivity(source, e.args[0].name,
+                                    "less_than_or_equal", e.args[2], metadata)
+            if lo is not None and hi is not None:
+                return max(0.0, lo + hi - 1.0)
+            return FILTER_SELECTIVITY
+        if e.form == "IN" and isinstance(e.args[0], SymbolRef):
+            cs = _column_stats(source, e.args[0].name, metadata)
+            if cs is not None and cs.distinct_count:
+                return min(1.0, (len(e.args) - 1) / cs.distinct_count)
+            return FILTER_SELECTIVITY
+        return FILTER_SELECTIVITY
+    if isinstance(e, Call) and e.name in _CMP_FLIP and len(e.args) == 2:
+        a, b = e.args
+        op = e.name
+        if isinstance(b, SymbolRef) and not isinstance(a, SymbolRef):
+            a, b, op = b, a, _CMP_FLIP[op]
+        if isinstance(a, SymbolRef) and isinstance(b, Constant):
+            cs = _column_stats(source, a.name, metadata)
+            if op == "equal":
+                if cs is not None and cs.distinct_count:
+                    return min(1.0, 1.0 / cs.distinct_count)
+                return FILTER_SELECTIVITY
+            if op == "not_equal":
+                if cs is not None and cs.distinct_count:
+                    return max(0.0, 1.0 - 1.0 / cs.distinct_count)
+                return 1.0 - FILTER_SELECTIVITY
+            s = _range_selectivity(source, a.name, op, b, metadata)
+            if s is not None:
+                return s
+    return FILTER_SELECTIVITY
+
+
+def _range_selectivity(source, name, op, const_expr,
+                       metadata) -> Optional[float]:
+    cs = _column_stats(source, name, metadata)
+    v = _const_value(const_expr)
+    if cs is None or v is None or cs.min_value is None or \
+            cs.max_value is None or cs.max_value <= cs.min_value:
+        return None
+    span = cs.max_value - cs.min_value
+    frac = (v - cs.min_value) / span
+    if op in ("less_than", "less_than_or_equal"):
+        out = frac
+    else:
+        out = 1.0 - frac
+    return float(min(1.0, max(0.0, out)))
+
+
+def _join_key_ndv(node: PlanNode, sym: Symbol, metadata) -> Optional[float]:
+    cs = _column_stats(node, sym.name, metadata)
+    return cs.distinct_count if cs is not None else None
+
+
 def estimate_rows(node: PlanNode, metadata: MetadataManager) -> float:
     if isinstance(node, TableScanNode):
         stats = metadata.get_table_statistics(node.table)
         return stats.row_count or 1e6
     if isinstance(node, FilterNode):
-        n = len(split_and(node.predicate))
-        return estimate_rows(node.source, metadata) * (FILTER_SELECTIVITY ** n)
+        src = estimate_rows(node.source, metadata)
+        sel = 1.0
+        for conj in split_and(node.predicate):
+            sel *= conjunct_selectivity(conj, node.source, metadata)
+        return src * sel
     if isinstance(node, (ProjectNode, SortNode)):
         return estimate_rows(node.children()[0], metadata)
     if isinstance(node, AggregationNode):
         if not node.keys:
             return 1.0
-        return max(1.0, estimate_rows(node.source, metadata) * 0.1)
+        src = estimate_rows(node.source, metadata)
+        ndv = 1.0
+        known = False
+        for k in node.keys:
+            d = _join_key_ndv(node.source, k, metadata)
+            if d:
+                ndv *= d
+                known = True
+        if known:
+            return max(1.0, min(src, ndv))
+        return max(1.0, src * 0.1)
     if isinstance(node, JoinNode):
         l = estimate_rows(node.left, metadata)
         r = estimate_rows(node.right, metadata)
         if not node.criteria:
             return l * r
+        # JoinStatsRule.java: |L x R| / max(NDV(lk), NDV(rk)) per equi-clause
+        out = l * r
+        known = False
+        for (lk, rk) in node.criteria:
+            ndv_l = _join_key_ndv(node.left, lk, metadata)
+            ndv_r = _join_key_ndv(node.right, rk, metadata)
+            ndv = max(ndv_l or 0.0, ndv_r or 0.0)
+            if ndv > 0:
+                out /= ndv
+                known = True
+        if known:
+            return max(1.0, out)
         return max(l, r)
     if isinstance(node, SemiJoinNode):
         return estimate_rows(node.source, metadata) * SEMI_SELECTIVITY
