@@ -10,7 +10,7 @@ TPU slice, fragments execute as shard_map collectives over ICI; ACROSS hosts,
 this package ships serialized page frames over HTTP — the reference's
 HTTP+LZ4 data plane (operator/ExchangeClient.java) mapped onto the DCN tier,
 where XLA collectives are not available."""
-__all__ = ["ClusterQueryRunner", "WorkerServer"]
+__all__ = ["ClusterQueryRunner", "WorkerServer", "Backoff", "FaultInjector"]
 
 
 def __getattr__(name):  # lazy: `python -m presto_tpu.cluster.worker` must not
@@ -20,4 +20,10 @@ def __getattr__(name):  # lazy: `python -m presto_tpu.cluster.worker` must not
     if name == "WorkerServer":
         from .worker import WorkerServer
         return WorkerServer
+    if name == "Backoff":
+        from .retry import Backoff
+        return Backoff
+    if name == "FaultInjector":
+        from .faults import FaultInjector
+        return FaultInjector
     raise AttributeError(name)

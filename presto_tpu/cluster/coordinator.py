@@ -13,13 +13,21 @@ schedule -> pull root output). The same SubPlan the mesh runner lowers to
 collectives is here lowered to remote tasks — AddExchanges and the fragmenter
 are shared, which is the plugin-boundary discipline the reference gets from
 its SPI.
-"""
+
+Fault tolerance (retry_policy session property — see cluster/retry.py):
+under QUERY/TASK policy a retryable failure (dead worker, dropped exchange,
+transport fault) transparently re-plans and re-executes the query on the
+surviving nodes — failed nodes are excluded from the next attempt's
+placement, attempts are bounded by query_retry_attempts, and attempts are
+separated by the shared jittered Backoff. Retry observability lands in
+QueryResult.stats and the /v1/metrics counters (cluster.query_retries,
+cluster.task_retries, cluster.faults_injected, cluster.backoff_seconds)."""
 from __future__ import annotations
 
 import itertools
 import threading
 import time
-from typing import List, Optional
+from typing import Dict, List, Optional, Set
 
 from ..metadata import CatalogManager, Session
 from ..runner import LocalQueryRunner, QueryResult
@@ -28,8 +36,11 @@ from ..sql.planner.add_exchanges import add_exchanges
 from ..sql.planner.fragmenter import SubPlan, fragment_plan
 from ..sql.planner.optimizer import optimize
 from ..sql.planner.planner import LogicalPlanner
-from .discovery import DiscoveryNodeManager, HeartbeatFailureDetector
+from ..utils.metrics import METRICS
+from . import faults, retry
+from .discovery import DiscoveryNodeManager, HeartbeatFailureDetector, NodeInfo
 from .exchange_client import StreamingRemoteSource
+from .retry import Backoff
 from .scheduler import SqlQueryScheduler
 from .task import FINISHED, plan_subplan
 
@@ -42,6 +53,7 @@ class ClusterQueryRunner:
                  min_workers: int = 1,
                  worker_wait_s: float = 30.0,
                  cluster_memory_limit_bytes: Optional[int] = None):
+        faults.install_from_env()  # PRESTO_TPU_FAULTS chaos knob (no-op unset)
         self.local = LocalQueryRunner(session, catalogs)
         self.nodes = DiscoveryNodeManager()
         self.detector = HeartbeatFailureDetector(self.nodes).start()
@@ -88,16 +100,23 @@ class ClusterQueryRunner:
 
     # ------------------------------------------------------------ execution
 
-    def _wait_for_workers(self) -> List:
+    def _wait_for_workers(self, min_needed: Optional[int] = None,
+                          exclude: Optional[Set[str]] = None) -> List[NodeInfo]:
+        min_needed = self.min_workers if min_needed is None else min_needed
         deadline = time.monotonic() + self.worker_wait_s
         while True:
             nodes = self.nodes.active_nodes()
-            if len(nodes) >= self.min_workers:
+            if exclude:
+                eligible = [n for n in nodes if n.node_id not in exclude]
+                # all survivors excluded = exclusion starved placement;
+                # trying suspect nodes beats certain failure
+                nodes = eligible or nodes
+            if len(nodes) >= min_needed:
                 return sorted(nodes, key=lambda n: n.node_id)
             if time.monotonic() > deadline:
                 raise RuntimeError(
                     f"only {len(nodes)} active workers "
-                    f"(need {self.min_workers})")
+                    f"(need {min_needed})")
             time.sleep(0.1)
 
     def execute(self, sql: str, user=None) -> QueryResult:
@@ -108,19 +127,98 @@ class ClusterQueryRunner:
         if not isinstance(stmt, t.Query):
             # DDL/DML/EXPLAIN/SHOW run on the coordinator's local engine
             return self.local.execute(sql, user=user)
+        session = self.local.session
+        spec = session.get("fault_injection")
+        # session-spec injectors are scoped to THIS query: a process-global
+        # leak would keep injecting chaos into every later query. A
+        # programmatically installed injector (tests) or the env-var one
+        # (worker processes) always wins and is left alone.
+        installed_here = False
+        if spec and faults.active() is None:
+            faults.install(faults.FaultInjector.from_spec(
+                str(spec), seed=int(session.get("fault_seed") or 0)))
+            installed_here = True
+        try:
+            return self._execute_query(sql, session)
+        finally:
+            if installed_here:
+                faults.clear()
+
+    def _execute_query(self, sql: str, session: Session) -> QueryResult:
+        def prop(name, default):
+            # Session.DEFAULTS (metadata.py) is the canonical source; the
+            # fallback here only guards a property explicitly set to None.
+            # 0 is a valid value for every retry knob
+            value = session.get(name)
+            return default if value is None else value
+
+        policy = retry.retry_policy(session)
+        max_retries = int(prop("query_retry_attempts", 2)) \
+            if policy != retry.NONE else 0
+        backoff = Backoff(
+            max_failure_interval_s=float("inf"),
+            initial_delay_s=float(prop("retry_initial_delay_s", 0.1)),
+            max_delay_s=float(prop("retry_max_delay_s", 2.0)))
+        excluded: Set[str] = set()
+        injector = faults.active()
+        faults_before = injector.total_fired if injector else 0
+        stats = {"retry_policy": policy, "query_attempts": 0,
+                 "task_attempts": 0, "task_retries": 0,
+                 "faults_injected": 0, "backoff_s": 0.0}
+        while True:
+            stats["query_attempts"] += 1
+            try:
+                result = self._execute_attempt(
+                    sql, policy, excluded, stats,
+                    first_attempt=stats["query_attempts"] == 1)
+                break
+            except BaseException as e:  # noqa: BLE001 — classified below
+                retryable = retry.is_retryable(e)
+                # exclude on NODE-level evidence (death, rejected creates) —
+                # a TaskFailedError's node is usually just where a dead
+                # peer's stream failure SURFACED, not the culprit
+                if isinstance(e, retry.ClusterExecutionError) and e.node_id \
+                        and not isinstance(e, retry.TaskFailedError):
+                    excluded.add(e.node_id)
+                if not retryable \
+                        or stats["query_attempts"] > max_retries:
+                    raise
+                METRICS.count("cluster.query_retries")
+                backoff.failure()
+                backoff.wait()
+        stats["backoff_s"] = round(
+            stats["backoff_s"] + backoff.total_backoff_s, 3)
+        stats["faults_injected"] = (injector.total_fired - faults_before) \
+            if injector else 0
+        METRICS.count("cluster.backoff_seconds", stats["backoff_s"])
+        result.stats = stats
+        return result
+
+    def _execute_attempt(self, sql: str, policy: str, excluded: Set[str],
+                         stats: dict, first_attempt: bool) -> QueryResult:
+        """One full plan->schedule->pull attempt. Re-planning per attempt is
+        deliberate: the surviving node count changes the exchange layout."""
+        # a retry only needs SOME healthy workers, not the original quorum
+        nodes = self._wait_for_workers(
+            min_needed=self.min_workers if first_attempt else 1,
+            exclude=excluded)
         sub = self.plan_sql(sql)
-        nodes = self._wait_for_workers()
         query_id = f"cq{next(self._ids)}_{int(time.time())}"
         scheduler = SqlQueryScheduler(query_id, sub, nodes,
-                                      self.local.session)
+                                      self.local.session,
+                                      retry_policy=policy,
+                                      excluded_nodes=excluded)
         self._schedulers[query_id] = scheduler
-        scheduler.schedule()
         try:
+            scheduler.schedule()
             return self._pull_results(scheduler, sub)
         except BaseException:
             scheduler.abort()
             raise
         finally:
+            stats["task_attempts"] += scheduler.task_attempts
+            stats["task_retries"] += scheduler.task_retries
+            stats["backoff_s"] += scheduler.backoff_s
             self._schedulers.pop(query_id, None)
             # free finished tasks' buffers/state on the workers
             for task in scheduler.all_tasks():
@@ -146,11 +244,16 @@ class ClusterQueryRunner:
         done = threading.Event()
         error: List[BaseException] = []
 
+        from .exchange_client import _MAX_ERROR_S
+        budget = self.session.get("exchange_error_budget_s")
+
         def pull():
             try:
                 source = StreamingRemoteSource(
                     [root.location], 0, types, dicts,
-                    int(self.session.get("page_capacity") or (1 << 16)))
+                    int(self.session.get("page_capacity") or (1 << 16)),
+                    error_budget_s=float(
+                        _MAX_ERROR_S if budget is None else budget))
                 for page in source:
                     rows.extend(page.to_pylists())
             except BaseException as e:  # noqa: BLE001
@@ -160,10 +263,15 @@ class ClusterQueryRunner:
 
         threading.Thread(target=pull, name="result-pull", daemon=True).start()
         while not done.wait(timeout=0.5):
-            active = {n.node_id for n in self.nodes.active_nodes()}
-            scheduler.check_failures(active_node_ids=active)
+            scheduler.check_failures(active_nodes=self.nodes.active_nodes())
         if error:
-            scheduler.check_failures()  # surface a task failure if one caused it
+            # surface the task/node failure that CAUSED the stream error if
+            # there is one — it names the node, which retry placement and
+            # fail-fast diagnostics both need. Diagnosis only: this attempt
+            # is already lost, recovering a task here would be wasted work
+            # that also swallows the node id
+            scheduler.check_failures(active_nodes=self.nodes.active_nodes(),
+                                     recover=False)
             raise error[0]
         info = root.poll_info()
         if info is not None and info.state != FINISHED:

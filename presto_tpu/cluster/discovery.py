@@ -104,16 +104,31 @@ class HeartbeatFailureDetector:
 
 
 class Announcer:
-    """Worker-side: periodically announce this node to the coordinator."""
+    """Worker-side: periodically announce this node to the coordinator.
+
+    Failure tracking rides the shared cluster/retry.Backoff (the announce
+    CADENCE stays period-driven — the announce loop never sleeps extra, a
+    worker must reappear the moment the coordinator does)."""
 
     def __init__(self, coordinator_uri: str, node_id: str, uri: str):
+        from .retry import Backoff
+
         self.coordinator_uri = coordinator_uri.rstrip("/")
         self.node_id = node_id
         self.uri = uri
+        # infinite budget: announcing retries forever, the Backoff only
+        # counts the failure streak for the persistent-failure warnings
+        self._backoff = Backoff(max_failure_interval_s=float("inf"),
+                                min_tries=1)
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._loop,
                                         name=f"announcer-{node_id}",
                                         daemon=True)
+
+    @property
+    def _announce_failures(self) -> int:
+        """Current failure streak — single source: the shared Backoff."""
+        return self._backoff.failure_count
 
     def start(self) -> "Announcer":
         self._announce_once()   # synchronous first announce: the node is
@@ -130,15 +145,17 @@ class Announcer:
             f"{self.coordinator_uri}/v1/announcement", data=body,
             method="POST", headers={"Content-Type": "application/json"})
         try:
+            from . import faults
+            faults.fire("client.announce", node_id=self.node_id)
             urllib.request.urlopen(req, timeout=5.0).read()
-            self._announce_failures = 0
+            self._backoff.success()
         except Exception as e:
             # coordinator may not be up yet (retried next period) — but a
             # PERSISTENT failure must be loud: a 401 here means the
             # coordinator requires authentication the worker cannot supply
             # and the node would silently never join the cluster
-            n = getattr(self, "_announce_failures", 0) + 1
-            self._announce_failures = n
+            self._backoff.failure()
+            n = self._backoff.failure_count
             if n in (3, 20) or n % 100 == 0:
                 import sys
                 print(f"presto_tpu worker {self.node_id}: announcement to "

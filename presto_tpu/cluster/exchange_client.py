@@ -4,13 +4,19 @@ Analogue of operator/ExchangeClient.java:145 + HttpPageBufferClient.java:88,301
 (/root/reference/presto-main): for each upstream task location, GET
 {location}/results/{buffer_id}/{token} long-polls one frame at a time; the next
 request's token acknowledges everything before it. Transient HTTP errors back
-off and retry (server/remotetask/Backoff.java); a hard error or an upstream
-task failure fails the consumer."""
+off and retry under the shared cluster/retry.Backoff budget
+(server/remotetask/Backoff.java); a hard error or an upstream task failure
+fails the consumer.
+
+Fault tolerance: a client whose stream is still virgin (token 0, nothing
+consumed) can be REWIRED to a replacement producer location when the
+scheduler recovers a failed leaf task (POST /v1/task/{id}/sources ->
+SqlTask.update_sources -> reset_location here); once any frame has been
+consumed a rewire is rejected and the failure escalates to a query retry."""
 from __future__ import annotations
 
 import json
 import threading
-import time
 import urllib.error
 import urllib.request
 from typing import Iterator, List, Optional, Sequence
@@ -20,9 +26,13 @@ import numpy as np
 from ..block import Dictionary, Page
 from ..spi.connector import ConnectorPageSource
 from ..types import Type
+from . import faults
+from .retry import Backoff
 from .serde import deserialize_pages
 
-# transient-failure budget before a location is declared dead
+# default transient-failure budget before a location is declared dead
+# (the exchange_error_budget_s session default in metadata.py matches; use
+# this constant as the fallback wherever that property might be None)
 _MAX_ERROR_S = 60.0
 
 
@@ -39,61 +49,105 @@ def http_json(method: str, url: str, body: Optional[bytes] = None,
 class PageBufferClient:
     """One upstream location's pull loop state."""
 
-    def __init__(self, location: str, buffer_id: int):
+    def __init__(self, location: str, buffer_id: int,
+                 error_budget_s: float = _MAX_ERROR_S):
         self.location = location.rstrip("/")
         self.buffer_id = buffer_id
         self.token = 0
         self.complete = False
-        self._error_since: Optional[float] = None
+        self.done = False  # complete AND final ack sent
+        self._backoff = Backoff(max_failure_interval_s=error_budget_s,
+                                initial_delay_s=0.05, max_delay_s=1.0)
         self._instance_id: Optional[str] = None
+        # guards token/complete/location/epoch against the rewire path: a
+        # reset bumps the epoch, and a poll that was in flight against the
+        # OLD location commits nothing (its frame is dropped) — without
+        # this, a rewire accepted mid-poll could double-consume frame 0
+        self._lock = threading.Lock()
+        self._epoch = 0
 
     def poll(self, timeout_s: float = 10.0) -> Optional[bytes]:
         """One GET; returns a frame or None (no data yet / now complete)."""
-        url = (f"{self.location}/results/{self.buffer_id}/{self.token}"
-               f"?wait={timeout_s:.1f}")
+        with self._lock:
+            epoch = self._epoch
+            location = self.location
+            url = (f"{location}/results/{self.buffer_id}/{self.token}"
+                   f"?wait={timeout_s:.1f}")
         req = urllib.request.Request(url, method="GET")
         try:
+            faults.fire("client.results", location=location)
             with urllib.request.urlopen(req, timeout=timeout_s + 15.0) as resp:
                 nxt = int(resp.headers.get("X-Next-Token", self.token))
                 complete = resp.headers.get("X-Complete") == "true"
                 instance = resp.headers.get("X-Task-Instance-Id")
                 frame = resp.read()
+        except urllib.error.HTTPError as e:
+            if e.code == 404 or e.code >= 500:
+                # 404: producer task not created yet (all-at-once scheduling
+                # may reach the consumer first); 5xx: a server-side blip or
+                # a failed buffer mid-recovery — both transient within the
+                # budget (HttpPageBufferClient treats any non-OK response as
+                # a retryable failure). Keep the body: if the budget
+                # exhausts, the LAST server diagnostic must survive into
+                # the error instead of a bare 'unreachable'
+                detail = e.read()[:300].decode(errors="replace")
+                return self._transient(RuntimeError(
+                    f"HTTP {e.code}: {detail}" if detail else str(e)))
+            raise RuntimeError(
+                f"exchange source {location} failed: {e} "
+                f"{e.read()[:500].decode(errors='replace')}") from e
+        except (urllib.error.URLError, OSError, TimeoutError) as e:
+            return self._transient(e)
+        with self._lock:
+            if self._epoch != epoch:
+                return None  # rewired mid-flight: drop the stale frame
             if instance:
                 if self._instance_id is None:
                     self._instance_id = instance
                 elif self._instance_id != instance:
-                    # the producer task was RECREATED: its tokens restarted at
-                    # 0, so our token would silently skip/duplicate frames —
-                    # fail the query loudly (reference: PRESTO_TASK_INSTANCE_ID
-                    # mismatch aborts the page client)
+                    # the producer task was RECREATED behind our back: its
+                    # tokens restarted at 0, so our token would silently
+                    # skip/duplicate frames — fail the query loudly
+                    # (reference: PRESTO_TASK_INSTANCE_ID mismatch aborts
+                    # the page client). A scheduler-driven rewire instead
+                    # goes through reset_location, which bumps the epoch
+                    # and clears the pinned instance id first.
                     raise RuntimeError(
-                        f"exchange source {self.location} was recreated "
+                        f"exchange source {location} was recreated "
                         f"(instance {self._instance_id} -> {instance}); "
                         f"stream tokens are no longer valid")
-        except urllib.error.HTTPError as e:
-            if e.code == 404:
-                # producer task not created yet (all-at-once scheduling may
-                # reach the consumer first) — transient within the budget
-                return self._transient(e)
-            raise RuntimeError(
-                f"exchange source {self.location} failed: {e} "
-                f"{e.read()[:500].decode(errors='replace')}") from e
-        except (urllib.error.URLError, OSError, TimeoutError) as e:
-            return self._transient(e)
-        self._error_since = None
-        self.token = nxt
-        self.complete = complete
+            self._backoff.success()
+            self.token = nxt
+            self.complete = complete
         return frame if frame else None
 
     def _transient(self, e: Exception) -> None:
-        now = time.monotonic()
-        if self._error_since is None:
-            self._error_since = now
-        if now - self._error_since > _MAX_ERROR_S:
+        if self._backoff.failure():
             raise RuntimeError(
-                f"exchange source {self.location} unreachable: {e}") from e
-        time.sleep(0.2)
+                f"exchange source {self.location} unreachable after "
+                f"{self._backoff.failure_count} tries over "
+                f"{self._backoff.time_since_first_failure_s():.1f}s: {e}"
+            ) from e
+        self._backoff.wait()
         return None
+
+    def can_reset(self) -> bool:
+        with self._lock:
+            return not (self.token > 0 or self.complete or self.done)
+
+    def reset_location(self, new_location: str) -> bool:
+        """Point this client at a replacement producer. Sound only while the
+        stream is virgin: any consumed frame would be silently re-produced
+        by the replacement (which restarts at token 0). Bumps the epoch so
+        an in-flight poll against the old location cannot commit."""
+        with self._lock:
+            if self.token > 0 or self.complete or self.done:
+                return False
+            self.location = new_location.rstrip("/")
+            self._instance_id = None
+            self._epoch += 1
+            self._backoff.success()
+            return True
 
     def finished_ack(self) -> None:
         """Final ack freeing the server-side buffer (abort endpoint)."""
@@ -103,6 +157,7 @@ class PageBufferClient:
             urllib.request.urlopen(req, timeout=5.0).read()
         except Exception:
             pass  # buffer cleanup is best-effort; task teardown also frees it
+        self.done = True
 
 
 class StreamingRemoteSource(ConnectorPageSource):
@@ -115,23 +170,56 @@ class StreamingRemoteSource(ConnectorPageSource):
                  types: Sequence[Type],
                  dicts: Sequence[Optional[Dictionary]],
                  page_capacity: int,
-                 cancelled: Optional[threading.Event] = None):
-        self.clients = [PageBufferClient(loc, buffer_id) for loc in locations]
+                 cancelled: Optional[threading.Event] = None,
+                 error_budget_s: float = _MAX_ERROR_S):
+        self._lock = threading.Lock()
+        self.clients = [PageBufferClient(loc, buffer_id,
+                                         error_budget_s=error_budget_s)
+                        for loc in locations]
         self.types = list(types)
         self.dicts = list(dicts)
         self.page_capacity = page_capacity
         self.cancelled = cancelled
 
+    def can_reset_location(self, old_location: str) -> bool:
+        """Would a rewire of `old_location` be sound right now? (the check
+        half of SqlTask.update_sources' check-then-apply)"""
+        old = old_location.rstrip("/")
+        with self._lock:
+            for client in self.clients:
+                if client.location == old:
+                    return client.can_reset()
+        return False
+
+    def reset_location(self, old_location: str, new_location: str) -> bool:
+        """Rewire the client pulling `old_location` to a replacement
+        producer; False when no virgin client matches (already consumed —
+        the caller escalates to a query-level retry)."""
+        old = old_location.rstrip("/")
+        with self._lock:
+            for client in self.clients:
+                if client.location == old:
+                    return client.reset_location(new_location)
+        return False
+
     def __iter__(self) -> Iterator[Page]:
-        pending = list(self.clients)
-        while pending:
+        # bounded idle wait replacing the old 10ms busy-spin: a stalled
+        # producer backs the consumer off exponentially (capped), any
+        # progress heals the streak
+        idle = Backoff(max_failure_interval_s=float("inf"),
+                       initial_delay_s=0.005, max_delay_s=0.1, min_tries=1)
+        while True:
+            with self._lock:
+                live = [c for c in self.clients if not c.done]
+            if not live:
+                return
             if self.cancelled is not None and self.cancelled.is_set():
                 raise RuntimeError("task cancelled while reading exchange")
             progressed = False
-            for c in list(pending):
+            for c in live:
                 # short poll while multiple sources are live so one slow
                 # producer cannot starve the others; the tail drains long-polled
-                frame = c.poll(timeout_s=0.2 if len(pending) > 1 else 10.0)
+                frame = c.poll(timeout_s=0.2 if len(live) > 1 else 10.0)
                 if frame:
                     progressed = True
                     for page in deserialize_pages(frame, self.types, self.dicts,
@@ -139,12 +227,16 @@ class StreamingRemoteSource(ConnectorPageSource):
                         yield page
                 if c.complete:
                     c.finished_ack()
-                    pending.remove(c)
-            if not progressed and pending:
-                time.sleep(0.01)
+            if progressed:
+                idle.success()
+            else:
+                idle.failure()
+                idle.wait()
 
     def close(self) -> None:
-        for c in self.clients:
+        with self._lock:
+            clients = list(self.clients)
+        for c in clients:
             if not c.complete:
                 c.finished_ack()
 
@@ -165,7 +257,8 @@ class MergingRemoteSource(ConnectorPageSource):
                  dicts: Sequence[Optional[Dictionary]],
                  page_capacity: int,
                  orderings: Sequence[tuple],
-                 cancelled: Optional[threading.Event] = None):
+                 cancelled: Optional[threading.Event] = None,
+                 error_budget_s: float = _MAX_ERROR_S):
         self.locations = list(locations)
         self.buffer_id = buffer_id
         self.types = list(types)
@@ -173,7 +266,30 @@ class MergingRemoteSource(ConnectorPageSource):
         self.page_capacity = page_capacity
         self.orderings = list(orderings)
         self.cancelled = cancelled
+        self.error_budget_s = error_budget_s
+        self._lock = threading.Lock()
+        self._started = False
         self._inner: List[StreamingRemoteSource] = []
+
+    def can_reset_location(self, old_location: str) -> bool:
+        old = old_location.rstrip("/")
+        with self._lock:
+            return not self._started and \
+                any(loc.rstrip("/") == old for loc in self.locations)
+
+    def reset_location(self, old_location: str, new_location: str) -> bool:
+        """Rewire is sound only before the merge started consuming (the heap
+        interleaves rows from every stream, so no per-stream virginity check
+        helps once iteration began)."""
+        old = old_location.rstrip("/")
+        with self._lock:
+            if self._started:
+                return False
+            for i, loc in enumerate(self.locations):
+                if loc.rstrip("/") == old:
+                    self.locations[i] = new_location
+                    return True
+        return False
 
     def _row_iter(self, location: str):
         """-> (sort key, row values tuple, row nulls tuple) per live row."""
@@ -187,7 +303,8 @@ class MergingRemoteSource(ConnectorPageSource):
                 ranks[ch] = np.asarray(d.sort_keys())
         src = StreamingRemoteSource([location], self.buffer_id, self.types,
                                     self.dicts, self.page_capacity,
-                                    cancelled=self.cancelled)
+                                    cancelled=self.cancelled,
+                                    error_budget_s=self.error_budget_s)
         self._inner.append(src)
         for page in src:
             mask = np.asarray(page.mask)
@@ -216,7 +333,10 @@ class MergingRemoteSource(ConnectorPageSource):
 
         from ..block import Block, Page as _Page
 
-        merged = heapq.merge(*(self._row_iter(loc) for loc in self.locations),
+        with self._lock:
+            self._started = True  # rewire window closes here
+            locations = list(self.locations)
+        merged = heapq.merge(*(self._row_iter(loc) for loc in locations),
                              key=lambda t: t[0])
         ncols = len(self.types)
         buf_vals: List[list] = [[] for _ in range(ncols)]

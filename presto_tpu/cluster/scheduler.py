@@ -9,43 +9,71 @@ Analogues (/root/reference/presto-main):
     create every stage's tasks (all-at-once policy: data streams between
     stages, so all tasks start together) and monitor them to completion
   - server/remotetask/Backoff.java — transient-failure retry budget
+    (cluster/retry.Backoff here, shared by every retry loop on this tier)
+
+Fault tolerance (retry_policy session property, cluster/retry.py):
+  - every RemoteTask.create retries transient failures under one shared
+    Backoff budget; 4xx rejections stay deterministic hard errors
+  - TASK policy re-places a task whose create exhausted its budget onto
+    another healthy node (consumers are created after producers, so their
+    input_locations simply use the new location), and recovers failed LEAF
+    tasks in place mid-query: replacement on a healthy node under a new
+    attempt id, consumers' PageBufferClient streams rewired through
+    POST /v1/task/{id}/sources (rejected — escalating to a query retry —
+    if any consumer already consumed from the dead task, because upstream
+    buffers free acked frames; see retry.py's taxonomy)
+  - check_failures raises NodeDiedError/TaskFailedError with the node id
+    so the coordinator can exclude failed nodes from the next attempt
 """
 from __future__ import annotations
 
 import dataclasses
-import time
 import urllib.error
 import urllib.request
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Set
 
 from ..metadata import Session
 from ..sql.planner.fragmenter import Fragment, SINGLE_PART, SubPlan
 from ..sql.planner.plan import RemoteSourceNode
-from . import codec
+from ..utils.metrics import METRICS
+from . import codec, faults, retry
 from .discovery import NodeInfo
-from .task import (DONE_STATES, FAILED, FINISHED, TaskInfo,
-                   TaskUpdateRequest)
+from .retry import Backoff, NodeDiedError, TaskFailedError
+from .task import (DONE_STATES, FAILED, FINISHED, SourceUpdateRequest,
+                   TaskInfo, TaskUpdateRequest)
 
 
 class RemoteTask:
     """Coordinator proxy for one worker task (HttpRemoteTask analogue)."""
 
-    def __init__(self, task_id: str, node: NodeInfo):
+    def __init__(self, task_id: str, node: NodeInfo, attempt: int = 0):
         self.task_id = task_id
         self.node = node
+        self.attempt = attempt
         self.location = f"{node.uri}/v1/task/{task_id}"
         self.info: Optional[TaskInfo] = None
+        self.request: Optional[TaskUpdateRequest] = None
 
-    def create(self, request: TaskUpdateRequest, retries: int = 3) -> TaskInfo:
+    def create(self, request: TaskUpdateRequest,
+               backoff: Optional[Backoff] = None) -> TaskInfo:
+        """POST the task; transient failures (5xx, connection errors) retry
+        under the shared Backoff budget, 4xx rejections are deterministic
+        hard errors."""
+        self.request = request
         body = codec.dumps(request)
+        backoff = backoff or Backoff(max_failure_interval_s=10.0,
+                                     initial_delay_s=0.1, max_delay_s=1.0)
         last: Optional[Exception] = None
-        for attempt in range(retries):
+        while True:
             req = urllib.request.Request(
                 self.location, data=body, method="POST",
                 headers={"Content-Type": "application/json"})
             try:
+                faults.fire("client.task_create", node_id=self.node.node_id,
+                            task_id=self.task_id)
                 with urllib.request.urlopen(req, timeout=30.0) as resp:
                     self.info = codec.loads(resp.read())
+                    backoff.success()
                     return self.info
             except urllib.error.HTTPError as e:
                 # 4xx = the worker REJECTED the request (bad body / conflicting
@@ -57,21 +85,39 @@ class RemoteTask:
                         f"worker {self.node.node_id} rejected task "
                         f"{self.task_id} ({e.code}): {detail}") from None
                 last = RuntimeError(f"HTTP {e.code}: {detail}")
-                time.sleep(0.2 * (attempt + 1))
             except (urllib.error.URLError, OSError) as e:
                 last = e
-                time.sleep(0.2 * (attempt + 1))
-        raise RuntimeError(
-            f"cannot create task {self.task_id} on {self.node.node_id}: {last}")
+            if backoff.failure():
+                raise retry.ClusterExecutionError(
+                    f"cannot create task {self.task_id} on "
+                    f"{self.node.node_id} after {backoff.failure_count} "
+                    f"tries: {last}", node_id=self.node.node_id,
+                    retryable=True)
+            backoff.wait()
 
     def poll_info(self) -> Optional[TaskInfo]:
         req = urllib.request.Request(self.location, method="GET")
         try:
+            faults.fire("client.task_poll", node_id=self.node.node_id,
+                        task_id=self.task_id)
             with urllib.request.urlopen(req, timeout=10.0) as resp:
                 self.info = codec.loads(resp.read())
                 return self.info
         except (urllib.error.URLError, OSError):
             return None  # judged by the failure detector, not one lost poll
+
+    def update_sources(self, update: "SourceUpdateRequest") -> bool:
+        """POST /sources: rewire one of this task's exchange inputs to a
+        replacement producer. False = the worker rejected the rewire (data
+        already consumed from the old location — caller must escalate)."""
+        req = urllib.request.Request(
+            self.location + "/sources", data=codec.dumps(update),
+            method="POST", headers={"Content-Type": "application/json"})
+        try:
+            urllib.request.urlopen(req, timeout=10.0).read()
+            return True
+        except (urllib.error.URLError, OSError):
+            return False
 
     def cancel(self, abort: bool = True) -> None:
         try:
@@ -113,13 +159,24 @@ class SqlQueryScheduler:
     AllAtOnceExecutionPolicy.java)."""
 
     def __init__(self, query_id: str, subplan: SubPlan,
-                 nodes: List[NodeInfo], session: Session):
+                 nodes: List[NodeInfo], session: Session,
+                 retry_policy: str = retry.NONE,
+                 excluded_nodes: Optional[Set[str]] = None):
         self.query_id = query_id
         self.subplan = subplan
         self.session = session
         self.selector = NodeScheduler(nodes)
+        self.retry_policy = retry_policy
+        # shared with the coordinator's query-retry loop: nodes that failed
+        # here are excluded from the NEXT attempt's placement too
+        self.excluded_nodes: Set[str] = (
+            excluded_nodes if excluded_nodes is not None else set())
         self.stages: Dict[int, StageExecution] = {}
         self._consumer_tasks = self._consumer_task_counts()
+        # observability (surfaced via QueryResult.stats + /v1/metrics)
+        self.task_attempts = 0
+        self.task_retries = 0
+        self.backoff_s = 0.0
 
     def _consumer_task_counts(self) -> Dict[int, int]:
         """fragment id -> number of tasks of its consuming fragment."""
@@ -132,6 +189,18 @@ class SqlQueryScheduler:
         counts[self.subplan.root_fragment.id] = 1  # the coordinator pulls root
         return counts
 
+    def _new_backoff(self) -> Backoff:
+        def prop(name, default):
+            # 0.0 is a valid budget: it collapses the time window so the
+            # budget exhausts after Backoff's min_tries (3) attempts
+            value = self.session.get(name)
+            return float(default if value is None else value)
+
+        return Backoff(
+            max_failure_interval_s=prop("remote_task_error_budget_s", 10.0),
+            initial_delay_s=prop("retry_initial_delay_s", 0.1),
+            max_delay_s=prop("retry_max_delay_s", 2.0))
+
     def schedule(self) -> None:
         task_counts = {
             f.id: (1 if f.partitioning == SINGLE_PART
@@ -139,23 +208,89 @@ class SqlQueryScheduler:
             for f in self.subplan.fragments}
         for frag in self.subplan.fragments:  # bottom-up order from fragmenter
             nodes = self.selector.select(frag)
-            tasks = [RemoteTask(f"{self.query_id}.{frag.id}.{i}", node)
-                     for i, node in enumerate(nodes)]
             input_locations = {
                 fid: [t.location for t in self.stages[fid].tasks]
                 for fid in _remote_source_ids(frag.root)}
-            for i, task in enumerate(tasks):
-                task.create(TaskUpdateRequest(
-                    task_id=task.task_id,
-                    query_id=self.query_id,
-                    subplan=self.subplan,
-                    fragment_id=frag.id,
-                    worker_index=i,
-                    task_counts=task_counts,
-                    input_locations=input_locations,
-                    session=self.session,
-                    output_buffers=self._consumer_tasks[frag.id]))
+            tasks: List[RemoteTask] = []
+            try:
+                for i, node in enumerate(nodes):
+                    tasks.append(self._create_task(frag, i, node, task_counts,
+                                                   input_locations))
+            except BaseException:
+                # a half-created stage is not in self.stages, so abort() and
+                # the coordinator's cleanup would never see these tasks —
+                # cancel them here or they leak on the workers per attempt
+                for task in tasks:
+                    task.cancel(abort=True)
+                raise
             self.stages[frag.id] = StageExecution(frag, tasks)
+
+    def _build_request(self, task_id: str, frag: Fragment, index: int,
+                      task_counts: Dict[int, int],
+                      input_locations: Dict[int, List[str]]
+                      ) -> TaskUpdateRequest:
+        return TaskUpdateRequest(
+            task_id=task_id,
+            query_id=self.query_id,
+            subplan=self.subplan,
+            fragment_id=frag.id,
+            worker_index=index,
+            task_counts=task_counts,
+            input_locations=input_locations,
+            session=self.session,
+            output_buffers=self._consumer_tasks[frag.id])
+
+    def _create_task(self, frag: Fragment, index: int, node: NodeInfo,
+                     task_counts: Dict[int, int],
+                     input_locations: Dict[int, List[str]]) -> RemoteTask:
+        """Create one task; under TASK policy a node whose create budget is
+        exhausted is excluded and the task is re-placed on the next healthy
+        node under a new attempt id."""
+        base_id = f"{self.query_id}.{frag.id}.{index}"
+        attempt = 0
+        tried: Set[str] = set()
+        while True:
+            if self.retry_policy == retry.TASK \
+                    and node.node_id in self.excluded_nodes:
+                # a node already proven bad this query would burn a full
+                # create budget per fragment before re-placing; skip it up
+                # front (if every node is excluded, try anyway — the
+                # starvation fallback)
+                alternative = self._pick_node(
+                    exclude=tried | {node.node_id})
+                if alternative is not None:
+                    node = alternative
+            task_id = base_id if attempt == 0 else f"{base_id}.r{attempt}"
+            task = RemoteTask(task_id, node, attempt=attempt)
+            self.task_attempts += 1
+            backoff = self._new_backoff()
+            try:
+                task.create(
+                    self._build_request(task_id, frag, index, task_counts,
+                                        input_locations),
+                    backoff=backoff)
+                return task
+            except retry.ClusterExecutionError:
+                tried.add(node.node_id)
+                if self.retry_policy != retry.TASK:
+                    raise
+                self.excluded_nodes.add(node.node_id)
+                replacement = self._pick_node(exclude=tried)
+                if replacement is None:
+                    raise
+                METRICS.count("cluster.task_retries")
+                self.task_retries += 1
+                node = replacement
+                attempt += 1
+            finally:
+                self.backoff_s += backoff.total_backoff_s
+
+    def _pick_node(self, exclude: Set[str]) -> Optional[NodeInfo]:
+        for node in self.selector.nodes:
+            if node.node_id not in exclude \
+                    and node.node_id not in self.excluded_nodes:
+                return node
+        return None
 
     # ------------------------------------------------------------ monitoring
 
@@ -165,24 +300,113 @@ class SqlQueryScheduler:
     def all_tasks(self) -> List[RemoteTask]:
         return [t for s in self.stages.values() for t in s.tasks]
 
-    def check_failures(self, active_node_ids: Optional[set] = None) -> None:
-        """Poll task infos; raise on any FAILED task or dead node (queries with
-        tasks on failed nodes fail — the reference has no intra-query retry
-        either, SURVEY §5)."""
-        for task in self.all_tasks():
-            info = task.poll_info()
-            if info is not None and info.state == FAILED:
-                err = info.error or {}
-                raise RuntimeError(
-                    f"task {task.task_id} failed on {task.node.node_id}: "
-                    f"{err.get('message')}\n{err.get('stack', '')[-800:]}")
-            if active_node_ids is not None \
-                    and task.node.node_id not in active_node_ids \
-                    and (info is None or info.state not in DONE_STATES):
-                raise RuntimeError(
-                    f"worker {task.node.node_id} died with task "
-                    f"{task.task_id} in state "
-                    f"{info.state if info else 'UNREACHABLE'}")
+    def check_failures(self,
+                       active_nodes: Optional[List[NodeInfo]] = None,
+                       recover: bool = True) -> None:
+        """Poll task infos; raise on any FAILED task or dead node. Under TASK
+        policy, first try in-place recovery of the sound subset (leaf
+        fragments nobody consumed from yet); everything else raises a typed
+        error the coordinator's query-retry loop classifies. Pass
+        ``recover=False`` on diagnosis-only calls (an attempt already known
+        lost): recovery there would build a replacement task just to throw
+        it away, and a successful recovery would swallow the typed error
+        whose node id the retry loop needs for placement exclusion."""
+        active_ids = ({n.node_id for n in active_nodes}
+                      if active_nodes is not None else None)
+        pending: List[retry.ClusterExecutionError] = []
+        for stage in self.stages.values():
+            for idx, task in enumerate(stage.tasks):
+                info = task.poll_info()
+                failure: Optional[retry.ClusterExecutionError] = None
+                if info is not None and info.state == FAILED:
+                    err = info.error or {}
+                    failure = TaskFailedError(
+                        f"task {task.task_id} failed on {task.node.node_id}: "
+                        f"{err.get('message')}\n{err.get('stack', '')[-800:]}",
+                        node_id=task.node.node_id,
+                        retryable=retry.error_dict_retryable(err))
+                elif active_ids is not None \
+                        and task.node.node_id not in active_ids \
+                        and (info is None or info.state not in DONE_STATES):
+                    failure = NodeDiedError(
+                        f"worker {task.node.node_id} died with task "
+                        f"{task.task_id} in state "
+                        f"{info.state if info else 'UNREACHABLE'}",
+                        node_id=task.node.node_id)
+                if failure is None:
+                    continue
+                if recover and self.retry_policy == retry.TASK \
+                        and failure.retryable and active_nodes \
+                        and self._recover_task(stage, idx, active_nodes):
+                    continue
+                pending.append(failure)
+        if pending:
+            # a dead NODE is the root cause; a FAILED task on a healthy node
+            # is often just a consumer of the dead node's stream — raise the
+            # node death first so retry placement excludes the right node
+            for failure in pending:
+                if isinstance(failure, NodeDiedError):
+                    raise failure
+            raise pending[0]
+
+    def _recover_task(self, stage: StageExecution, idx: int,
+                      active_nodes: List[NodeInfo]) -> bool:
+        """In-place recovery of one failed task. Sound only when the task's
+        fragment re-derives its input from scratch (a LEAF — no remote
+        sources, whose upstream is a re-scannable connector, and not the
+        root the coordinator is consuming) and no consumer has pulled any
+        of its output yet (their PageBufferClient tokens are still 0 — the
+        rewire endpoint verifies and rejects otherwise)."""
+        frag = stage.fragment
+        old = stage.tasks[idx]
+        if frag is self.subplan.root_fragment:
+            return False
+        if _remote_source_ids(frag.root):
+            return False  # mid-stage: upstream buffers freed acked frames
+        budget = self.session.get("task_retry_attempts")
+        if old.attempt >= int(2 if budget is None else budget):
+            # a task that keeps dying with virgin streams would otherwise be
+            # recovered forever (recovery resets nothing the failure reads);
+            # escalate to the BOUNDED query-level retry instead
+            return False
+        candidates = [n for n in active_nodes
+                      if n.node_id != old.node.node_id
+                      and n.node_id not in self.excluded_nodes] \
+            or [n for n in active_nodes if n.node_id != old.node.node_id]
+        if not candidates:
+            return False
+        node = candidates[0]
+        attempt = old.attempt + 1
+        base_id = f"{self.query_id}.{frag.id}.{old.request.worker_index}"
+        new_task = RemoteTask(f"{base_id}.r{attempt}", node, attempt=attempt)
+        self.task_attempts += 1
+        backoff = self._new_backoff()
+        try:
+            new_task.create(
+                dataclasses.replace(old.request, task_id=new_task.task_id),
+                backoff=backoff)
+        except (retry.ClusterExecutionError, RuntimeError):
+            return False
+        finally:
+            self.backoff_s += backoff.total_backoff_s
+        # rewire every live consumer's exchange input to the replacement;
+        # any rejection (already-consumed stream) is an unsound rewire —
+        # abort the replacement and escalate
+        for consumer_stage in self.stages.values():
+            if frag.id not in _remote_source_ids(consumer_stage.fragment.root):
+                continue
+            update = SourceUpdateRequest(
+                fragment_id=frag.id, old_location=old.location,
+                new_location=new_task.location)
+            for consumer in consumer_stage.tasks:
+                if not consumer.update_sources(update):
+                    new_task.cancel(abort=True)
+                    return False
+        old.cancel(abort=True)
+        stage.tasks[idx] = new_task
+        METRICS.count("cluster.task_retries")
+        self.task_retries += 1
+        return True
 
     def is_finished(self) -> bool:
         info = self.root_task().info

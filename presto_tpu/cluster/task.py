@@ -34,7 +34,7 @@ from ..ops.operator import Operator, OperatorContext, OperatorFactory
 from ..sql.planner.fragmenter import SINGLE_PART, SubPlan
 from ..sql.planner.plan import BROADCAST, GATHER, OutputNode, REPARTITION
 from ..types import Type
-from . import buffers
+from . import buffers, faults
 from .exchange_client import StreamingRemoteSource
 from .serde import pages_to_columns, serialize_columns
 
@@ -96,6 +96,17 @@ class TaskInfo:
     error: Optional[dict] = None
     rows_out: int = 0
     instance_id: str = ""
+
+
+@codec.register
+@dataclasses.dataclass
+class SourceUpdateRequest:
+    """POST /v1/task/{taskId}/sources body: rewire one exchange input from a
+    failed producer to its replacement (task-level retry). The worker accepts
+    only while the affected stream is virgin — nothing consumed yet."""
+    fragment_id: int
+    old_location: str
+    new_location: str
 
 
 def plan_subplan(subplan: SubPlan, metadata: MetadataManager, session: Session,
@@ -299,6 +310,13 @@ class SqlTask:
         self.output_types: List[Type] = []
         self.output_dicts: List[Optional[Dictionary]] = []
         self._sink: Optional[TaskOutputFactory] = None
+        # exchange inputs are rewireable for task-level retry: the scheduler
+        # may replace a failed producer's location (update_sources); sources
+        # not yet constructed pick up the current list, live ones are reset
+        self._src_lock = threading.Lock()
+        self._input_locations: Dict[int, List[str]] = {
+            fid: list(locs) for fid, locs in request.input_locations.items()}
+        self._live_sources: Dict[int, List[object]] = {}
         kind = self._output_kind()
         self.output = buffers.OutputBuffer(
             buffers.BROADCAST if kind == BROADCAST else
@@ -326,6 +344,8 @@ class SqlTask:
     def _run(self) -> None:
         try:
             self.state = RUNNING
+            faults.fire("worker.task_run", task_id=self.task_id,
+                        query_id=self.request.query_id)
             drivers = self._plan_drivers()
             if self.cancelled.is_set():
                 raise RuntimeError("task cancelled")
@@ -355,24 +375,32 @@ class SqlTask:
         from ..metadata import default_page_capacity
         page_cap = int(req.session.get("page_capacity")
                        or default_page_capacity())
+        from .exchange_client import _MAX_ERROR_S
+        budget = req.session.get("exchange_error_budget_s")
+        error_budget_s = float(_MAX_ERROR_S if budget is None else budget)
         for fid, slot in own_lp.remote_slots.items():
-            locations = req.input_locations.get(fid, [])
             dicts = plans[fid][1].output_dicts
             types = [s.type for s in self._producer_outputs(fid)]
 
             merge = getattr(slot, "merge_orderings", None)
 
-            def factory(worker, _locs=locations, _t=types, _d=dicts,
-                        _m=merge):
-                if _m:
-                    from .exchange_client import MergingRemoteSource
+            def factory(worker, _fid=fid, _t=types, _d=dicts, _m=merge):
+                with self._src_lock:
+                    locs = list(self._input_locations.get(_fid, []))
+                    if _m:
+                        from .exchange_client import MergingRemoteSource
 
-                    return MergingRemoteSource(
-                        _locs, req.worker_index, _t, _d, page_cap, _m,
-                        cancelled=self.cancelled)
-                return StreamingRemoteSource(
-                    _locs, req.worker_index, _t, _d, page_cap,
-                    cancelled=self.cancelled)
+                        src = MergingRemoteSource(
+                            locs, req.worker_index, _t, _d, page_cap, _m,
+                            cancelled=self.cancelled,
+                            error_budget_s=error_budget_s)
+                    else:
+                        src = StreamingRemoteSource(
+                            locs, req.worker_index, _t, _d, page_cap,
+                            cancelled=self.cancelled,
+                            error_budget_s=error_budget_s)
+                    self._live_sources.setdefault(_fid, []).append(src)
+                return src
             slot.source_factory = factory
         return own_plan.create_drivers(req.worker_index)
 
@@ -395,10 +423,46 @@ class SqlTask:
 
     # ------------------------------------------------------------------ api
 
+    def update_sources(self, update: "SourceUpdateRequest") -> bool:
+        """Rewire one exchange input to a replacement producer location.
+        True only if EVERY live source for that fragment accepted the reset
+        (virgin streams) — one consumed frame makes the rewire unsound (the
+        replacement re-produces from token 0) and the scheduler must
+        escalate to a query-level retry instead."""
+        with self._src_lock:
+            locs = self._input_locations.get(update.fragment_id)
+            if locs is None:
+                return False
+            old = update.old_location.rstrip("/")
+            if not any(loc.rstrip("/") == old for loc in locs):
+                return False
+            live = self._live_sources.get(update.fragment_id, [])
+            # check-then-apply so a rejection mutates nothing (a concurrent
+            # first-frame commit between the phases can still fail the
+            # apply — that residual partial rewire is torn down by the
+            # query-level retry the caller escalates to)
+            if not all(src.can_reset_location(update.old_location)
+                       for src in live):
+                return False
+            for src in live:
+                if not src.reset_location(update.old_location,
+                                          update.new_location):
+                    return False
+            for i, loc in enumerate(locs):
+                if loc.rstrip("/") == old:
+                    locs[i] = update.new_location
+        return True
+
     def cancel(self, abort: bool = False) -> None:
         self.cancelled.set()
         if self.state not in DONE_STATES:
             self.state = ABORTED if abort else CANCELED
+        if abort:
+            # poison BEFORE freeing: an aborted stream must read as a
+            # failure, never as a clean end-of-stream — consumers that saw
+            # a silent `complete` here would truncate their input and
+            # report partial rows as a successful result
+            self.output.fail(f"task {self.task_id} aborted")
         self.output.destroy()
 
     def info(self) -> TaskInfo:

@@ -30,8 +30,9 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
 from ..metadata import CatalogManager, MetadataManager
-from . import codec
-from .task import DONE_STATES, TaskUpdateRequest, WorkerTaskManager
+from . import codec, faults
+from .task import (DONE_STATES, SourceUpdateRequest, TaskUpdateRequest,
+                   WorkerTaskManager)
 
 ACTIVE = "ACTIVE"
 SHUTTING_DOWN = "SHUTTING_DOWN"
@@ -71,12 +72,46 @@ class _WorkerHandler(BaseHTTPRequestHandler):
         self._send(codec.dumps(obj), status,
                    [("Content-Type", "application/json")])
 
+    def _inject(self, point: str, **ctx) -> bool:
+        """Fault-injection hook (cluster/faults.py). True = the request was
+        consumed by an injected fault and the caller must return."""
+        try:
+            faults.fire(point, node_id=self.worker.node_id, path=self.path,
+                        **ctx)
+        except faults.InjectedHTTPError as e:
+            self._send(e.body.encode(), e.code)
+            return True
+        except faults.InjectedFault:
+            # slam the connection: no status line, no body — the client sees
+            # the peer reset a real worker crash would produce
+            self.close_connection = True
+            return True
+        return False
+
     # ------------------------------------------------------------ endpoints
 
     def do_POST(self) -> None:  # noqa: N802
+        m = re.fullmatch(r"/v1/task/([^/]+)/sources", self.path)
+        if m:
+            length = int(self.headers.get("Content-Length", 0))
+            body = self.rfile.read(length)
+            task = self.worker.tasks.get(m.group(1))
+            if task is None:
+                return self._send(b"no such task", 404)
+            try:
+                update: SourceUpdateRequest = codec.loads(body)
+                assert isinstance(update, SourceUpdateRequest)
+            except Exception as e:
+                return self._send(f"bad sources body: {e}".encode(), 400)
+            if not task.update_sources(update):
+                return self._send(
+                    b"rewire rejected: stream already consumed", 409)
+            return self._send(b"", 200)
         m = re.fullmatch(r"/v1/task/([^/]+)", self.path)
         if not m:
             return self._send(b"not found", 404)
+        if self._inject("worker.task_create", task_id=m.group(1)):
+            return
         if self.worker.state == SHUTTING_DOWN:
             return self._send(b"shutting down", 503)
         length = int(self.headers.get("Content-Length", 0))
@@ -97,6 +132,8 @@ class _WorkerHandler(BaseHTTPRequestHandler):
         path, _, query = self.path.partition("?")
         m = re.fullmatch(r"/v1/task/([^/]+)/results/(\d+)/(\d+)", path)
         if m:
+            if self._inject("worker.results", task_id=m.group(1)):
+                return
             task = self.worker.tasks.get(m.group(1))
             if task is None:
                 return self._send(b"no such task", 404)
@@ -107,8 +144,13 @@ class _WorkerHandler(BaseHTTPRequestHandler):
             try:
                 frame, nxt, complete = task.output.get(
                     buffer_id, int(m.group(3)), wait_s=min(wait, 30.0))
-            except Exception as e:  # an aborted connection would look like a
-                # transient network error to PageBufferClient and retry for 60s
+            except Exception as e:
+                # failed/poisoned buffer -> 500: consumers treat 5xx as
+                # transient-within-budget, which is what keeps them alive
+                # through the task-recovery rewire window; the body carries
+                # the diagnostic PageBufferClient reports if the budget
+                # exhausts, and the coordinator's monitor loop surfaces the
+                # underlying task failure within one 0.5s tick anyway
                 return self._send(str(e).encode(), 500)
             return self._send(
                 frame or b"", 200,
@@ -118,6 +160,8 @@ class _WorkerHandler(BaseHTTPRequestHandler):
                  ("X-Task-Instance-Id", task.instance_id)])
         m = re.fullmatch(r"/v1/task/([^/]+)", path)
         if m:
+            if self._inject("worker.task_info", task_id=m.group(1)):
+                return
             task = self.worker.tasks.get(m.group(1))
             if task is None:
                 return self._send(b"no such task", 404)
@@ -146,6 +190,8 @@ class _WorkerHandler(BaseHTTPRequestHandler):
 
     def do_HEAD(self) -> None:  # noqa: N802 — failure-detector ping
         if self.path.rstrip("/") == "/v1/status":
+            if self._inject("worker.status"):
+                return
             return self._send(b"", 200)
         self._send(b"", 404)
 
@@ -187,6 +233,7 @@ class WorkerServer:
         """`host` is the bind address; `announce_host` is what peers dial
         (defaults to `host`) — a worker binding 0.0.0.0 must announce a
         routable address, not the wildcard."""
+        faults.install_from_env()  # PRESTO_TPU_FAULTS chaos knob (no-op unset)
         catalogs = catalogs or default_catalogs()
         self.metadata = MetadataManager(catalogs)
         self.tasks = WorkerTaskManager(self.metadata)

@@ -58,6 +58,23 @@ class Session:
         # stateful operators (ops/coalesce.py) — downstream kernel work and
         # per-page dispatches then scale with selectivity
         "coalesce_pages": True,
+        # --- cluster fault tolerance (cluster/retry.py) ---
+        # NONE fails fast; QUERY re-plans + re-runs the whole query on
+        # retryable failures (failed nodes excluded from placement); TASK
+        # additionally re-places failed task creates and recovers failed
+        # leaf tasks in place
+        "retry_policy": "NONE",
+        "query_retry_attempts": 2,      # extra attempts after the first
+        "task_retry_attempts": 2,       # in-place recoveries per task (TASK)
+        "retry_initial_delay_s": 0.1,   # jittered-exponential backoff floor
+        "retry_max_delay_s": 2.0,       # ... and ceiling
+        # transient-failure budget for one remote-task create
+        "remote_task_error_budget_s": 10.0,
+        # transient-failure budget before an exchange source is declared dead
+        "exchange_error_budget_s": 60.0,
+        # deterministic fault-injection spec (cluster/faults.py); "" = off
+        "fault_injection": "",
+        "fault_seed": 0,
     }
 
     def get(self, name: str, default=None):

@@ -54,6 +54,9 @@ class QueryResult:
     rows: List[list]
     column_names: List[str]
     types: Optional[List] = None  # output Type objects when the engine knows them
+    # cluster-tier execution stats (query/task attempts, retries, faults
+    # injected, backoff time) — None for purely local execution
+    stats: Optional[dict] = None
 
 
 class LocalQueryRunner:

@@ -1,0 +1,404 @@
+"""Fault-tolerant cluster execution: Backoff, fault injection, retry policies.
+
+Every scenario here drives a retry path deterministically through the
+cluster/faults.py harness — count-triggered injected faults, not
+sleeps-and-hope: a worker killed mid-query on its Nth results request, 5xx
+storms on task create, injected task failures. Results of retried queries are
+checked row-identical against the single-process LocalQueryRunner."""
+import random
+import threading
+
+import pytest
+
+from presto_tpu.cluster import faults, retry
+from presto_tpu.cluster.coordinator import ClusterQueryRunner
+from presto_tpu.cluster.discovery import Announcer
+from presto_tpu.cluster.exchange_client import StreamingRemoteSource
+from presto_tpu.cluster.retry import Backoff
+from presto_tpu.cluster.worker import WorkerServer
+from presto_tpu.metadata import Session
+from presto_tpu.runner import LocalQueryRunner
+from presto_tpu.types import BIGINT
+from presto_tpu.utils.testing import assert_rows_equal
+
+
+@pytest.fixture(autouse=True)
+def _isolated_injector():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+# ---------------------------------------------------------------------------
+# Backoff units (deterministic: injected clock / sleeper / rng)
+# ---------------------------------------------------------------------------
+
+class _FakeClock:
+    def __init__(self):
+        self.now = 0.0
+
+    def __call__(self):
+        return self.now
+
+
+def test_backoff_budget_needs_min_tries_and_interval():
+    clock = _FakeClock()
+    b = Backoff(max_failure_interval_s=1.5, min_tries=3, clock=clock,
+                sleep=lambda s: None)
+    assert not b.failure()          # 1st failure: under min_tries
+    clock.now = 1.0
+    assert not b.failure()          # 2nd: still under min_tries
+    clock.now = 1.2
+    assert not b.failure()          # 3rd: tries met, interval (1.2s) not
+    clock.now = 2.0
+    assert b.failure()              # 4th: tries met AND 2.0s >= 1.5s
+    b.success()                     # heal: budget restarts from scratch
+    clock.now = 10.0
+    assert not b.failure()
+    assert b.failure_count == 1
+
+
+def test_backoff_delay_grows_exponentially_with_jitter_bounds():
+    sleeps = []
+    b = Backoff(max_failure_interval_s=100.0, initial_delay_s=0.1,
+                max_delay_s=1.0, rng=random.Random(7),
+                clock=_FakeClock(), sleep=sleeps.append)
+    expected_base = [0.1, 0.2, 0.4, 0.8, 1.0, 1.0]  # capped at max_delay_s
+    for base in expected_base:
+        b.failure()
+        delay = b.wait()
+        assert base * 0.5 <= delay <= base, (base, delay)
+    assert b.total_backoff_s == pytest.approx(sum(sleeps))
+    assert len(sleeps) == len(expected_base)
+
+
+def test_backoff_no_delay_before_any_failure():
+    b = Backoff(sleep=lambda s: pytest.fail("must not sleep"))
+    assert b.backoff_delay_s() == 0.0
+    assert b.wait() == 0.0
+
+
+# ---------------------------------------------------------------------------
+# fault injector units
+# ---------------------------------------------------------------------------
+
+def test_fault_rule_window_after_times():
+    inj = faults.FaultInjector()
+    inj.add("worker.results", faults.HTTP_ERROR, code=500, after=2, times=2)
+    inj.fire("worker.results")          # 1st: before window
+    inj.fire("worker.results")          # 2nd: before window
+    for _ in range(2):                  # 3rd/4th: inside window
+        with pytest.raises(faults.InjectedHTTPError) as e:
+            inj.fire("worker.results")
+        assert e.value.code == 500
+    inj.fire("worker.results")          # 5th: times exhausted
+    assert inj.total_fired == 2
+
+
+def test_fault_spec_parsing_and_filters():
+    inj = faults.FaultInjector.from_spec(
+        "worker.task_create:http_error:code=503,times=1,node_id=w1;"
+        "client.*:disconnect:task_re=\\.7\\.0$,times=2", seed=5)
+    assert len(inj.rules) == 2
+    inj.fire("worker.task_create", node_id="w2")   # filtered: wrong node
+    with pytest.raises(faults.InjectedHTTPError):
+        inj.fire("worker.task_create", node_id="w1")
+    inj.fire("client.results", task_id="q.7.1")    # filtered: wrong task
+    with pytest.raises(faults.InjectedDisconnect):
+        inj.fire("client.results", task_id="q.7.0")
+    # InjectedDisconnect must read as a dropped connection to existing
+    # transient-failure handling
+    assert issubclass(faults.InjectedDisconnect, ConnectionResetError)
+
+
+def test_fault_probability_deterministic_under_seed():
+    def fired_sequence(seed):
+        inj = faults.FaultInjector(seed=seed)
+        inj.add("p", faults.ERROR, times=None, probability=0.5)
+        out = []
+        for _ in range(20):
+            try:
+                inj.fire("p")
+                out.append(0)
+            except faults.InjectedFault:
+                out.append(1)
+        return out
+
+    assert fired_sequence(3) == fired_sequence(3)
+    assert fired_sequence(3) != fired_sequence(4)
+
+
+def test_install_from_env():
+    faults.clear()
+    env = {"PRESTO_TPU_FAULTS": "worker.results:delay:delay_s=0.5,times=3",
+           "PRESTO_TPU_FAULT_SEED": "9"}
+    inj = faults.install_from_env(env)
+    assert faults.active() is inj
+    assert inj.seed == 9 and inj.rules[0].delay_s == 0.5
+
+
+# ---------------------------------------------------------------------------
+# satellite units: announcer failure accounting, stream rewire
+# ---------------------------------------------------------------------------
+
+def test_announcer_warns_on_persistent_failure(capsys):
+    ann = Announcer("http://127.0.0.1:1", "nodeZ", "http://127.0.0.1:2")
+    assert ann._announce_failures == 0   # initialized, no getattr pattern
+    for _ in range(3):
+        ann._announce_once()             # nothing listens on port 1
+    assert ann._announce_failures == 3
+    err = capsys.readouterr().err
+    assert "nodeZ" in err and "(3x)" in err and "failing" in err
+    # below-threshold counts must NOT have warned (exactly one line)
+    assert err.count("failing") == 1
+
+
+def test_streaming_source_rewire_only_while_virgin():
+    src = StreamingRemoteSource(
+        ["http://127.0.0.1:1/v1/task/a", "http://127.0.0.1:1/v1/task/b"],
+        0, [BIGINT], [None], 1024)
+    assert src.reset_location("http://127.0.0.1:1/v1/task/a",
+                              "http://127.0.0.1:1/v1/task/a2")
+    assert src.clients[0].location == "http://127.0.0.1:1/v1/task/a2"
+    # consumed stream: rewire must be rejected (replacement restarts at 0)
+    src.clients[1].token = 3
+    assert not src.reset_location("http://127.0.0.1:1/v1/task/b",
+                                  "http://127.0.0.1:1/v1/task/b2")
+    # unknown location
+    assert not src.reset_location("http://127.0.0.1:1/v1/task/zz",
+                                  "http://127.0.0.1:1/v1/task/b2")
+
+
+# ---------------------------------------------------------------------------
+# chaos integration: coordinator + 2 workers, injected cluster faults
+# ---------------------------------------------------------------------------
+
+AGG_SQL = ("select l_returnflag, count(*), sum(l_quantity) "
+           "from lineitem group by l_returnflag")
+
+
+class _Cluster:
+    """2-worker in-process cluster with controllable announcements."""
+
+    def __init__(self, properties=None, min_workers=2, n_workers=2):
+        session = Session(catalog="tpch", schema="tiny",
+                          properties=dict(properties or {}))
+        self.runner = ClusterQueryRunner(session=session,
+                                         min_workers=min_workers,
+                                         worker_wait_s=10.0)
+        self.workers = [WorkerServer(port=0).start()
+                        for _ in range(n_workers)]
+        self.dead = set()
+        self._stop = threading.Event()
+        for w in self.workers:
+            self.runner.nodes.announce(w.node_id, w.uri)
+        threading.Thread(target=self._keep_alive, daemon=True).start()
+
+    def _keep_alive(self):
+        while not self._stop.wait(0.5):
+            for w in self.workers:
+                if w.node_id not in self.dead:
+                    self.runner.nodes.announce(w.node_id, w.uri)
+            for node_id in list(self.dead):
+                # heal the announce-vs-kill race: an announce in flight
+                # while kill() ran could have resurrected the dead node
+                self.runner.nodes.remove(node_id)
+
+    def kill(self, worker):
+        """Deterministic worker death: server down + discovery forgets it
+        (in production the announcement expiry / failure detector does the
+        forgetting; tests must not wait out those clocks)."""
+        self.dead.add(worker.node_id)
+        worker.stop()
+        self.runner.nodes.remove(worker.node_id)
+
+    def close(self):
+        self._stop.set()
+        self.runner.detector.stop()
+        for w in self.workers:
+            if w.node_id not in self.dead:
+                w.stop()
+
+
+@pytest.fixture
+def local_runner():
+    return LocalQueryRunner(session=Session(catalog="tpch", schema="tiny"))
+
+
+def _kill_rule(cluster, victim, after=0):
+    """Kill `victim` on its (after+1)-th results request: the callback runs
+    in the victim's handler thread, downs the server, then slams the very
+    connection that triggered it."""
+    def kill(ctx):
+        cluster.kill(victim)
+        raise faults.InjectedDisconnect("worker killed")
+
+    inj = faults.FaultInjector(seed=11)
+    inj.add("worker.results", faults.CALLBACK, node_id=victim.node_id,
+            after=after, times=1, callback=kill)
+    faults.install(inj)
+    return inj
+
+
+def test_query_retry_survives_worker_kill(local_runner):
+    from presto_tpu.utils.metrics import METRICS
+
+    cluster = _Cluster(properties={"retry_policy": "QUERY",
+                                   "retry_initial_delay_s": 0.02,
+                                   "retry_max_delay_s": 0.1})
+    victim = cluster.workers[0]
+    inj = _kill_rule(cluster, victim)
+    retries_before = METRICS.counter_value("cluster.query_retries")
+    try:
+        got = cluster.runner.execute(AGG_SQL)
+    finally:
+        cluster.close()
+    assert inj.rules[0].fired == 1, "kill fault never triggered"
+    want = local_runner.execute(AGG_SQL)
+    assert_rows_equal(got.rows, want.rows, ordered=False)
+    assert got.stats["query_attempts"] >= 2
+    assert got.stats["retry_policy"] == "QUERY"
+    assert got.stats["faults_injected"] >= 1
+    assert METRICS.counter_value("cluster.query_retries") > retries_before
+
+
+def test_none_policy_fails_fast_naming_dead_node():
+    cluster = _Cluster()  # retry_policy defaults to NONE
+    victim = cluster.workers[0]
+    inj = _kill_rule(cluster, victim)
+    try:
+        with pytest.raises(Exception, match=victim.node_id):
+            cluster.runner.execute(AGG_SQL)
+    finally:
+        cluster.close()
+    assert inj.rules[0].fired == 1, "kill fault never triggered"
+
+
+def test_task_policy_replaces_node_rejecting_creates(local_runner):
+    cluster = _Cluster(properties={"retry_policy": "TASK",
+                                   "remote_task_error_budget_s": 0.0,
+                                   "retry_initial_delay_s": 0.01,
+                                   "retry_max_delay_s": 0.02})
+    victim = cluster.workers[0]
+    inj = faults.FaultInjector()
+    # the victim's task-create endpoint 503s forever: every task assigned to
+    # it must exhaust its Backoff budget and be re-placed on the survivor
+    inj.add("worker.task_create", faults.HTTP_ERROR, code=503, times=None,
+            node_id=victim.node_id)
+    faults.install(inj)
+    try:
+        got = cluster.runner.execute(AGG_SQL)
+    finally:
+        cluster.close()
+    want = local_runner.execute(AGG_SQL)
+    assert_rows_equal(got.rows, want.rows, ordered=False)
+    assert got.stats["query_attempts"] == 1, "re-placement, not query retry"
+    assert got.stats["task_retries"] >= 1
+    assert inj.rules[0].fired >= 3  # at least one full backoff budget
+
+
+def test_create_backoff_budget_honored_then_fail_fast():
+    cluster = _Cluster(properties={"remote_task_error_budget_s": 0.0,
+                                   "retry_initial_delay_s": 0.01,
+                                   "retry_max_delay_s": 0.02})
+    inj = faults.FaultInjector()
+    inj.add("worker.task_create", faults.HTTP_ERROR, code=503, times=None)
+    faults.install(inj)
+    try:
+        with pytest.raises(RuntimeError, match="cannot create task"):
+            cluster.runner.execute(AGG_SQL)
+    finally:
+        cluster.close()
+    # budget = min_tries (3) once the failure interval is exhausted: the
+    # first task burns exactly its budget, then the query fails (NONE)
+    assert inj.rules[0].fired == 3
+
+
+def test_task_policy_recovers_failed_leaf_task_in_place(local_runner):
+    cluster = _Cluster(properties={"retry_policy": "TASK",
+                                   "retry_initial_delay_s": 0.01,
+                                   "retry_max_delay_s": 0.02})
+    # find a leaf fragment (no remote sources, not root): its tasks derive
+    # input purely from the connector, so in-place recovery is sound
+    from presto_tpu.cluster.scheduler import _remote_source_ids
+    sub = cluster.runner.plan_sql(AGG_SQL)
+    leaves = [f.id for f in sub.fragments
+              if not _remote_source_ids(f.root)
+              and f.id != sub.root_fragment.id]
+    assert leaves, "plan has no leaf fragment"
+    inj = faults.FaultInjector()
+    # fail task <leaf>.0 once at startup; the scheduler must recreate it
+    # under a new attempt id and rewire its consumers' virgin streams
+    inj.add("worker.task_run", faults.ERROR, times=1,
+            task_re=rf"\.{leaves[0]}\.0$")
+    faults.install(inj)
+    try:
+        got = cluster.runner.execute(AGG_SQL)
+    finally:
+        cluster.close()
+    assert inj.rules[0].fired == 1, "task fault never triggered"
+    want = local_runner.execute(AGG_SQL)
+    assert_rows_equal(got.rows, want.rows, ordered=False)
+    assert got.stats["query_attempts"] == 1, \
+        "leaf recovery must not escalate to a query retry"
+    assert got.stats["task_retries"] >= 1
+
+
+def test_in_place_recovery_is_bounded_then_escalates():
+    """A leaf task that keeps dying with virgin streams must not be
+    recovered forever: after task_retry_attempts recoveries the failure
+    escalates to the (here zero-budget) query-level retry and surfaces."""
+    cluster = _Cluster(properties={"retry_policy": "TASK",
+                                   "query_retry_attempts": 0,
+                                   "task_retry_attempts": 2,
+                                   "retry_initial_delay_s": 0.01,
+                                   "retry_max_delay_s": 0.02})
+    from presto_tpu.cluster.scheduler import _remote_source_ids
+    sub = cluster.runner.plan_sql(AGG_SQL)
+    leaf = next(f.id for f in sub.fragments
+                if not _remote_source_ids(f.root)
+                and f.id != sub.root_fragment.id)
+    inj = faults.FaultInjector()
+    # matches the original task AND every .rN replacement
+    inj.add("worker.task_run", faults.ERROR, times=None,
+            task_re=rf"\.{leaf}\.0(\.r\d+)?$")
+    faults.install(inj)
+    try:
+        with pytest.raises(RuntimeError, match="injected fault"):
+            cluster.runner.execute(AGG_SQL)
+    finally:
+        cluster.close()
+    # original + exactly task_retry_attempts recoveries, then escalate
+    assert inj.rules[0].fired == 3
+
+
+def test_query_retry_gives_up_after_attempt_budget():
+    cluster = _Cluster(properties={"retry_policy": "QUERY",
+                                   "query_retry_attempts": 1,
+                                   "remote_task_error_budget_s": 0.0,
+                                   "retry_initial_delay_s": 0.01,
+                                   "retry_max_delay_s": 0.02})
+    inj = faults.FaultInjector()
+    inj.add("worker.task_create", faults.HTTP_ERROR, code=503, times=None)
+    faults.install(inj)
+    try:
+        with pytest.raises(RuntimeError, match="cannot create task"):
+            cluster.runner.execute(AGG_SQL)
+    finally:
+        cluster.close()
+    # 2 attempts (1 retry), each burning one 3-try create budget
+    assert inj.rules[0].fired == 6
+
+
+def test_deterministic_query_error_is_not_retried(local_runner):
+    """A SQL-level failure must fail identically under QUERY policy — only
+    transport/environment faults are retryable."""
+    cluster = _Cluster(properties={"retry_policy": "QUERY"})
+    try:
+        cluster.runner.local.execute(
+            "create table memory.default.coord_only2 as select 1 as x")
+        with pytest.raises(Exception, match="(?i)task .* failed"):
+            cluster.runner.execute(
+                "select count(*) from memory.default.coord_only2")
+    finally:
+        cluster.close()
