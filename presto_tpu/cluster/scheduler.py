@@ -28,6 +28,7 @@ Fault tolerance (retry_policy session property, cluster/retry.py):
 from __future__ import annotations
 
 import dataclasses
+import http.client
 import urllib.error
 import urllib.request
 from typing import Dict, List, Optional, Set
@@ -125,7 +126,10 @@ class RemoteTask:
                 self.location + ("?abort=true" if abort else ""),
                 method="DELETE")
             urllib.request.urlopen(req, timeout=5.0).read()
-        except Exception:
+        except (urllib.error.URLError, http.client.HTTPException, OSError):
+            # cancel is best-effort: the task may already be done or its
+            # node dead — teardown proceeds either way, and the worker's
+            # own task GC reaps anything a lost DELETE leaves behind
             pass
 
 

@@ -100,7 +100,13 @@ class _WorkerHandler(BaseHTTPRequestHandler):
                 return self._send(b"no such task", 404)
             try:
                 update: SourceUpdateRequest = codec.loads(body)
-                assert isinstance(update, SourceUpdateRequest)
+                if not isinstance(update, SourceUpdateRequest):
+                    # not an assert: those vanish under python -O and the
+                    # AttributeError would then escape as a dropped
+                    # connection the peer misreads as a transient fault
+                    raise TypeError(
+                        f"expected SourceUpdateRequest, got "
+                        f"{type(update).__name__}")
             except Exception as e:
                 return self._send(f"bad sources body: {e}".encode(), 400)
             if not task.update_sources(update):

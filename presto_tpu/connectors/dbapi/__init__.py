@@ -19,6 +19,7 @@ matching the engine's dictionaries-as-metadata contract.
 """
 from __future__ import annotations
 
+import sys
 import threading
 from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple
 
@@ -545,8 +546,12 @@ class DbApiPageSink(ConnectorPageSink):
         try:
             with self._metadata.conn_lock:
                 self._metadata._conn().rollback()
-        except Exception:
-            pass
+        except Exception as e:
+            # abort runs on the failure path — a rollback error must not mask
+            # the original query error, but it must not vanish either: a
+            # half-applied INSERT is exactly the silent-wrong-answer case
+            print(f"presto_tpu: dbapi abort: rollback failed: {e!r}",
+                  file=sys.stderr)
 
 
 def _plain(v):

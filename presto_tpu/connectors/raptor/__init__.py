@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import os
 import sqlite3
+import sys
 import threading
 import uuid as uuidlib
 from typing import Dict, Iterator, List, Optional, Sequence, Tuple
@@ -584,8 +585,12 @@ class RaptorConnector(Connector):
         while not self._organizer_stop.wait(interval_s):
             try:
                 self.maintenance()
-            except Exception:
-                pass
+            except Exception as e:
+                # the organizer must survive a failed compaction round, but a
+                # silent failure here means shards never merge and scans decay
+                # — surface it every round it happens
+                print(f"presto_tpu: raptor organizer: maintenance failed: "
+                      f"{e!r}", file=sys.stderr)
 
     def shutdown(self) -> None:
         self._organizer_stop.set()

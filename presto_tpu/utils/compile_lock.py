@@ -53,8 +53,8 @@ def _maybe_unload(log) -> None:
     try:
         from ..ops import scan
         scan.RESIDENT_CACHE.clear()
-    except Exception:
-        pass
+    except (ImportError, AttributeError):
+        pass  # scan not loaded (CLI tools) — nothing resident to drop
     jax.clear_caches()
 
 

@@ -1,0 +1,593 @@
+"""tools/prestocheck: the multi-pass static analysis suite gating tier-1.
+
+Each pass gets synthetic fixture modules: a positive case (deliberately
+seeded violation detected), a suppressed case (`# prestocheck: ignore[...]`
+honored) and a clean/negative case. The whole-tree test is the tier-1 wiring
+(successor to test_check_imports.test_whole_tree_is_clean): every `pytest
+tests/` run fails on any new (non-baselined, non-suppressed) finding in
+presto_tpu/ or tools/.
+"""
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from tools.prestocheck import (all_pass_ids, load_baseline, run,  # noqa: E402
+                               save_baseline)
+
+EXPECTED_PASSES = {"undefined-name", "tracer-safety", "lock-discipline",
+                   "exception-hygiene", "retry-discipline",
+                   "mutable-default-args"}
+
+
+def _scan(tmp_path, source, select=None, name="mod.py"):
+    path = tmp_path / name
+    path.write_text(textwrap.dedent(source))
+    return run([str(path)], select=select, baseline_path=None).new_findings
+
+
+def _messages(findings):
+    return [f"{f.pass_id}: {f.message}" for f in findings]
+
+
+def test_registry_has_all_six_passes():
+    assert EXPECTED_PASSES <= set(all_pass_ids())
+
+
+# ------------------------------------------------------------- tracer-safety
+
+def test_tracer_safety_flags_side_effects_in_jit(tmp_path):
+    findings = _scan(tmp_path, """
+        import time
+        import random
+        import numpy as np
+        import jax
+        import jax.numpy as jnp
+
+        COUNT = 0
+
+        @jax.jit
+        def kernel(x):
+            global COUNT
+            COUNT = COUNT + 1
+            print("tracing", x)
+            t = time.time()
+            r = random.random()
+            v = x.sum().item()
+            h = np.asarray(x)
+            return jnp.sum(x) + t + r + v
+        """, select=["tracer-safety"])
+    msgs = "\n".join(_messages(findings))
+    assert "mutates global `COUNT`" in msgs
+    assert "print()" in msgs
+    assert "time.time()" in msgs
+    assert "random.random()" in msgs
+    assert ".item()" in msgs
+    assert "host-numpy call np.asarray" in msgs
+
+
+def test_tracer_safety_partial_jit_respects_static_argnames(tmp_path):
+    findings = _scan(tmp_path, """
+        import functools
+        import numpy as np
+        import jax
+        import jax.numpy as jnp
+
+        @functools.partial(jax.jit, static_argnames=("shape",))
+        def make(x, shape):
+            pad = np.prod(shape)     # shape is static: concrete by contract
+            return jnp.resize(x, shape) + pad
+
+        @functools.partial(jax.jit, static_argnames=("n",))
+        def bad(x, n):
+            return jnp.sum(np.asarray(x)) + n   # x is traced: flagged
+        """, select=["tracer-safety"])
+    msgs = "\n".join(_messages(findings))
+    assert "np.prod" not in msgs
+    assert "np.asarray" in msgs and "`x`" in msgs
+
+
+def test_tracer_safety_reaches_helpers_and_jit_call_roots(tmp_path):
+    findings = _scan(tmp_path, """
+        import jax
+
+        def helper(x):
+            print("helper side effect")
+            return x
+
+        class Op:
+            def _process(self, page):
+                return helper(page)
+
+            def compiled(self):
+                return jax.jit(self._process)
+        """, select=["tracer-safety"])
+    msgs = "\n".join(_messages(findings))
+    assert "in jit-traced `helper`" in msgs and "print()" in msgs
+
+
+def test_tracer_safety_suppression_and_clean_module(tmp_path):
+    findings = _scan(tmp_path, """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def noisy(x):
+            print("debug", x)  # prestocheck: ignore[tracer-safety]
+            return jnp.sum(x)
+
+        @jax.jit
+        def clean(x):
+            return jnp.sum(x) * 2
+
+        def untraced(x):
+            print(x)           # not reachable from any jit root: fine
+            return x
+        """, select=["tracer-safety"])
+    assert findings == []
+
+
+# ----------------------------------------------------------- lock-discipline
+
+def test_lock_discipline_flags_blocking_calls_under_lock(tmp_path):
+    findings = _scan(tmp_path, """
+        import threading
+        import time
+        import urllib.request
+
+        _LOCK = threading.Lock()
+
+        class Client:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def bad_sleep(self):
+                with self._lock:
+                    time.sleep(0.1)
+
+            def bad_io(self):
+                with _LOCK:
+                    return urllib.request.urlopen("http://x").read()
+
+            def fine(self):
+                with self._lock:
+                    snapshot = dict(self.__dict__)
+                    hit = snapshot.get("k")   # dict.get: not blocking
+                time.sleep(0.1)               # outside the lock
+                return hit
+        """, select=["lock-discipline"])
+    msgs = _messages(findings)
+    assert len(msgs) == 2, msgs
+    assert any("time.sleep()" in m and "Client._lock" in m for m in msgs)
+    assert any("urlopen()" in m and "mod._LOCK" in m for m in msgs)
+
+
+def test_lock_discipline_two_module_order_cycle(tmp_path):
+    """The deadlock detector: module a takes A_LOCK then calls into b (which
+    takes B_LOCK); module b takes B_LOCK then calls back into a (which takes
+    A_LOCK). Opposite acquisition orders = a cycle in the global graph."""
+    (tmp_path / "locka.py").write_text(textwrap.dedent("""
+        import threading
+        from lockb import enter_b
+
+        A_LOCK = threading.Lock()
+
+        def refresh_a():
+            with A_LOCK:
+                enter_b()
+
+        def poke_a():
+            with A_LOCK:
+                return 1
+        """))
+    (tmp_path / "lockb.py").write_text(textwrap.dedent("""
+        import threading
+        from locka import poke_a
+
+        B_LOCK = threading.Lock()
+
+        def enter_b():
+            with B_LOCK:
+                return 2
+
+        def refresh_b():
+            with B_LOCK:
+                poke_a()
+        """))
+    result = run([str(tmp_path)], select=["lock-discipline"],
+                 baseline_path=None)
+    cycles = [f for f in result.new_findings
+              if "lock-order cycle" in f.message]
+    assert len(cycles) == 1, _messages(result.new_findings)
+    assert "locka.A_LOCK" in cycles[0].message
+    assert "lockb.B_LOCK" in cycles[0].message
+
+
+def test_lock_discipline_consistent_order_is_clean(tmp_path):
+    """Same two locks, both paths take A then B: no cycle, no finding."""
+    (tmp_path / "orda.py").write_text(textwrap.dedent("""
+        import threading
+
+        A_LOCK = threading.Lock()
+        B_LOCK = threading.Lock()
+
+        def path1():
+            with A_LOCK:
+                with B_LOCK:
+                    return 1
+
+        def path2():
+            with A_LOCK:
+                with B_LOCK:
+                    return 2
+        """))
+    result = run([str(tmp_path)], select=["lock-discipline"],
+                 baseline_path=None)
+    assert result.new_findings == [], _messages(result.new_findings)
+
+
+# --------------------------------------------------------- exception-hygiene
+
+def test_exception_hygiene_positive_suppressed_and_justified(tmp_path):
+    findings = _scan(tmp_path, """
+        def silent():
+            try:
+                risky()
+            except Exception:
+                pass
+
+        def bare_continue(items):
+            for i in items:
+                try:
+                    risky(i)
+                except:
+                    continue
+
+        def justified():
+            try:
+                risky()
+            except Exception:
+                pass  # best-effort cleanup; teardown also frees it
+
+        def narrow():
+            try:
+                risky()
+            except KeyError:
+                pass
+
+        def logged():
+            try:
+                risky()
+            except Exception as e:
+                print(e)
+
+        def risky(i=0):
+            return i
+        """, select=["exception-hygiene"])
+    assert len(findings) == 2, _messages(findings)
+    assert findings[0].message.startswith("except Exception")
+    assert findings[1].message.startswith("bare except")
+
+
+def test_exception_hygiene_inline_suppression(tmp_path):
+    findings = _scan(tmp_path, """
+        def f():
+            try:
+                g()
+            except Exception:  # prestocheck: ignore[exception-hygiene]
+                pass
+
+        def g():
+            return 1
+        """, select=["exception-hygiene"])
+    assert findings == []
+
+
+# --------------------------------------------------------- retry-discipline
+
+def test_retry_discipline_flags_adhoc_loop_not_backoff(tmp_path):
+    findings = _scan(tmp_path, """
+        import time
+        import urllib.request
+
+        def adhoc(url):
+            while True:
+                try:
+                    return urllib.request.urlopen(url).read()
+                except OSError:
+                    time.sleep(1.0)
+
+        def bounded(url):
+            for _ in range(5):
+                try:
+                    return urllib.request.urlopen(url).read()
+                except OSError:
+                    time.sleep(0.5)
+
+        def disciplined(url, backoff):
+            while True:
+                try:
+                    return urllib.request.urlopen(url).read()
+                except OSError:
+                    if backoff.failure():
+                        raise
+                    backoff.wait()
+
+        def plain_poll(flag):
+            while not flag.is_set():
+                time.sleep(0.01)   # no I/O try/except: not a retry loop
+        """, select=["retry-discipline"])
+    assert len(findings) == 2, _messages(findings)
+    assert {f.line for f in findings} == {6, 13}
+
+
+# ------------------------------------------------------- mutable-default-args
+
+def test_mutable_defaults_flagged_and_none_is_fine(tmp_path):
+    findings = _scan(tmp_path, """
+        def f(a, xs=[], *, opts={}):
+            return a, xs, opts
+
+        def g(a, xs=None, n=3, s="x", t=()):
+            return a, xs, n, s, t
+
+        def h(m=dict()):
+            return m
+        """, select=["mutable-default-args"])
+    msgs = _messages(findings)
+    assert len(msgs) == 3, msgs
+    assert any("xs=[]" in m for m in msgs)
+    assert any("opts={}" in m for m in msgs)
+    assert any("m=dict()" in m for m in msgs)
+
+
+# ----------------------------------------------------------- undefined-name
+
+def test_undefined_name_pass_via_suite(tmp_path):
+    findings = _scan(tmp_path, """
+        from typing import List
+
+        class C:
+            def __init__(self):
+                self._m: Dict[str, int] = {}
+                self.ok: List[int] = []
+        """, select=["undefined-name"])
+    assert len(findings) == 1 and "'Dict'" in findings[0].message
+
+
+# ------------------------------------------------- baseline + suppressions
+
+def test_baseline_grandfathers_old_findings_only(tmp_path):
+    mod = tmp_path / "legacy.py"
+    mod.write_text("def f(xs=[]):\n    return xs\n")
+    baseline_path = str(tmp_path / "baseline.json")
+
+    first = run([str(mod)], baseline_path=None)
+    assert len(first.new_findings) == 1
+    save_baseline(first.findings, baseline_path)
+    assert load_baseline(baseline_path)
+
+    grandfathered = run([str(mod)], baseline_path=baseline_path)
+    assert grandfathered.new_findings == []
+    assert len(grandfathered.baselined) == 1
+    assert grandfathered.exit_code == 0
+
+    # a NEW violation in the same file still fails the run
+    mod.write_text("def f(xs=[]):\n    return xs\n\ndef g(m={}):\n"
+                   "    return m\n")
+    after = run([str(mod)], baseline_path=baseline_path)
+    assert len(after.new_findings) == 1 and "m={}" in after.new_findings[0].message
+    assert after.exit_code == 1
+
+
+def test_bare_ignore_suppresses_every_pass(tmp_path):
+    findings = _scan(tmp_path, """
+        def f(xs=[]):  # prestocheck: ignore
+            return undefined_thing
+        """)
+    # the default-arg finding sits on the annotated line; the undefined
+    # name on the next line still fires
+    assert len(findings) == 1, _messages(findings)
+    assert findings[0].pass_id == "undefined-name"
+
+
+def test_suppression_inside_string_literal_is_not_honored(tmp_path):
+    """Only real COMMENT tokens suppress — the directive quoted in a
+    docstring (e.g. documentation of the syntax itself) must not."""
+    findings = _scan(tmp_path, '''
+        DOC = "use `# prestocheck: ignore[mutable-default-args]` to silence"
+
+        def f(xs=[]):
+            return xs, DOC
+        ''', select=["mutable-default-args"])
+    assert len(findings) == 1
+
+
+def test_malformed_suppression_fails_closed(tmp_path):
+    """A typo'd pass id must suppress NOTHING, not everything."""
+    findings = _scan(tmp_path, """
+        def f(xs=[]):  # prestocheck: ignore[mutable.default.args]
+            return xs
+        """, select=["mutable-default-args"])
+    assert len(findings) == 1
+
+
+def test_suppression_space_before_bracket_stays_targeted(tmp_path):
+    """`ignore [pass-id]` (space before bracket) must suppress exactly that
+    pass — not degrade to a bare suppress-all."""
+    findings = _scan(tmp_path, """
+        def f(xs=[]):  # prestocheck: ignore [mutable-default-args]
+            return missing_name
+        """)
+    assert len(findings) == 1, _messages(findings)
+    assert findings[0].pass_id == "undefined-name"
+
+
+def test_lock_discipline_same_basename_modules_not_conflated(tmp_path):
+    """Two unrelated util.py files in different dirs, each internally
+    consistent, must stay distinct graph nodes (no phantom cycle)."""
+    (tmp_path / "a").mkdir()
+    (tmp_path / "b").mkdir()
+    (tmp_path / "a" / "util.py").write_text(textwrap.dedent("""
+        import threading
+        A_LOCK = threading.Lock()
+        def helper():
+            with A_LOCK:
+                return 1
+        def outer():
+            with A_LOCK:
+                helper2()
+        def helper2():
+            return 2
+        """))
+    (tmp_path / "b" / "util.py").write_text(textwrap.dedent("""
+        import threading
+        B_LOCK = threading.Lock()
+        def helper2():
+            with B_LOCK:
+                return 1
+        def outer2():
+            with B_LOCK:
+                helper()
+        def helper():
+            return 2
+        """))
+    result = run([str(tmp_path)], select=["lock-discipline"],
+                 baseline_path=None)
+    assert result.new_findings == [], _messages(result.new_findings)
+
+
+def test_check_imports_shim_honors_suppressions(tmp_path):
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import check_imports
+    finally:
+        sys.path.pop(0)
+    path = tmp_path / "mod.py"
+    path.write_text(
+        "x = silenced_name  # prestocheck: ignore[undefined-name]\n"
+        "y = loud_name\n")
+    problems = check_imports.check_file(str(path))
+    assert len(problems) == 1 and "loud_name" in problems[0]
+
+
+# ------------------------------------------------------------- tier-1 gate
+
+def test_whole_tree_has_no_new_findings():
+    """Tier-1 wiring (successor of test_check_imports.test_whole_tree_is_clean
+    for the full suite): all six passes over presto_tpu/ + tools/ must report
+    nothing beyond the committed baseline."""
+    result = run([os.path.join(REPO, "presto_tpu"),
+                  os.path.join(REPO, "tools")])
+    assert result.n_files > 100, f"scan looks wrong: {result.n_files} files"
+    rendered = "\n".join(f.render() for f in result.new_findings)
+    assert result.new_findings == [], (
+        "new prestocheck findings (fix, suppress with a justified "
+        "`# prestocheck: ignore[pass-id]`, or re-baseline):\n" + rendered)
+
+
+# ------------------------------------------------------------------- CLI
+
+def test_cli_list_passes_json_and_exit_codes(tmp_path):
+    env = dict(os.environ, PYTHONPATH=REPO)
+
+    out = subprocess.run(
+        [sys.executable, "-m", "tools.prestocheck", "--list-passes"],
+        capture_output=True, text=True, cwd=REPO, env=env)
+    assert out.returncode == 0
+    assert EXPECTED_PASSES <= set(out.stdout.split())
+
+    bad = tmp_path / "bad.py"
+    bad.write_text("def f(xs=[]):\n    return unknown_name\n")
+    fail = subprocess.run(
+        [sys.executable, "-m", "tools.prestocheck", "--json", str(bad)],
+        capture_output=True, text=True, cwd=REPO, env=env)
+    assert fail.returncode == 1
+    doc = json.loads(fail.stdout)
+    assert {f["pass"] for f in doc["new"]} == {"mutable-default-args",
+                                              "undefined-name"}
+
+    only_defaults = subprocess.run(
+        [sys.executable, "-m", "tools.prestocheck",
+         "--select", "mutable-default-args", str(bad)],
+        capture_output=True, text=True, cwd=REPO, env=env)
+    assert only_defaults.returncode == 1
+    assert "undefined name" not in only_defaults.stdout
+
+    clean = subprocess.run(
+        [sys.executable, "-m", "tools.prestocheck",
+         os.path.join(REPO, "presto_tpu", "cluster")],
+        capture_output=True, text=True, cwd=REPO, env=env)
+    assert clean.returncode == 0, clean.stdout + clean.stderr
+
+    unknown = subprocess.run(
+        [sys.executable, "-m", "tools.prestocheck", "--select", "nope"],
+        capture_output=True, text=True, cwd=REPO, env=env)
+    assert unknown.returncode == 2
+
+    # a nonexistent path must be a hard error, not a silent 0-file pass
+    nopath = subprocess.run(
+        [sys.executable, "-m", "tools.prestocheck", "no/such/dir"],
+        capture_output=True, text=True, cwd=REPO, env=env)
+    assert nopath.returncode == 2
+    assert "no such path" in nopath.stderr
+
+    # default paths anchor to the repo root, not the cwd
+    from_elsewhere = subprocess.run(
+        [sys.executable, "-m", "tools.prestocheck"],
+        capture_output=True, text=True, cwd=str(tmp_path), env=env)
+    assert from_elsewhere.returncode == 0, from_elsewhere.stderr
+    assert "0 files" not in from_elsewhere.stderr
+
+
+def test_cli_update_baseline_roundtrip(tmp_path):
+    env = dict(os.environ, PYTHONPATH=REPO)
+    bad = tmp_path / "bad.py"
+    bad.write_text("def f(xs=[]):\n    return xs\n")
+    baseline = tmp_path / "base.json"
+
+    upd = subprocess.run(
+        [sys.executable, "-m", "tools.prestocheck",
+         "--update-baseline", "--baseline", str(baseline), str(bad)],
+        capture_output=True, text=True, cwd=REPO, env=env)
+    assert upd.returncode == 0 and baseline.exists()
+
+    rerun = subprocess.run(
+        [sys.executable, "-m", "tools.prestocheck",
+         "--baseline", str(baseline), str(bad)],
+        capture_output=True, text=True, cwd=REPO, env=env)
+    assert rerun.returncode == 0, rerun.stdout + rerun.stderr
+    assert "1 baselined" in rerun.stderr
+
+
+def test_cli_partial_update_baseline_keeps_other_passes(tmp_path):
+    """--update-baseline --select must not discard grandfathered findings
+    of the passes that did not run."""
+    env = dict(os.environ, PYTHONPATH=REPO)
+    bad = tmp_path / "bad.py"
+    bad.write_text("def f(xs=[]):\n    return unknown_name\n")
+    baseline = tmp_path / "base.json"
+
+    subprocess.run(
+        [sys.executable, "-m", "tools.prestocheck",
+         "--update-baseline", "--baseline", str(baseline), str(bad)],
+        capture_output=True, text=True, cwd=REPO, env=env, check=True)
+    before = load_baseline(str(baseline))
+    assert len(before) == 2  # one per pass
+
+    subprocess.run(
+        [sys.executable, "-m", "tools.prestocheck",
+         "--update-baseline", "--select", "undefined-name",
+         "--baseline", str(baseline), str(bad)],
+        capture_output=True, text=True, cwd=REPO, env=env, check=True)
+    assert load_baseline(str(baseline)) == before
+
+    full = subprocess.run(
+        [sys.executable, "-m", "tools.prestocheck",
+         "--baseline", str(baseline), str(bad)],
+        capture_output=True, text=True, cwd=REPO, env=env)
+    assert full.returncode == 0, full.stdout + full.stderr

@@ -1,0 +1,1 @@
+"""Repo tooling package (so `python -m tools.prestocheck` works anywhere)."""

@@ -1,0 +1,53 @@
+"""prestocheck: multi-pass static analysis suite for the presto-tpu tree.
+
+One AST parse + scope model per module feeds a registry of passes, each
+emitting structured findings, filtered by inline
+``# prestocheck: ignore[pass-id]`` suppressions and a committed baseline of
+grandfathered findings. Run ``python -m tools.prestocheck --help``.
+
+Programmatic use (how tests/test_prestocheck.py gates tier-1):
+
+    from tools.prestocheck import run
+    result = run(["presto_tpu"])   # -> RunResult
+    assert not result.new_findings
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+from .core import (DEFAULT_BASELINE, Finding, Module, Pass, all_pass_ids,
+                   iter_py_files, load_baseline, load_modules, make_passes,
+                   run_passes, save_baseline, split_new)
+
+__all__ = ["Finding", "Module", "Pass", "RunResult", "run", "all_pass_ids",
+           "iter_py_files", "load_baseline", "save_baseline",
+           "DEFAULT_BASELINE"]
+
+
+@dataclass
+class RunResult:
+    n_files: int
+    findings: List[Finding] = field(default_factory=list)       # all kept
+    new_findings: List[Finding] = field(default_factory=list)   # fail the run
+    baselined: List[Finding] = field(default_factory=list)
+
+    @property
+    def exit_code(self) -> int:
+        return 1 if self.new_findings else 0
+
+
+def run(paths: Sequence[str],
+        select: Optional[Sequence[str]] = None,
+        baseline_path: Optional[str] = DEFAULT_BASELINE) -> RunResult:
+    """Run the selected passes (default: all) over `paths`.
+
+    ``baseline_path=None`` disables baselining (every finding is "new")."""
+    modules = load_modules(paths)
+    passes = make_passes(select)
+    findings = run_passes(modules, passes)
+    baseline: Dict[str, int] = (load_baseline(baseline_path)
+                                if baseline_path else {})
+    new, old = split_new(findings, baseline)
+    return RunResult(n_files=len(modules), findings=findings,
+                     new_findings=new, baselined=old)

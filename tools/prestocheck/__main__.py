@@ -1,0 +1,113 @@
+"""CLI: python -m tools.prestocheck [paths...] [options].
+
+Exit 0 unless NEW (non-baselined, non-suppressed) findings exist — safe to
+wire into pre-commit and tier-1.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional
+
+from . import DEFAULT_BASELINE, all_pass_ids, run
+from .core import (REPO_ROOT, load_modules, make_passes, run_passes,
+                   save_baseline)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tools.prestocheck",
+        description="multi-pass static analysis for the presto-tpu tree")
+    parser.add_argument("paths", nargs="*", default=None,
+                        help="files/dirs to scan (default: presto_tpu tools)")
+    parser.add_argument("--json", action="store_true", dest="as_json",
+                        help="emit findings as a JSON document")
+    parser.add_argument("--list-passes", action="store_true",
+                        help="list registered pass ids and exit")
+    parser.add_argument("--select", metavar="IDS",
+                        help="comma-separated pass ids to run (default: all)")
+    parser.add_argument("--baseline", default=DEFAULT_BASELINE,
+                        help="baseline file (default: %(default)s)")
+    parser.add_argument("--no-baseline", action="store_true",
+                        help="treat every finding as new")
+    parser.add_argument("--update-baseline", action="store_true",
+                        help="rewrite the baseline from this run's findings")
+    parser.add_argument("--show-baselined", action="store_true",
+                        help="also print grandfathered findings")
+    args = parser.parse_args(argv)
+
+    if args.list_passes:
+        passes = make_passes()
+        if args.as_json:
+            print(json.dumps([{"id": p.id, "description": p.description}
+                              for p in passes], indent=1))
+        else:
+            for p in passes:
+                print(f"{p.id:22s} {p.description}")
+        return 0
+
+    # default paths anchor to the repo root, not cwd, and a path that does
+    # not exist is a hard error (exit 2) — otherwise a wrong-cwd pre-commit
+    # hook or a typo scans 0 files and green-lights everything forever
+    paths = args.paths or [os.path.join(REPO_ROOT, "presto_tpu"),
+                           os.path.join(REPO_ROOT, "tools")]
+    missing = [p for p in paths if not os.path.exists(p)]
+    if missing:
+        print(f"prestocheck: no such path(s): {', '.join(missing)}",
+              file=sys.stderr)
+        return 2
+    select = ([s.strip() for s in args.select.split(",") if s.strip()]
+              if args.select else None)
+    try:
+        passes_ok = select is None or all(s in all_pass_ids() for s in select)
+        if not passes_ok:
+            bad = [s for s in select if s not in all_pass_ids()]
+            print(f"unknown pass id(s): {', '.join(bad)} "
+                  f"(see --list-passes)", file=sys.stderr)
+            return 2
+        if args.update_baseline:
+            modules = load_modules(paths)
+            findings = run_passes(modules, make_passes(select))
+            kept = {}
+            if select:
+                # partial update: preserve grandfathered entries of the
+                # passes that did NOT run instead of discarding them
+                from .core import load_baseline as _load
+                kept = {k: v for k, v in _load(args.baseline).items()
+                        if k.split("::")[1] not in select}
+            save_baseline(findings, args.baseline, extra=kept)
+            print(f"prestocheck: baseline updated with {len(findings)} "
+                  f"finding(s)"
+                  + (f" (+{len(kept)} kept from unselected passes)"
+                     if kept else "")
+                  + f" -> {args.baseline}", file=sys.stderr)
+            return 0
+        result = run(paths, select=select,
+                     baseline_path=None if args.no_baseline
+                     else args.baseline)
+    except OSError as e:
+        print(f"prestocheck: {e}", file=sys.stderr)
+        return 2
+
+    if args.as_json:
+        print(json.dumps({
+            "files": result.n_files,
+            "new": [f.to_json() for f in result.new_findings],
+            "baselined": [f.to_json() for f in result.baselined],
+        }, indent=1))
+    else:
+        for f in result.new_findings:
+            print(f.render())
+        if args.show_baselined:
+            for f in result.baselined:
+                print(f"{f.render()}  (baselined)")
+    print(f"prestocheck: {result.n_files} files, "
+          f"{len(result.new_findings)} new finding(s), "
+          f"{len(result.baselined)} baselined", file=sys.stderr)
+    return result.exit_code
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,311 @@
+"""prestocheck core: one parse per module, a registry of passes, structured
+findings, inline suppressions and a committed baseline.
+
+Supersedes the single-purpose ``tools/check_imports.py`` (now a shim over the
+``undefined-name`` pass). The design mirrors how Presto's own build enforces
+project-specific checkstyle/error-prone rules instead of trusting review: each
+invariant that threatens the north star (correct TPU results under heavy
+concurrent traffic) gets a machine-checked pass.
+
+Pipeline
+--------
+1. Every ``.py`` file under the given roots is parsed ONCE into a
+   :class:`Module` (AST + source lines + ``# prestocheck: ignore[...]``
+   suppression map). Passes never re-parse.
+2. Each registered :class:`Pass` emits :class:`Finding`s per module via
+   ``check_module``; cross-module passes (the lock-order graph) additionally
+   emit from ``finish`` after the whole tree has been seen.
+3. Findings suppressed inline are dropped; the rest are split into *new*
+   vs *baselined* against ``baseline.json`` (counts keyed by
+   ``relpath::pass::message`` so line drift does not churn the baseline).
+   Only NEW findings fail the run — safe for pre-commit and tier-1.
+"""
+from __future__ import annotations
+
+import ast
+import io
+import json
+import os
+import re
+import tokenize
+from dataclasses import dataclass
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+# repo root = parent of the tools/ directory this package lives in; baseline
+# keys are stored relative to it so runs from any cwd agree.
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+DEFAULT_BASELINE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "baseline.json")
+
+# bare `ignore` (no bracket at all) suppresses every pass; any bracket —
+# even space-separated or holding a malformed id — is captured as-is and
+# matched against pass ids, so a typo suppresses NOTHING (fails closed)
+# rather than degrading to suppress-all. An unclosed `[` matches neither
+# branch: no suppression at all.
+_SUPPRESS_RE = re.compile(
+    r"#\s*prestocheck:\s*ignore(?:\s*\[([^\]]*)\]|(?!\s*\[))")
+
+ALL_PASSES = "*"  # sentinel in a suppression set: bare `ignore` silences all
+
+
+@dataclass(frozen=True)
+class Finding:
+    file: str
+    line: int
+    col: int
+    pass_id: str
+    message: str
+
+    def relpath(self) -> str:
+        path = os.path.abspath(self.file)
+        try:
+            rel = os.path.relpath(path, REPO_ROOT)
+        except ValueError:  # different drive (windows) — keep absolute
+            return path.replace(os.sep, "/")
+        if rel.startswith(".."):
+            return path.replace(os.sep, "/")
+        return rel.replace(os.sep, "/")
+
+    def key(self) -> str:
+        return f"{self.relpath()}::{self.pass_id}::{self.message}"
+
+    def render(self) -> str:
+        return (f"{self.file}:{self.line}:{self.col + 1}: "
+                f"[{self.pass_id}] {self.message}")
+
+    def to_json(self) -> dict:
+        return {"file": self.relpath(), "line": self.line,
+                "col": self.col + 1, "pass": self.pass_id,
+                "message": self.message}
+
+
+class Module:
+    """One parsed source file, shared by every pass.
+
+    ``suppressions`` maps line number -> set of pass ids silenced on that
+    line (``{"*"}`` for a bare ``# prestocheck: ignore``).
+    """
+
+    def __init__(self, path: str, source: bytes):
+        self.path = path
+        self.source = source
+        text = source.decode("utf-8", errors="replace")
+        self.lines: List[str] = text.splitlines()
+        self.tree: Optional[ast.Module] = None
+        self.syntax_error: Optional[SyntaxError] = None
+        try:
+            self.tree = ast.parse(source, filename=path)
+        except SyntaxError as e:
+            self.syntax_error = e
+        # real COMMENT tokens only — the directive quoted inside a docstring
+        # or string literal must not create a suppression
+        self.suppressions: Dict[int, set] = {}
+        try:
+            tokens = tokenize.generate_tokens(io.StringIO(text).readline)
+            for tok in tokens:
+                if tok.type != tokenize.COMMENT:
+                    continue
+                m = _SUPPRESS_RE.search(tok.string)
+                if not m:
+                    continue
+                if m.group(1) is not None:
+                    ids = {p.strip() for p in m.group(1).split(",")
+                           if p.strip()}
+                else:
+                    ids = {ALL_PASSES}
+                self.suppressions.setdefault(tok.start[0], set()).update(ids)
+        except (tokenize.TokenError, IndentationError, SyntaxError):
+            pass  # unparseable source is reported as a `parse` finding
+
+    def line_text(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1]
+        return ""
+
+    def is_suppressed(self, finding: Finding) -> bool:
+        ids = self.suppressions.get(finding.line)
+        if not ids:
+            return False
+        return ALL_PASSES in ids or finding.pass_id in ids
+
+
+class Pass:
+    """Base class: subclasses set ``id``/``description`` and override
+    ``check_module`` (per file) and/or ``finish`` (cross-module)."""
+
+    id: str = ""
+    description: str = ""
+
+    def check_module(self, module: Module) -> Iterable[Finding]:
+        return ()
+
+    def finish(self, modules: Sequence[Module]) -> Iterable[Finding]:
+        return ()
+
+
+# ------------------------------------------------------------------ registry
+
+_REGISTRY: "Dict[str, type]" = {}
+
+
+def register(cls: type) -> type:
+    assert issubclass(cls, Pass) and cls.id, cls
+    assert cls.id not in _REGISTRY, f"duplicate pass id {cls.id}"
+    _REGISTRY[cls.id] = cls
+    return cls
+
+
+def all_pass_ids() -> List[str]:
+    _load_builtin_passes()
+    return sorted(_REGISTRY)
+
+
+def make_passes(select: Optional[Sequence[str]] = None) -> List[Pass]:
+    _load_builtin_passes()
+    if select is None:
+        ids = sorted(_REGISTRY)
+    else:
+        unknown = [s for s in select if s not in _REGISTRY]
+        if unknown:
+            raise KeyError(
+                f"unknown pass id(s) {unknown}; known: {sorted(_REGISTRY)}")
+        ids = list(select)
+    return [_REGISTRY[i]() for i in ids]
+
+
+def _load_builtin_passes() -> None:
+    # Import for side effect (each module @register's its pass). Deferred so
+    # `import core` never cycles with the pass modules importing core.
+    from . import passes  # noqa: F401
+
+
+# ------------------------------------------------------------------ scanning
+
+def iter_py_files(paths: Sequence[str]) -> Iterable[str]:
+    for path in paths:
+        if os.path.isfile(path):
+            yield path
+            continue
+        for dirpath, dirnames, filenames in os.walk(path):
+            dirnames[:] = sorted(d for d in dirnames
+                                 if d not in ("__pycache__", ".git"))
+            for name in sorted(filenames):
+                if name.endswith(".py"):
+                    yield os.path.join(dirpath, name)
+
+
+def load_modules(paths: Sequence[str]) -> List[Module]:
+    modules = []
+    for path in iter_py_files(paths):
+        with open(path, "rb") as f:
+            modules.append(Module(path, f.read()))
+    return modules
+
+
+def run_passes(modules: Sequence[Module],
+               passes: Sequence[Pass]) -> List[Finding]:
+    """All non-suppressed findings (baseline NOT applied here)."""
+    by_path = {m.path: m for m in modules}
+    findings: List[Finding] = []
+    for module in modules:
+        if module.syntax_error is not None:
+            e = module.syntax_error
+            findings.append(Finding(module.path, e.lineno or 1, 0, "parse",
+                                    f"syntax error: {e.msg}"))
+            continue
+        for p in passes:
+            findings.extend(p.check_module(module))
+    for p in passes:
+        findings.extend(p.finish(modules))
+    kept = []
+    for f in sorted(set(findings),
+                    key=lambda f: (f.file, f.line, f.col, f.pass_id)):
+        module = by_path.get(f.file)
+        if module is not None and module.is_suppressed(f):
+            continue
+        kept.append(f)
+    return kept
+
+
+# ------------------------------------------------------------------ baseline
+
+def load_baseline(path: str = DEFAULT_BASELINE) -> Dict[str, int]:
+    if not os.path.exists(path):
+        return {}
+    with open(path, "r", encoding="utf-8") as f:
+        data = json.load(f)
+    return {str(k): int(v) for k, v in data.get("findings", {}).items()}
+
+
+def save_baseline(findings: Sequence[Finding],
+                  path: str = DEFAULT_BASELINE,
+                  extra: Optional[Dict[str, int]] = None) -> None:
+    """Write the baseline; `extra` carries pre-counted keys to merge in
+    (used by a per-pass --update-baseline to keep the other passes')."""
+    counts: Dict[str, int] = dict(extra or {})
+    for f in findings:
+        counts[f.key()] = counts.get(f.key(), 0) + 1
+    payload = {
+        "comment": ("prestocheck grandfathered findings; counts keyed by "
+                    "relpath::pass::message (line-drift-proof). Regenerate "
+                    "with: python -m tools.prestocheck --update-baseline"),
+        "findings": {k: counts[k] for k in sorted(counts)},
+    }
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(payload, f, indent=1, sort_keys=False)
+        f.write("\n")
+
+
+def split_new(findings: Sequence[Finding],
+              baseline: Dict[str, int]) -> Tuple[List[Finding],
+                                                 List[Finding]]:
+    """(new, baselined): each baseline key absorbs up to its count."""
+    budget = dict(baseline)
+    new: List[Finding] = []
+    old: List[Finding] = []
+    for f in findings:
+        k = f.key()
+        if budget.get(k, 0) > 0:
+            budget[k] -= 1
+            old.append(f)
+        else:
+            new.append(f)
+    return new, old
+
+
+# ------------------------------------------------------------------ AST util
+# Small helpers shared by several passes.
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """'a.b.c' for nested Attribute/Name chains, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def terminal_attr(node: ast.AST) -> Optional[str]:
+    """Last segment of an attribute/name chain: `self.a._lock` -> '_lock'."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def walk_no_nested_functions(node: ast.AST) -> Iterable[ast.AST]:
+    """ast.walk that does not descend into nested function/class bodies
+    (their statements execute in a different trace/lock context)."""
+    stack = list(ast.iter_child_nodes(node))
+    while stack:
+        child = stack.pop()
+        yield child
+        if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda, ast.ClassDef)):
+            continue
+        stack.extend(ast.iter_child_nodes(child))

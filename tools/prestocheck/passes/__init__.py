@@ -1,0 +1,7 @@
+"""Built-in prestocheck passes; importing this package registers them all."""
+from . import undefined_names  # noqa: F401
+from . import tracer_safety  # noqa: F401
+from . import lock_discipline  # noqa: F401
+from . import exception_hygiene  # noqa: F401
+from . import retry_discipline  # noqa: F401
+from . import mutable_defaults  # noqa: F401

@@ -1,0 +1,66 @@
+"""exception-hygiene: broad handlers that swallow faults silently.
+
+``except Exception:`` (or bare ``except:``) whose body is only ``pass`` /
+``continue`` and carries no comment turns a fault into a silent wrong answer —
+the exact failure mode the fault-tolerance tier exists to prevent: a worker
+dies, the exchange client eats the error, and the query returns truncated
+results as if they were complete.
+
+A handler is fine if it narrows the type, logs/re-raises, or carries ANY
+comment in its source range (the justifying-comment pattern at
+``cluster/exchange_client.py``: ``pass  # buffer cleanup is best-effort``).
+Intentional best-effort sites therefore need one line of English — which is
+exactly the review bar this pass mechanizes.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..core import Finding, Module, Pass, register
+
+_BROAD = {"Exception", "BaseException"}
+
+
+def _is_broad(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True
+    if isinstance(t, ast.Name):
+        return t.id in _BROAD
+    if isinstance(t, ast.Attribute):
+        return t.attr in _BROAD
+    if isinstance(t, ast.Tuple):
+        return any(_is_broad(ast.ExceptHandler(type=e, name=None, body=[]))
+                   for e in t.elts)
+    return False
+
+
+def _swallows(handler: ast.ExceptHandler) -> bool:
+    return all(isinstance(stmt, (ast.Pass, ast.Continue))
+               for stmt in handler.body)
+
+
+@register
+class ExceptionHygienePass(Pass):
+    id = "exception-hygiene"
+    description = ("broad `except` that only pass/continue with no "
+                   "justifying comment (silent fault swallow)")
+
+    def check_module(self, module: Module):
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Try):
+                continue
+            for handler in node.handlers:
+                if not (_is_broad(handler) and _swallows(handler)):
+                    continue
+                last = handler.body[-1]
+                end = getattr(last, "end_lineno", last.lineno) or last.lineno
+                span = range(handler.lineno, end + 1)
+                if any("#" in module.line_text(i) for i in span):
+                    continue  # commented = a human declared it intentional
+                caught = "bare except" if handler.type is None else \
+                    f"except {ast.unparse(handler.type)}"
+                yield Finding(
+                    module.path, handler.lineno, handler.col_offset, self.id,
+                    f"{caught}: body only pass/continue — log it, narrow "
+                    "the type, or add a justifying comment")

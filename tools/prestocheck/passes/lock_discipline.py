@@ -1,0 +1,321 @@
+"""lock-discipline: blocking calls under locks + cross-module lock ordering.
+
+Two checks feed one pass id:
+
+1. **blocking-under-lock** (per module): a call that can block for I/O or
+   scheduling time — ``time.sleep``, ``requests.*``, ``urlopen``, socket
+   accept/recv, ``subprocess`` waits, a zero-arg ``.join()`` (thread join), a
+   queue ``.get`` — lexically inside a ``with <lock>:`` body. Under heavy
+   concurrent traffic that serializes every other holder of the lock behind
+   one slow network peer; the fix is to copy state under the lock and do the
+   I/O outside (the pattern metrics.MetricsRegistry.snapshot already
+   follows: gauges are sampled after the lock is released).
+
+2. **lock-order-cycle** (cross-module, from ``finish``): every lock-ish
+   ``with`` acquired while another lock is held contributes an edge
+   ``held -> acquired`` to a global acquisition-order graph; calls made
+   under a lock contribute cross-module edges when the callee can be
+   resolved without guessing: ``self.m()`` to methods of the enclosing
+   class, bare ``f()`` to same-module or explicitly-imported functions, and
+   ``NAME.m()`` through module-level singletons (``METRICS =
+   MetricsRegistry()``) or imported-module aliases. A cycle in that graph is
+   deadlock *potential*: two threads taking the locks in opposite orders can
+   each hold one and wait forever on the other.
+
+Lock-ish = the with-expression's terminal name matches lock/mutex/cond/sem
+(this tree's 27 lock-holding modules all follow that naming). Identities are
+``module.Class.attr`` / ``module.name`` so the same lock acquired from two
+modules is one node. Receiver-blind name matching (any ``.get()`` resolving
+to any class's ``get``) is deliberately NOT done — it drowned real edges in
+dict/list-method noise.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from ..core import (REPO_ROOT, Finding, Module, Pass, dotted_name, register,
+                    terminal_attr)
+
+_LOCKISH = re.compile(r"(?i)(lock|mutex|cond|cv|sem(aphore)?)$")
+
+# dotted callee prefixes/exact names that block
+_BLOCKING_EXACT = {"time.sleep", "socket.create_connection",
+                   "subprocess.run", "subprocess.call",
+                   "subprocess.check_call", "subprocess.check_output"}
+_BLOCKING_PREFIX = ("requests.",)
+_BLOCKING_TERMINAL = {"urlopen", "accept", "recv", "recv_into", "communicate"}
+
+
+def _module_name(path: str) -> str:
+    """Full dotted module identity — basenames alone conflate the tree's
+    three connector.py / two runner.py into one graph node, which both
+    fabricates cycles and can mask real ones."""
+    ap = os.path.abspath(path)
+    rel = os.path.relpath(ap, REPO_ROOT)
+    if rel.startswith(".."):
+        rel = ap.lstrip(os.sep)  # out-of-tree (test fixtures): still unique
+    if rel.endswith(".py"):
+        rel = rel[:-3]
+    parts = [p for p in rel.replace(os.sep, ".").split(".") if p]
+    if parts and parts[-1] == "__init__":
+        parts.pop()
+    return ".".join(parts) or "module"
+
+
+def _is_lockish(expr: ast.AST) -> bool:
+    term = terminal_attr(expr)
+    return bool(term and _LOCKISH.search(term))
+
+
+def _is_blocking_call(node: ast.Call) -> Optional[str]:
+    callee = dotted_name(node.func)
+    if callee in _BLOCKING_EXACT:
+        return callee
+    if callee and callee.startswith(_BLOCKING_PREFIX):
+        return callee
+    term = node.func.attr if isinstance(node.func, ast.Attribute) else None
+    if term in _BLOCKING_TERMINAL:
+        return term
+    if term == "join" and not node.args:
+        return "join"  # zero-positional-arg join = thread/process join
+    if term == "get" and isinstance(node.func, ast.Attribute):
+        recv = terminal_attr(node.func.value) or ""
+        queueish = re.search(r"(?i)(queue|^_?q$)", recv)
+        # dict.get never takes keywords; queue.get takes block=/timeout=
+        if queueish or any(kw.arg in ("block", "timeout")
+                           for kw in node.keywords):
+            return "queue.get"
+    return None
+
+
+@dataclass
+class _CallSite:
+    held: str
+    kind: str           # "self" | "bare" | "recv"
+    receiver: Optional[str]
+    callee: str
+    path: str
+    lineno: int
+    cls: Optional[str]  # enclosing class name
+    modname: str
+
+
+@dataclass
+class _ModFacts:
+    modname: str
+    imports: Dict[str, str] = field(default_factory=dict)  # alias -> src mod
+    instances: Dict[str, str] = field(default_factory=dict)  # name -> class
+    calls: List[_CallSite] = field(default_factory=list)
+
+
+@register
+class LockDisciplinePass(Pass):
+    id = "lock-discipline"
+    description = ("blocking call under a lock; cross-module lock-order "
+                   "cycle (deadlock potential)")
+
+    def __init__(self):
+        # (modname, class or None, fn) -> lock ids directly acquired
+        self._acquires: Dict[Tuple[str, Optional[str], str], Set[str]] = {}
+        self._facts: List[_ModFacts] = []
+        # direct lexical nesting edges: (held, acquired) -> first site
+        self._edges: Dict[Tuple[str, str], Tuple[str, int]] = {}
+
+    # ------------------------------------------------------------ per module
+
+    def check_module(self, module: Module):
+        modname = _module_name(module.path)
+        facts = _ModFacts(modname)
+        self._facts.append(facts)
+        # alias -> fully dotted source module (relative imports resolved
+        # against this module's own dotted identity)
+        mod_parts = modname.split(".")
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    facts.imports[alias.asname
+                                  or alias.name.split(".")[0]] = alias.name
+            elif isinstance(node, ast.ImportFrom):
+                if node.level:
+                    if node.level > len(mod_parts):
+                        continue
+                    base = mod_parts[:len(mod_parts) - node.level]
+                    src = ".".join(base + (node.module.split(".")
+                                           if node.module else []))
+                else:
+                    src = node.module or ""
+                if not src:
+                    continue
+                for alias in node.names:
+                    # `from . import codec` binds the SUBMODULE codec
+                    full = (f"{src}.{alias.name}"
+                            if node.module is None else src)
+                    facts.imports[alias.asname or alias.name] = full
+        # module-level singletons: NAME = ClassName(...)
+        for stmt in module.tree.body:
+            if isinstance(stmt, ast.Assign) and \
+                    isinstance(stmt.value, ast.Call):
+                cls = terminal_attr(stmt.value.func)
+                # class-ish callee = first alphabetic char is uppercase
+                # (covers `_GenCache`, excludes `_make_pool` factories)
+                if cls and cls.lstrip("_")[:1].isupper():
+                    for t in stmt.targets:
+                        if isinstance(t, ast.Name):
+                            facts.instances[t.id] = cls
+
+        def lock_id(expr: ast.AST, cls: Optional[str]) -> str:
+            term = terminal_attr(expr) or "?"
+            if isinstance(expr, ast.Name) and expr.id in facts.imports:
+                return f"{facts.imports[expr.id]}.{term}"
+            if isinstance(expr, ast.Attribute) and \
+                    isinstance(expr.value, ast.Name) and \
+                    expr.value.id in ("self", "cls") and cls:
+                return f"{modname}.{cls}.{term}"
+            return f"{modname}.{term}"
+
+        findings: List[Finding] = []
+
+        def visit(node: ast.AST, cls: Optional[str], fn: Optional[str],
+                  held: List[str]):
+            if isinstance(node, ast.ClassDef):
+                for child in node.body:
+                    visit(child, node.name, fn, held)
+                return
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # a nested def's body runs when called, not where it appears:
+                # locks held at the def site are not held in the body
+                for child in node.body:
+                    visit(child, cls, node.name, [])
+                return
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                acquired = [lock_id(item.context_expr, cls)
+                            for item in node.items
+                            if _is_lockish(item.context_expr)]
+                for lid in acquired:
+                    if held:
+                        self._edges.setdefault((held[-1], lid),
+                                               (module.path, node.lineno))
+                    if fn:
+                        self._acquires.setdefault((modname, cls, fn),
+                                                  set()).add(lid)
+                for child in node.body:
+                    visit(child, cls, fn, held + acquired)
+                return
+            if isinstance(node, ast.Call) and held:
+                blocking = _is_blocking_call(node)
+                if blocking:
+                    findings.append(Finding(
+                        module.path, node.lineno, node.col_offset, self.id,
+                        f"blocking call {blocking}() while holding "
+                        f"`{held[-1]}` — copy state under the lock, do the "
+                        "I/O outside"))
+                else:
+                    f = node.func
+                    if isinstance(f, ast.Name):
+                        facts.calls.append(_CallSite(
+                            held[-1], "bare", None, f.id, module.path,
+                            node.lineno, cls, modname))
+                    elif isinstance(f, ast.Attribute) and \
+                            isinstance(f.value, ast.Name):
+                        kind = ("self" if f.value.id in ("self", "cls")
+                                else "recv")
+                        facts.calls.append(_CallSite(
+                            held[-1], kind, f.value.id, f.attr, module.path,
+                            node.lineno, cls, modname))
+            for child in ast.iter_child_nodes(node):
+                visit(child, cls, fn, held)
+
+        for stmt in module.tree.body:
+            visit(stmt, None, None, [])
+        return findings
+
+    # ---------------------------------------------------------- cross module
+
+    def finish(self, modules: Sequence[Module]):
+        # merge acquisition facts by bare class name / (mod, fn)
+        method_acq: Dict[Tuple[str, str], Set[str]] = {}
+        modfn_acq: Dict[Tuple[str, str], Set[str]] = {}
+        for (mod, cls, fn), lids in self._acquires.items():
+            if cls:
+                method_acq.setdefault((cls, fn), set()).update(lids)
+            else:
+                modfn_acq.setdefault((mod, fn), set()).update(lids)
+        instances: Dict[str, str] = {}
+        for facts in self._facts:
+            instances.update(facts.instances)
+
+        def fns_of(src: str, callee: str) -> Set[str]:
+            """Acquisitions of module-level `callee` in module `src` —
+            exact dotted match, or tail match for sources imported from
+            outside the scanned roots' package structure."""
+            exact = modfn_acq.get((src, callee))
+            if exact:
+                return exact
+            out: Set[str] = set()
+            for (mod, fn), lids in modfn_acq.items():
+                if fn == callee and mod.endswith("." + src):
+                    out |= lids
+            return out
+
+        edges: Dict[Tuple[str, str], Tuple[str, int]] = dict(self._edges)
+        for facts in self._facts:
+            for site in facts.calls:
+                targets: Set[str] = set()
+                if site.kind == "self" and site.cls:
+                    targets = method_acq.get((site.cls, site.callee), set())
+                elif site.kind == "bare":
+                    targets = modfn_acq.get((site.modname, site.callee),
+                                            set())
+                    if not targets and site.callee in facts.imports:
+                        targets = fns_of(facts.imports[site.callee],
+                                         site.callee)
+                elif site.kind == "recv":
+                    recv = site.receiver
+                    # own module's singletons first, then the global map
+                    cls_name = facts.instances.get(recv, instances.get(recv))
+                    if cls_name:
+                        targets = method_acq.get((cls_name, site.callee),
+                                                 set())
+                    elif recv in facts.imports:
+                        # module alias: kernel_cache.get_or_install(...)
+                        targets = fns_of(facts.imports[recv], site.callee)
+                for lid in targets:
+                    if lid != site.held:
+                        edges.setdefault((site.held, lid),
+                                         (site.path, site.lineno))
+
+        graph: Dict[str, Set[str]] = {}
+        for (a, b) in edges:
+            graph.setdefault(a, set()).add(b)
+            graph.setdefault(b, set())
+
+        # DFS cycle detection; report each cycle once, canonicalized by its
+        # node set so A->B->A and B->A->B are one finding.
+        reported: Set[Tuple[str, ...]] = set()
+        findings: List[Finding] = []
+        for start in sorted(graph):
+            stack = [(start, [start])]
+            while stack:
+                node, trail = stack.pop()
+                for nxt in sorted(graph.get(node, ())):
+                    if nxt == trail[0]:
+                        if len(trail) < 2:
+                            continue
+                        key = tuple(sorted(set(trail)))
+                        if key in reported:
+                            continue
+                        reported.add(key)
+                        path, lineno = edges.get(
+                            (trail[0], trail[1]),
+                            edges.get((trail[-1], trail[0]), ("?", 0)))
+                        findings.append(Finding(
+                            path, lineno, 0, self.id,
+                            "lock-order cycle (deadlock potential): "
+                            + " -> ".join(trail + [nxt])))
+                    elif nxt not in trail and len(trail) < 8:
+                        stack.append((nxt, trail + [nxt]))
+        return findings

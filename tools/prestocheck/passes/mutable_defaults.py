@@ -1,0 +1,52 @@
+"""mutable-default-args: `def f(x=[])` / `def f(x={})` and friends.
+
+The default is evaluated ONCE at def time and shared by every call; under
+concurrent traffic two sessions appending to the "fresh" default list see
+each other's state — a heisenbug that only reproduces under load. Use
+``None`` + ``x = [] if x is None else x``.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..core import Finding, Module, Pass, dotted_name, register
+
+_MUTABLE_FACTORIES = {"list", "dict", "set", "bytearray", "defaultdict",
+                      "OrderedDict", "Counter", "deque",
+                      "collections.defaultdict", "collections.OrderedDict",
+                      "collections.Counter", "collections.deque"}
+
+
+def _is_mutable(node: ast.AST) -> bool:
+    if isinstance(node, (ast.List, ast.Dict, ast.Set)):
+        return True
+    if isinstance(node, ast.Call):
+        return (dotted_name(node.func) or "") in _MUTABLE_FACTORIES
+    return False
+
+
+@register
+class MutableDefaultsPass(Pass):
+    id = "mutable-default-args"
+    description = "mutable default argument shared across calls"
+
+    def check_module(self, module: Module):
+        for node in ast.walk(module.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                     ast.Lambda)):
+                continue
+            a = node.args
+            positional = a.posonlyargs + a.args
+            pairs = list(zip(positional[len(positional) - len(a.defaults):],
+                             a.defaults))
+            pairs += [(arg, d) for arg, d in zip(a.kwonlyargs, a.kw_defaults)
+                      if d is not None]
+            fname = getattr(node, "name", "<lambda>")
+            for arg, default in pairs:
+                if _is_mutable(default):
+                    yield Finding(
+                        module.path, default.lineno, default.col_offset,
+                        self.id,
+                        f"mutable default `{arg.arg}={ast.unparse(default)}` "
+                        f"in `{fname}` is shared across calls — default to "
+                        "None and allocate inside")

@@ -1,0 +1,63 @@
+"""retry-discipline: ad-hoc retry loops that bypass cluster/retry.Backoff.
+
+PR 1 unified every coordinator<->worker retry loop on one jittered
+exponential ``Backoff`` with a transient-failure budget. An ad-hoc
+``while: try: <I/O> except: time.sleep(k)`` loop reintroduces the problems
+that migration removed: fixed delay (thundering herd on recovery), no
+failure budget (infinite retry of a dead peer), no jitter, and no
+``total_backoff_s`` accounting in query stats.
+
+Detection: a ``while`` or ``for`` loop whose body contains BOTH a
+``time.sleep`` call and a ``try/except`` wrapping an I/O-ish call
+(``urlopen`` / ``requests.*`` / ``socket.*`` / ``.recv``), with no reference
+to a backoff object anywhere in the loop. Loops already driven by a Backoff
+(``self._backoff.wait()``) are exempt by that last clause.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..core import (Finding, Module, Pass, dotted_name, register,
+                    walk_no_nested_functions)
+
+
+def _is_io_call(node: ast.Call) -> bool:
+    callee = dotted_name(node.func) or ""
+    if callee.startswith(("requests.", "socket.", "http.")):
+        return True
+    term = node.func.attr if isinstance(node.func, ast.Attribute) else callee
+    return term in ("urlopen", "recv", "recv_into", "create_connection")
+
+
+@register
+class RetryDisciplinePass(Pass):
+    id = "retry-discipline"
+    description = ("ad-hoc retry loop (sleep + try/except around I/O) "
+                   "bypassing cluster/retry.Backoff")
+
+    def check_module(self, module: Module):
+        for node in ast.walk(module.tree):
+            if not isinstance(node, (ast.While, ast.For)):
+                continue
+            has_sleep = has_io_try = has_backoff = False
+            for sub in walk_no_nested_functions(node):
+                if isinstance(sub, ast.Call) and \
+                        dotted_name(sub.func) == "time.sleep":
+                    has_sleep = True
+                if isinstance(sub, ast.Try):
+                    for inner in ast.walk(sub):
+                        if isinstance(inner, ast.Call) and _is_io_call(inner):
+                            has_io_try = True
+                            break
+                if isinstance(sub, ast.Name) and "backoff" in sub.id.lower():
+                    has_backoff = True
+                if isinstance(sub, ast.Attribute) and \
+                        "backoff" in sub.attr.lower():
+                    has_backoff = True
+            if has_sleep and has_io_try and not has_backoff:
+                kind = "while" if isinstance(node, ast.While) else "for"
+                yield Finding(
+                    module.path, node.lineno, node.col_offset, self.id,
+                    f"ad-hoc retry loop ({kind} + time.sleep + try/except "
+                    "around I/O) — use cluster/retry.Backoff (jitter, "
+                    "budget, stats)")

@@ -1,0 +1,253 @@
+"""tracer-safety: Python side effects inside `jax.jit` traces.
+
+A jitted function's Python body runs ONCE at trace time; anything that is not
+expressed as jax ops is baked into the compiled artifact as a constant or
+silently skipped on later calls. The classic wrong-answer generators:
+
+* ``print(...)`` — fires at trace time only (use ``jax.debug.print``);
+* reading ``time.*`` / ``random.*`` / ``np.random.*`` — the value freezes at
+  trace time, every subsequent call reuses it;
+* mutating a global — happens once, at trace time;
+* ``.item()`` / ``float(param)`` / ``int(param)`` / ``bool(param)`` — forces
+  concretization; on a tracer it either raises or (via static re-tracing)
+  hides a recompile per distinct value;
+* ``np.<fn>(traced_param)`` — silently concretizes the tracer through host
+  numpy, constant-folding data into the compiled graph.
+
+Roots: functions decorated ``@jax.jit`` / ``@functools.partial(jax.jit,...)``
+or passed to a ``jax.jit(...)`` call anywhere in the module (including
+``jax.jit(self._method)``). Parameters named in ``static_argnames`` /
+``static_argnums`` are exempt from the concretization checks (static args are
+concrete by contract). The module-local call graph extends the checks to
+helpers reachable from a root — for those, only the always-wrong checks run
+(print / time / random / global / ``.item()``), since we cannot tell which of
+their arguments are traced.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..core import Finding, Module, Pass, dotted_name, register
+
+_TIME_CALLS = {"time.time", "time.monotonic", "time.perf_counter",
+               "time.time_ns", "time.process_time", "datetime.now",
+               "datetime.utcnow", "datetime.datetime.now",
+               "datetime.datetime.utcnow"}
+
+
+def _numpy_aliases(tree: ast.Module) -> Set[str]:
+    aliases = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.name == "numpy":
+                    aliases.add(alias.asname or "numpy")
+    return aliases
+
+
+def _is_jax_jit(node: ast.AST) -> bool:
+    """`jax.jit` or bare `jit` (from jax import jit)."""
+    name = dotted_name(node)
+    return name in ("jax.jit", "jit")
+
+
+def _jit_call_static(call: ast.Call,
+                     func_node: Optional[ast.AST] = None) -> Optional[Set]:
+    """If `call` is functools.partial(jax.jit, ...) or jax.jit(...), return
+    the static-parameter spec {names...} | {ints...}; else None."""
+    callee = dotted_name(call.func)
+    inner = None
+    if callee in ("functools.partial", "partial") and call.args \
+            and _is_jax_jit(call.args[0]):
+        inner = call
+    elif _is_jax_jit(call.func):
+        inner = call
+    if inner is None:
+        return None
+    static: Set = set()
+    for kw in inner.keywords:
+        if kw.arg in ("static_argnames", "static_argnums"):
+            v = kw.value
+            elts = v.elts if isinstance(v, (ast.Tuple, ast.List)) else [v]
+            for e in elts:
+                if isinstance(e, ast.Constant):
+                    static.add(e.value)
+    return static
+
+
+def _param_names(fn: ast.AST) -> List[str]:
+    a = fn.args
+    return [p.arg for p in a.posonlyargs + a.args]
+
+
+def _static_params(fn: ast.AST, spec: Set) -> Set[str]:
+    """Resolve a static_argnames/argnums spec to parameter NAMES of fn."""
+    names = _param_names(fn)
+    out: Set[str] = set()
+    for s in spec:
+        if isinstance(s, int):
+            if 0 <= s < len(names):
+                out.add(names[s])
+        else:
+            out.add(str(s))
+    return out
+
+
+class _FnInfo:
+    def __init__(self, node):
+        self.node = node
+        self.is_root = False
+        self.static_spec: Set = set()
+        self.reachable = False
+
+
+@register
+class TracerSafetyPass(Pass):
+    id = "tracer-safety"
+    description = ("Python side effect inside a jax.jit trace "
+                   "(print/time/random/global/.item()/np-on-tracer "
+                   "freezes at trace time)")
+
+    def check_module(self, module: Module):
+        tree = module.tree
+        np_aliases = _numpy_aliases(tree)
+        # ---- function table by bare name (module funcs AND methods)
+        fns: Dict[str, List[_FnInfo]] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                fns.setdefault(node.name, []).append(_FnInfo(node))
+
+        def infos_of(node) -> Optional[_FnInfo]:
+            for info in fns.get(getattr(node, "name", ""), []):
+                if info.node is node:
+                    return info
+            return None
+
+        # ---- roots from decorators
+        for infos in fns.values():
+            for info in infos:
+                for deco in info.node.decorator_list:
+                    if _is_jax_jit(deco):
+                        info.is_root = True
+                    elif isinstance(deco, ast.Call):
+                        spec = _jit_call_static(deco)
+                        if spec is not None:
+                            info.is_root = True
+                            info.static_spec |= spec
+        # ---- roots from jax.jit(f) / jax.jit(self._m) call sites
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call) and _is_jax_jit(node.func)
+                    and node.args):
+                continue
+            spec = _jit_call_static(node) or set()
+            target = node.args[0]
+            name = None
+            if isinstance(target, ast.Name):
+                name = target.id
+            elif isinstance(target, ast.Attribute):
+                name = target.attr
+            for info in fns.get(name, []):
+                info.is_root = True
+                info.static_spec |= spec
+
+        roots = [i for infos in fns.values() for i in infos if i.is_root]
+        if not roots:
+            return
+
+        # ---- module-local call graph: mark helpers reachable from roots
+        work = list(roots)
+        for info in work:
+            info.reachable = True
+        while work:
+            info = work.pop()
+            for node in ast.walk(info.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                callee = None
+                if isinstance(node.func, ast.Name):
+                    callee = node.func.id
+                elif isinstance(node.func, ast.Attribute) and \
+                        isinstance(node.func.value, ast.Name) and \
+                        node.func.value.id in ("self", "cls"):
+                    callee = node.func.attr
+                for target in fns.get(callee or "", []):
+                    if not target.reachable:
+                        target.reachable = True
+                        work.append(target)
+
+        seen: Set[Tuple[int, int, str]] = set()
+
+        def emit(node, message):
+            key = (node.lineno, node.col_offset, message)
+            if key not in seen:
+                seen.add(key)
+                yield Finding(module.path, node.lineno, node.col_offset,
+                              self.id, message)
+
+        for infos in fns.values():
+            for info in infos:
+                if not info.reachable:
+                    continue
+                traced = set(_param_names(info.node)) - \
+                    _static_params(info.node, info.static_spec) - {"self"}
+                yield from self._check_fn(info, traced, np_aliases, emit)
+
+    def _check_fn(self, info: _FnInfo, traced_params: Set[str],
+                  np_aliases: Set[str], emit):
+        fn = info.node
+        where = f"in jit-traced `{fn.name}`"
+
+        def touches_traced(node) -> Optional[str]:
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Name) and sub.id in traced_params:
+                    return sub.id
+            return None
+
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Global):
+                # global + assignment in this fn = trace-time-only mutation
+                assigned = {t.id for a in ast.walk(fn)
+                            if isinstance(a, (ast.Assign, ast.AugAssign))
+                            for t in (a.targets if isinstance(a, ast.Assign)
+                                      else [a.target])
+                            if isinstance(t, ast.Name)}
+                for name in node.names:
+                    if name in assigned:
+                        yield from emit(node, f"mutates global `{name}` "
+                                        f"{where} (runs at trace time only)")
+                continue
+            if not isinstance(node, ast.Call):
+                continue
+            callee = dotted_name(node.func)
+            if callee == "print":
+                yield from emit(node, f"print() {where} fires at trace time "
+                                "only (use jax.debug.print)")
+            elif callee in _TIME_CALLS:
+                yield from emit(node, f"{callee}() {where} freezes at trace "
+                                "time — every compiled call reuses it")
+            elif callee and (callee.startswith("random.")
+                             or any(callee.startswith(a + ".random.")
+                                    for a in np_aliases)):
+                yield from emit(node, f"{callee}() {where} draws at trace "
+                                "time only (use jax.random with a key)")
+            elif isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "item":
+                yield from emit(node, f".item() {where} concretizes the "
+                                "tracer (device sync / trace error)")
+            elif info.is_root and callee in ("float", "int", "bool") \
+                    and node.args:
+                hit = touches_traced(node.args[0])
+                if hit:
+                    yield from emit(
+                        node, f"{callee}(...) on traced parameter `{hit}` "
+                        f"{where} forces concretization")
+            elif info.is_root and callee \
+                    and callee.split(".")[0] in np_aliases \
+                    and not callee.split(".")[1:2] == ["random"]:
+                hit = touches_traced(node)
+                if hit:
+                    yield from emit(
+                        node, f"host-numpy call {callee}(...) touches traced "
+                        f"parameter `{hit}` {where} — use jnp or mark the "
+                        "argument static")

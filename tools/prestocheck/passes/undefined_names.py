@@ -1,0 +1,218 @@
+"""undefined-name: pyflakes-lite scope analysis (ported from the original
+tools/check_imports.py, which is now a shim over this pass).
+
+Catches the latent-NameError class where a name is used (often only inside a
+type annotation or a rarely-taken branch) but never imported or assigned —
+e.g. `Dict` annotating an attribute while only `List, Optional` were imported:
+the module imports fine and every test passes until something evaluates the
+annotation, then it NameErrors in production. Binding ORDER is deliberately
+ignored (flow analysis is pyflakes' job); this pass only hunts names bound
+NOWHERE, so it has near-zero false positives.
+"""
+from __future__ import annotations
+
+import ast
+import builtins
+from typing import List, Set, Tuple
+
+from ..core import Finding, Module, Pass, register
+
+_BUILTINS: Set[str] = set(dir(builtins)) | {
+    "__file__", "__name__", "__doc__", "__builtins__", "__spec__",
+    "__package__", "__debug__", "__annotations__", "__dict__", "__class__",
+    "__module__", "__qualname__", "__loader__", "__path__",
+}
+
+
+class _Scope:
+    def __init__(self, node: ast.AST, parent: "_Scope" = None,
+                 is_class: bool = False):
+        self.node = node
+        self.parent = parent
+        self.is_class = is_class
+        self.bindings: Set[str] = set()
+        self.globals: Set[str] = set()
+        self.has_star_import = False
+
+    def resolve(self, name: str) -> bool:
+        if name in self.bindings or self.has_star_import:
+            return True
+        # class scopes are invisible to scopes nested inside them (methods
+        # cannot see class attributes by bare name)
+        scope = self.parent
+        while scope is not None:
+            if not scope.is_class and (name in scope.bindings
+                                       or scope.has_star_import):
+                return True
+            scope = scope.parent
+        return False
+
+    def module(self) -> "_Scope":
+        scope = self
+        while scope.parent is not None:
+            scope = scope.parent
+        return scope
+
+
+def _bind_target(scope: _Scope, node: ast.AST) -> None:
+    """Bind every Name inside an assignment-target-like AST node."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name):
+            scope.bindings.add(sub.id)
+        elif isinstance(sub, ast.MatchAs) and sub.name:
+            scope.bindings.add(sub.name)
+        elif isinstance(sub, ast.MatchStar) and sub.name:
+            scope.bindings.add(sub.name)
+        elif isinstance(sub, ast.MatchMapping) and sub.rest:
+            scope.bindings.add(sub.rest)
+
+
+class _Checker:
+    """Two passes per scope: collect bindings for the whole scope subtree,
+    then check loads (so later bindings satisfy earlier uses — order is a
+    flow concern, not an existence concern)."""
+
+    def __init__(self):
+        self.problems: List[Tuple[int, int, str]] = []
+
+    # ---------------------------------------------------------- binding pass
+
+    def _collect(self, scope: _Scope, body: List[ast.stmt]) -> None:
+        for stmt in body:
+            self._collect_stmt(scope, stmt)
+
+    def _collect_stmt(self, scope: _Scope, node: ast.AST) -> None:
+        if isinstance(node, (ast.Import, ast.ImportFrom)):
+            for alias in node.names:
+                if alias.name == "*":
+                    scope.has_star_import = True
+                    scope.module().has_star_import = True
+                else:
+                    bound = alias.asname or alias.name.split(".")[0]
+                    scope.bindings.add(bound)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef)):
+            scope.bindings.add(node.name)
+            return  # inner scope handled when visited
+        elif isinstance(node, ast.Assign):
+            for target in node.targets:
+                _bind_target(scope, target)
+        elif isinstance(node, (ast.AnnAssign, ast.AugAssign)):
+            _bind_target(scope, node.target)
+        elif isinstance(node, (ast.For, ast.AsyncFor)):
+            _bind_target(scope, node.target)
+        elif isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                if item.optional_vars is not None:
+                    _bind_target(scope, item.optional_vars)
+        elif isinstance(node, ast.ExceptHandler):
+            if node.name:
+                scope.bindings.add(node.name)
+        elif isinstance(node, ast.Global):
+            scope.globals.update(node.names)
+            scope.bindings.update(node.names)
+            scope.module().bindings.update(node.names)
+        elif isinstance(node, ast.Nonlocal):
+            scope.bindings.update(node.names)
+        elif isinstance(node, ast.NamedExpr):
+            _bind_target(scope, node.target)
+        elif isinstance(node, ast.Delete):
+            pass
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef, ast.Lambda, ast.ListComp,
+                             ast.SetComp, ast.DictComp, ast.GeneratorExp)):
+            return  # do not descend into nested scopes
+        for child in ast.iter_child_nodes(node):
+            self._collect_stmt(scope, child)
+
+    # ------------------------------------------------------------ check pass
+
+    def check_module(self, tree: ast.Module) -> None:
+        scope = _Scope(tree)
+        self._collect(scope, tree.body)
+        for stmt in tree.body:
+            self._check_node(scope, stmt)
+
+    def _enter_function(self, scope: _Scope, node) -> None:
+        inner = _Scope(node, parent=scope)
+        args = node.args
+        for a in (args.posonlyargs + args.args + args.kwonlyargs
+                  + ([args.vararg] if args.vararg else [])
+                  + ([args.kwarg] if args.kwarg else [])):
+            inner.bindings.add(a.arg)
+            # annotations evaluate in the ENCLOSING scope
+            if a.annotation is not None:
+                self._check_node(scope, a.annotation)
+        for default in list(args.defaults) + \
+                [d for d in args.kw_defaults if d is not None]:
+            self._check_node(scope, default)
+        if isinstance(node, ast.Lambda):
+            self._check_node(inner, node.body)
+            return
+        if node.returns is not None:
+            self._check_node(scope, node.returns)
+        for deco in node.decorator_list:
+            self._check_node(scope, deco)
+        self._collect(inner, node.body)
+        for stmt in node.body:
+            self._check_node(inner, stmt)
+
+    def _enter_class(self, scope: _Scope, node: ast.ClassDef) -> None:
+        for deco in node.decorator_list:
+            self._check_node(scope, deco)
+        for base in node.bases + [kw.value for kw in node.keywords]:
+            self._check_node(scope, base)
+        inner = _Scope(node, parent=scope, is_class=True)
+        self._collect(inner, node.body)
+        for stmt in node.body:
+            self._check_node(inner, stmt)
+
+    def _enter_comprehension(self, scope: _Scope, node) -> None:
+        inner = _Scope(node, parent=scope)
+        for gen in node.generators:
+            _bind_target(inner, gen.target)
+        # first iterable evaluates in the enclosing scope, the rest inside
+        self._check_node(scope, node.generators[0].iter)
+        for gen in node.generators[1:]:
+            self._check_node(inner, gen.iter)
+        for gen in node.generators:
+            for cond in gen.ifs:
+                self._check_node(inner, cond)
+        if isinstance(node, ast.DictComp):
+            self._check_node(inner, node.key)
+            self._check_node(inner, node.value)
+        else:
+            self._check_node(inner, node.elt)
+
+    def _check_node(self, scope: _Scope, node: ast.AST) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            self._enter_function(scope, node)
+            return
+        if isinstance(node, ast.ClassDef):
+            self._enter_class(scope, node)
+            return
+        if isinstance(node, (ast.ListComp, ast.SetComp, ast.DictComp,
+                             ast.GeneratorExp)):
+            self._enter_comprehension(scope, node)
+            return
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+            if node.id not in _BUILTINS and not scope.resolve(node.id):
+                self.problems.append((node.lineno, node.col_offset, node.id))
+            return
+        for child in ast.iter_child_nodes(node):
+            self._check_node(scope, child)
+
+
+@register
+class UndefinedNamesPass(Pass):
+    id = "undefined-name"
+    description = ("name used but bound in no enclosing scope "
+                   "(latent NameError; pyflakes-lite)")
+
+    def check_module(self, module: Module):
+        checker = _Checker()
+        checker.check_module(module.tree)
+        for line, col, name in sorted(set(checker.problems)):
+            yield Finding(module.path, line, col, self.id,
+                          f"undefined name {name!r}")
