@@ -341,8 +341,9 @@ def bench_pcol_scan(sf: float, seconds_budget: float = 30.0,
     runner.execute(q6)  # compile + first mmap touch
     out["first_run_s"] = round(time.time() - t0, 2)
     runs, t0 = 0, time.time()
+    last = None
     while True:
-        runner.execute(q6)
+        last = runner.execute(q6)
         runs += 1
         if time.time() - t0 > seconds_budget or runs >= 5:
             break
@@ -351,6 +352,11 @@ def bench_pcol_scan(sf: float, seconds_budget: float = 30.0,
     src_rows = g.table_row_count("lineitem", sf)
     out.update({"rows": src_rows, "wall_s": round(wall, 3),
                 "rows_per_sec": round(src_rows / wall)})
+    # per-stage busy/stall attribution of the LAST timed run (the streaming
+    # scan pipeline's read/decode/upload/compute breakdown) — bench rounds
+    # compare these fields to see which stage the wall clock went to
+    if last is not None and last.stats and last.stats.get("scan_pipeline"):
+        out["stages"] = last.stats["scan_pipeline"]
     return out
 
 

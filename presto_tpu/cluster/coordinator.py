@@ -102,8 +102,11 @@ class ClusterQueryRunner:
 
     def _wait_for_workers(self, min_needed: Optional[int] = None,
                           exclude: Optional[Set[str]] = None) -> List[NodeInfo]:
+        from .retry import Backoff
+
         min_needed = self.min_workers if min_needed is None else min_needed
         deadline = time.monotonic() + self.worker_wait_s
+        backoff = Backoff(initial_delay_s=0.02, max_delay_s=0.25)
         while True:
             nodes = self.nodes.active_nodes()
             if exclude:
@@ -117,7 +120,8 @@ class ClusterQueryRunner:
                 raise RuntimeError(
                     f"only {len(nodes)} active workers "
                     f"(need {min_needed})")
-            time.sleep(0.1)
+            backoff.failure()
+            backoff.wait()
 
     def execute(self, sql: str, user=None) -> QueryResult:
         stmt = self.local.parser.parse(sql)

@@ -334,17 +334,12 @@ def iter_pcol_pages(path: str, names, type_of, table_dicts, capacity: int,
             return
         prefilter = prefilter_fn(pf) if prefilter_fn is not None else None
         cols = {}
-        remap = {}
         for n in names:
             data, nulls, _d = pf.read_column(n)
             cols[n] = (data, nulls)
-            e = pf.columns[n]
-            td = table_dicts.get(n)
-            if "dict" in e and td is not None and \
-                    list(e["dict"]) != list(td.values):
-                pos = {v: i for i, v in enumerate(td.values)}
-                remap[n] = np.asarray([pos[v] for v in e["dict"]],
-                                      dtype=np.int32)
+        # one remap implementation for the serial and split-parallel paths —
+        # they must stay row-identical by construction
+        remap = pcol_dict_remaps(pf, names, table_dicts)
         for lo in range(0, pf.rows, capacity):
             hi = min(lo + capacity, pf.rows)
             n_rows = hi - lo
@@ -369,6 +364,81 @@ def iter_pcol_pages(path: str, names, type_of, table_dicts, capacity: int,
                 mask = mask & np.pad(prefilter[lo:hi],
                                      (0, capacity - n_rows))
             yield Page(tuple(blocks), mask)
+    finally:
+        pf.close()
+
+
+# CAP on rows per parallel pcol range split: binds only when the target
+# page is larger (the 4M-row accelerator capacity -> 4 ranges per page, so
+# the byte budget has granularity and the reader pool has work items);
+# smaller targets make each range exactly one page
+_RANGE_ROWS = 1 << 20
+
+
+def pcol_dict_remaps(pf: PcolFile, names, table_dicts):
+    """{column: int32 remap array} for columns whose FILE dictionary differs
+    from the TABLE's unioned one. O(dict size) — computed once per file and
+    shared by every range reader of that file."""
+    remaps = {}
+    for cname in names:
+        e = pf.columns.get(cname)
+        td = table_dicts.get(cname)
+        if e is None or "dict" not in e or td is None or \
+                list(e["dict"]) == list(td.values):
+            continue
+        pos = {v: i for i, v in enumerate(td.values)}
+        remaps[cname] = np.asarray([pos[v] for v in e["dict"]],
+                                   dtype=np.int32)
+    return remaps
+
+
+def read_pcol_range_chunk(path: str, names, type_of, table_dicts,
+                          lo: int, hi: int, prefilter_fn=None, remaps=None,
+                          header=None):
+    """Decode rows [lo, hi) of one pcol file into a compacted HostChunk —
+    the read+decode step of the streaming scan pipeline. Opens its own
+    mapping so ranges of one file are readable concurrently; all returned
+    arrays are detached from the mapping before it closes. `prefilter_fn(pf,
+    lo, hi) -> bool mask | None` compacts non-surviving rows away HERE, so
+    they never cost host->HBM bytes. `remaps` (pcol_dict_remaps) carries the
+    per-file dictionary re-encodings, precomputed by the caller; None =
+    derive them here (the self-contained path). `header` likewise shares one
+    parsed file header across the ranges (each range still opens its own
+    mapping so reads stay concurrent)."""
+    from ...ops.scan_pipeline import HostChunk
+
+    pf = PcolFile(path, header=header)
+    try:
+        if remaps is None:
+            remaps = pcol_dict_remaps(pf, names, table_dicts)
+        keep = None
+        if prefilter_fn is not None:
+            pre = prefilter_fn(pf, lo, hi)
+            if pre is not None:
+                keep = np.flatnonzero(pre)
+        cols = []
+        nulls = []
+        for cname in names:
+            data, nl, _d = pf.read_column_range(cname, lo, hi)
+            seg = np.asarray(data)
+            rm = remaps.get(cname)
+            if rm is not None:
+                seg = rm[np.clip(seg.astype(np.int32), 0, len(rm) - 1)]
+                if keep is not None:
+                    seg = seg[keep]
+            elif keep is not None:
+                seg = seg[keep]
+            else:
+                seg = np.array(seg)  # copy off the mapping
+            cols.append(np.ascontiguousarray(seg))
+            if nl is None:
+                nulls.append(None)
+            else:  # read_column_range already copied (astype) off the map
+                nulls.append(nl[keep] if keep is not None else nl)
+        rows = int(len(keep)) if keep is not None else hi - lo
+        return HostChunk.build(cols, nulls,
+                               [type_of[c] for c in names],
+                               [table_dicts.get(c) for c in names], rows)
     finally:
         pf.close()
 
@@ -466,6 +536,48 @@ class FilePageSource(ConnectorPageSource):
         yield from iter_pcol_pages(path, names, type_of, table_dicts,
                                    self.capacity, self._native_prefilter)
 
+    def split_readers(self, target_rows: int):
+        """Row-range split readers (the scan-pipeline SPI): a pcol split
+        decomposes into independently-decodable row ranges read by the
+        shared reader pool. External formats (parquet/orc/rc) decode whole
+        chunks and stay on the serial path (None)."""
+        if len(self.split.payload) != 2:
+            return None
+        try:
+            from ...native import native_available
+            if not native_available():
+                # no native mmap: PcolFile's fallback reads the WHOLE file
+                # (np.fromfile) per open, so per-range readers would each
+                # re-read it — the serial one-open path wins there
+                return None
+        except Exception:
+            return None
+        name, path = self.split.payload
+        info = self._metadata._load(name)
+        table_dicts = {c.name: c.dictionary for c in info.metadata.columns}
+        names = [c.name for c in self.columns]
+        type_of = {c.name: info.metadata.column(c.name).type
+                   for c in self.columns}
+        pf = PcolFile(path)
+        rows = pf.rows
+        # header-derived work (JSON parse, dictionary remaps) hoisted out of
+        # the range readers: once per FILE, not once per row range
+        header = pf.header
+        remaps = pcol_dict_remaps(pf, names, table_dicts)
+        pf.close()
+        from ...formats.pcol import row_ranges
+        step = max(1, min(int(target_rows), _RANGE_ROWS))
+
+        def reader(lo: int, hi: int):
+            def read():
+                yield read_pcol_range_chunk(path, names, type_of,
+                                            table_dicts, lo, hi,
+                                            self._native_prefilter, remaps,
+                                            header)
+            return read
+
+        return [reader(lo, hi) for lo, hi in row_ranges(rows, step)]
+
     def _iter_external(self) -> Iterator[Page]:
         name, path, group = self.split.payload
         info = self._metadata._load(name)
@@ -530,9 +642,13 @@ class FilePageSource(ConnectorPageSource):
             if n == 0:
                 break
 
-    def _native_prefilter(self, pf: PcolFile) -> Optional[np.ndarray]:
+    def _native_prefilter(self, pf: PcolFile, row_lo: int = 0,
+                          row_hi: Optional[int] = None
+                          ) -> Optional[np.ndarray]:
         """AND together pushed-down ranges via libpcol's native scan kernels
-        (skips rows before they ever reach the device)."""
+        (skips rows before they ever reach the device). `row_lo`/`row_hi`
+        restrict the scan to one row range so split-parallel readers only
+        touch their own slice of the mapping."""
         if not self.constraint.domains:
             return None
         try:
@@ -540,6 +656,8 @@ class FilePageSource(ConnectorPageSource):
             lib = libpcol()
         except Exception:
             return None
+        row_hi = pf.rows if row_hi is None else row_hi
+        n = row_hi - row_lo
         mask: Optional[np.ndarray] = None
         for col, dom in self.constraint.domains.items():
             if col not in pf.columns:
@@ -547,7 +665,7 @@ class FilePageSource(ConnectorPageSource):
             lo, hi = dom if isinstance(dom, tuple) else (None, None)
             if lo is None and hi is None:
                 continue
-            data, nulls, _ = pf.read_column(col)
+            data, nulls, _ = pf.read_column_range(col, row_lo, row_hi)
             if data.dtype == np.int64:
                 fn = lib.pcol_filter_range_i64
             elif data.dtype == np.int32:
@@ -555,7 +673,7 @@ class FilePageSource(ConnectorPageSource):
             else:
                 continue
             if mask is None:
-                mask = np.ones(pf.rows, dtype=np.uint8)
+                mask = np.ones(n, dtype=np.uint8)
             c = np.ascontiguousarray(data)
             fn(c.ctypes.data, len(c),
                np.iinfo(np.int64).min if lo is None else int(lo),

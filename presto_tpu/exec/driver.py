@@ -124,7 +124,18 @@ class Driver:
             self._closed = True
 
     def run_to_completion(self, poll_sleep_s: float = 0.001) -> None:
-        """Convenience for tests/benchmarks: drive until FINISHED."""
+        """Convenience for tests/benchmarks: drive until FINISHED.
+
+        Blocked waits re-arm through the shared cluster/retry.Backoff
+        (jittered exponential, capped) instead of a fixed-interval sleep —
+        a parked driver must not burn the host CPU the scan pipeline's
+        decode pool needs."""
+        from ..cluster.retry import Backoff
+
+        # floor the delay: poll_sleep_s=0 would otherwise degenerate to a
+        # GIL-hogging pure spin (Backoff skips a zero-delay sleep entirely)
+        backoff = Backoff(initial_delay_s=max(poll_sleep_s, 1e-4),
+                          max_delay_s=0.02)
         while True:
             state = self.process()
             if state == ProcessState.FINISHED:
@@ -132,4 +143,6 @@ class Driver:
             if state == ProcessState.BLOCKED:
                 b = self.blocked_on()
                 while b is not None and not b():
-                    time.sleep(poll_sleep_s)
+                    backoff.failure()
+                    backoff.wait()
+                backoff.success()

@@ -125,7 +125,11 @@ def _extract_constraint(filter_parts, scan: TableScanNode) -> Constraint:
         else:
             cur[1] = b if cur[1] is None else min(cur[1], b)
 
-    for part in filter_parts:
+    # flatten AND trees first: a multi-conjunct WHERE arrives as ONE nested
+    # conjunction, and every conjunct may contribute a domain bound (Q6's
+    # shipdate/discount/quantity ranges drive both split pruning and the
+    # scan pipeline's native pre-filter compaction)
+    for part in (c for fp in filter_parts for c in split_and(fp)):
         if not isinstance(part, Call) or len(part.args) != 2:
             continue
         a, b = part.args
@@ -175,6 +179,20 @@ class _ConcatPageSource(ConnectorPageSource):
         if any(t is None for t in toks):
             return None
         return ("concat",) + toks
+
+    def split_readers(self, target_rows: int):
+        """Concatenated split decomposition (scan-pipeline SPI): the child
+        streams' range readers in stream order — re-batching then fills
+        device-shaped pages ACROSS file boundaries. All-or-nothing: one
+        child without split support keeps the whole concat serial, so
+        output order always matches serial iteration."""
+        out = []
+        for s in self.sources:
+            rs = s.split_readers(target_rows)
+            if rs is None:
+                return None
+            out.extend(rs)
+        return out
 
     def close(self) -> None:
         for s in self.sources:
@@ -275,6 +293,19 @@ class LocalExecutionPlanner:
         from ..metadata import default_page_capacity
         cap = session.get("page_capacity")
         self.page_capacity = int(cap) if cap else default_page_capacity()
+        # streaming scan pipeline knobs (ops/scan_pipeline.py), resolved once
+        # per fragment. target rows default to the canonical page capacity so
+        # every scan feeds kernels ONE shape; 0/None knobs fall through to
+        # ScanPipeline's engine defaults (single source of truth)
+        threads = session.get("scan_reader_threads")
+        rows = session.get("scan_target_page_rows")
+        self.scan_options = {
+            "rebatch": bool(session.get("scan_pipeline", True)),
+            "reader_threads": int(threads) if threads else None,
+            "target_rows": int(rows) if rows else self.page_capacity,
+            "prefetch_bytes": int(session.get("scan_prefetch_bytes") or 0)
+            or None,
+        }
         self.n_workers = n_workers
         # grouped (lifespan) execution: restrict every scan to this bucket's
         # splits (exec/grouped.py drives one planner per lifespan)
@@ -324,11 +355,13 @@ class LocalExecutionPlanner:
                 for fac in pipeline:
                     fac.memory_ctx = mem
                     fac.revoke_check = check
-        if self.devices is not None:
-            for pipeline in self.pipelines:
-                for fac in pipeline:
-                    if isinstance(fac, TableScanOperatorFactory):
+        for pipeline in self.pipelines:
+            for fac in pipeline:
+                if isinstance(fac, TableScanOperatorFactory):
+                    if self.devices is not None:
                         fac.devices = self.devices
+                    if fac.scan_options is None:
+                        fac.scan_options = self.scan_options
         return LocalExecutionPlan(self.pipelines, sink, root.column_names,
                                   [s.type for s in chain.symbols],
                                   list(chain.dicts), self.remote_slots)

@@ -91,6 +91,13 @@ def _pad(n: int) -> int:
     return (n + _ALIGN - 1) // _ALIGN * _ALIGN
 
 
+def row_ranges(rows: int, step: int) -> List[Tuple[int, int]]:
+    """[lo, hi) row splits at `step` granularity — the unit of the
+    split-parallel pcol read (each range is decoded independently)."""
+    step = max(int(step), 1)
+    return [(lo, min(lo + step, rows)) for lo in range(0, rows, step)]
+
+
 def _native_stats(arr: np.ndarray):
     """Column min/max via libpcol when available (bandwidth-bound native
     loop), else numpy."""
@@ -175,9 +182,13 @@ def write_pcol(path: str, names: Sequence[str], types: Sequence[Type],
 
 
 class PcolFile:
-    """Reader: native mmap when available, else a host read."""
+    """Reader: native mmap when available, else a host read.
 
-    def __init__(self, path: str):
+    `header` short-circuits the JSON header parse with an already-parsed
+    one — split-parallel range readers of one file open their own mapping
+    each but share a single parse (a dict-heavy header can be megabytes)."""
+
+    def __init__(self, path: str, header: Optional[Dict] = None):
         self.path = path
         self._map = None
         self._lib = None
@@ -197,7 +208,8 @@ class PcolFile:
             self._buf = np.fromfile(path, dtype=np.uint8)
         assert bytes(self._buf[:6]) == MAGIC, f"{path}: not a pcol file"
         hlen = int(np.frombuffer(self._buf[6:10], dtype=np.uint32)[0])
-        self.header = json.loads(bytes(self._buf[10:10 + hlen]))
+        self.header = header if header is not None \
+            else json.loads(bytes(self._buf[10:10 + hlen]))
         self.rows = self.header["rows"]
         self._data_start = _pad(10 + hlen)
         self.columns = {e["name"]: e for e in self.header["columns"]}
@@ -223,6 +235,23 @@ class PcolFile:
             nlo = self._data_start + e["nulls_offset"]
             nulls = self._buf[nlo: nlo + self.rows].view(np.uint8) \
                 .astype(bool)
+        d = Dictionary(e["dict"]) if "dict" in e else None
+        return data, nulls, d
+
+    def read_column_range(self, name: str, lo: int, hi: int):
+        """Rows [lo, hi) of one column: (data view, bool null mask or None,
+        Dictionary or None). Chunks are raw aligned arrays, so a row range
+        is a byte range — the split-parallel scan reads ranges of ONE file
+        concurrently without touching the rest of the mapping."""
+        e = self.columns[name]
+        dt = np.dtype(e["dtype"])
+        base = self._data_start + e["offset"]
+        data = self._buf[base + lo * dt.itemsize:
+                         base + hi * dt.itemsize].view(dt)
+        nulls = None
+        if "nulls_offset" in e:
+            nlo = self._data_start + e["nulls_offset"]
+            nulls = self._buf[nlo + lo: nlo + hi].view(np.uint8).astype(bool)
         d = Dictionary(e["dict"]) if "dict" in e else None
         return data, nulls, d
 

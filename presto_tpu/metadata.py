@@ -58,6 +58,22 @@ class Session:
         # stateful operators (ops/coalesce.py) — downstream kernel work and
         # per-page dispatches then scale with selectivity
         "coalesce_pages": True,
+        # --- streaming scan pipeline (ops/scan_pipeline.py) ---
+        # staged host->HBM ingest: split-parallel readers -> ordered
+        # re-batch into device-shaped pages -> async upload. False =
+        # single-reader passthrough (pages keep their source shapes)
+        "scan_pipeline": True,
+        # reader pool size per scan driver; 0 = engine default
+        # (scan_pipeline.DEFAULT_READER_THREADS: min(8, host cores))
+        "scan_reader_threads": 0,
+        # re-batched page rows; 0 = the session page_capacity (canonical
+        # device shape: kernels see ONE large static shape per schema)
+        "scan_target_page_rows": 0,
+        # in-flight byte bound per scan, applied to BOTH the decoded host
+        # staging and the uploaded-but-unconsumed device pages — bounding
+        # bytes (not page count) lets prefetch depth adapt to page size;
+        # 0 = engine default (scan_pipeline.DEFAULT_PREFETCH_BYTES, 256MB)
+        "scan_prefetch_bytes": 0,
         # --- cluster fault tolerance (cluster/retry.py) ---
         # NONE fails fast; QUERY re-plans + re-runs the whole query on
         # retryable failures (failed nodes excluded from placement); TASK

@@ -34,40 +34,60 @@ class LocalExchangeBuffer:
     pipelines run under the task executor — a sequentially-driven producer
     with no concurrent consumer must never deadlock on a full buffer."""
 
-    def __init__(self, n_producers: int, max_pages: int = 0):
+    def __init__(self, n_producers: int, max_pages: int = 0,
+                 deal_slots: int = 0):
         self._pages: List[Page] = []
         self._lock = threading.Lock()
         self._open_producers = n_producers
         self.max_pages = max_pages
         self.rows_in = 0
+        # deal_slots > 0: pages are DEALT round-robin to that many consumer
+        # slots instead of work-stolen from one shared list — the
+        # reference's unpartitioned writer exchange, where every scaled
+        # writer must receive a share regardless of scheduling order (a
+        # fast prefetching producer would otherwise let early consumers
+        # drain everything before late ones start)
+        self.deal_slots = deal_slots
+        self._dealt: List[List[Page]] = [[] for _ in range(deal_slots)]
+        self._deal_next = 0
 
     def put(self, page: Page) -> None:
         with self._lock:
-            self._pages.append(page)
+            if self.deal_slots:
+                self._dealt[self._deal_next].append(page)
+                self._deal_next = (self._deal_next + 1) % self.deal_slots
+            else:
+                self._pages.append(page)
+
+    def _buffered(self) -> int:
+        return len(self._pages) + sum(len(d) for d in self._dealt)
 
     def has_room(self) -> bool:
         if self.max_pages <= 0:
             return True
         with self._lock:
-            return len(self._pages) < self.max_pages
+            return self._buffered() < self.max_pages
 
     def producer_finished(self) -> None:
         with self._lock:
             self._open_producers -= 1
 
-    def poll(self) -> Optional[Page]:
+    def poll(self, slot: Optional[int] = None) -> Optional[Page]:
         with self._lock:
-            if self._pages:
-                return self._pages.pop(0)
+            pages = self._dealt[slot] if slot is not None else self._pages
+            if pages:
+                return pages.pop(0)
             return None
 
-    def is_done(self) -> bool:
+    def is_done(self, slot: Optional[int] = None) -> bool:
         with self._lock:
-            return not self._pages and self._open_producers <= 0
+            pages = self._dealt[slot] if slot is not None else self._pages
+            return not pages and self._open_producers <= 0
 
-    def has_output(self) -> bool:
+    def has_output(self, slot: Optional[int] = None) -> bool:
         with self._lock:
-            return bool(self._pages) or self._open_producers <= 0
+            pages = self._dealt[slot] if slot is not None else self._pages
+            return bool(pages) or self._open_producers <= 0
 
 
 class LocalExchangeSink(Operator):
@@ -119,10 +139,12 @@ class LocalExchangeSource(Operator):
     while producers are still running and no page is ready."""
 
     def __init__(self, context: OperatorContext, buffer: LocalExchangeBuffer,
-                 types: List[Type]):
+                 types: List[Type], slot: Optional[int] = None):
         super().__init__(context)
         self.buffer = buffer
         self._types = types
+        self._slot = slot  # dealt-mode consumer slot; None = work stealing
+        self._ready = lambda: buffer.has_output(slot)
 
     @property
     def output_types(self) -> List[Type]:
@@ -135,41 +157,56 @@ class LocalExchangeSource(Operator):
         raise RuntimeError("local exchange source takes no input")
 
     def is_blocked(self):
-        if self.buffer.has_output():
+        if self.buffer.has_output(self._slot):
             return None
-        return self.buffer.has_output  # poll-able future
+        return self._ready  # poll-able future
 
     @timed("get_output_ns")
     def get_output(self) -> Optional[Page]:
-        page = self.buffer.poll()
+        page = self.buffer.poll(self._slot)
         if page is not None:
             self.context.record_output(page, page.capacity)
         return page
 
     def is_finished(self) -> bool:
-        return self._finishing or self.buffer.is_done()
+        return self._finishing or self.buffer.is_done(self._slot)
 
 
 class LocalExchangeFactory:
     """One per pipeline cut; builds per-worker buffers shared by the sink and
     source factories (a worker's producers feed only that worker's consumer)."""
 
-    def __init__(self, n_producers: int, max_pages: int = 0):
+    def __init__(self, n_producers: int, max_pages: int = 0,
+                 deal_slots: int = 0):
         self.n_producers = n_producers
         # soft bound on buffered pages (0 = unbounded): pass e.g.
         # 2 * n_producers when the pipelines run under the task executor so N
         # fast producers cannot grow HBM-resident pages without limit
         self.max_pages = max_pages
+        # deal_slots > 0: round-robin dealing to that many consumers (the
+        # scaled-writers distribution); 0 = shared-list work stealing
+        self.deal_slots = deal_slots
         self._buffers = {}
+        self._next_slot = {}
         self._lock = threading.Lock()
 
     def buffer(self, worker: int) -> LocalExchangeBuffer:
         with self._lock:
             b = self._buffers.get(worker)
             if b is None:
-                b = LocalExchangeBuffer(self.n_producers, self.max_pages)
+                b = LocalExchangeBuffer(self.n_producers, self.max_pages,
+                                        self.deal_slots)
                 self._buffers[worker] = b
             return b
+
+    def next_slot(self, worker: int) -> Optional[int]:
+        """Dealt-mode consumer slot assignment, in creation order."""
+        if not self.deal_slots:
+            return None
+        with self._lock:
+            slot = self._next_slot.get(worker, 0)
+            self._next_slot[worker] = (slot + 1) % self.deal_slots
+            return slot
 
 
 class LocalExchangeSinkFactory(OperatorFactory):
@@ -193,4 +230,5 @@ class LocalExchangeSourceFactory(OperatorFactory):
 
     def create_operator(self, worker: int = 0) -> Operator:
         return LocalExchangeSource(self.context(worker),
-                                   self.exchange.buffer(worker), self.types)
+                                   self.exchange.buffer(worker), self.types,
+                                   slot=self.exchange.next_slot(worker))

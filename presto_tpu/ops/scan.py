@@ -10,14 +10,16 @@ TPU-first design of the host→HBM boundary (the streaming-scan wall):
   scan widens back to each block's declared type ON DEVICE, inside the same
   jitted program as the filter/projections, so the narrow form only exists on
   the wire;
-- a prefetch thread walks the page source and issues the (async) uploads ahead
-  of the driver, double-buffering host generation/IO against device compute —
-  the role `isBlocked` futures play in the reference's ScanFilterAndProject
-  laziness (operator/Driver.java:347-434 overlap of IO and compute).
+- the staged scan pipeline (ops/scan_pipeline.py) walks the page source ahead
+  of the driver: split-parallel readers decode row ranges concurrently,
+  chunks re-batch into canonical device-shaped pages, and a dedicated upload
+  stage issues async `jax.device_put`s under a bytes-bounded budget — the
+  role `isBlocked` futures play in the reference's ScanFilterAndProject
+  laziness (operator/Driver.java:347-434 overlap of IO and compute), deepened
+  into a real pipeline.
 """
 from __future__ import annotations
 
-import queue
 import threading
 from typing import Iterator, List, Optional
 
@@ -29,8 +31,7 @@ from ..spi.connector import ConnectorPageSource
 from ..types import Type
 from .filter_project import PageProcessor
 from .operator import Operator, OperatorContext, OperatorFactory, timed
-
-_SENTINEL = object()
+from .scan_pipeline import ScanPipeline, page_nbytes
 
 
 class _ResidentPageCache:
@@ -50,11 +51,9 @@ class _ResidentPageCache:
         self._bytes = 0
         self._lock = threading.Lock()
 
-    @staticmethod
-    def _page_bytes(page: Page) -> int:
-        n = sum(b.data.nbytes + (b.nulls.nbytes if b.nulls is not None else 0)
-                for b in page.blocks)
-        return n + page.mask.nbytes
+    # one page-size formula engine-wide: cache eviction and the scan
+    # pipeline's byte-budget backpressure must never disagree
+    _page_bytes = staticmethod(page_nbytes)
 
     def get(self, token):
         with self._lock:
@@ -111,67 +110,11 @@ def _widen_page(page: Page) -> Page:
 _widen_jit = jax.jit(_widen_page)
 
 
-class _Prefetcher:
-    """Walks a page source on a daemon thread, uploading pages ahead of the
-    consumer. Depth bounds in-flight host+device memory; errors surface on the
-    consuming thread."""
-
-    def __init__(self, source: ConnectorPageSource, device, depth: int = 2):
-        self._source = source
-        self._device = device
-        self._q: queue.Queue = queue.Queue(maxsize=depth)
-        self._stop = threading.Event()
-        self._thread = threading.Thread(target=self._run, daemon=True)
-        self._thread.start()
-
-    def _run(self):
-        try:
-            for page in self._source:
-                if self._stop.is_set():
-                    return
-                page = jax.tree.map(
-                    lambda a: jax.device_put(a, self._device), page)
-                while not self._stop.is_set():
-                    try:
-                        self._q.put(page, timeout=0.1)
-                        break
-                    except queue.Full:
-                        continue
-        except BaseException as e:  # noqa: BLE001 - re-raised by next()
-            self._put_forever(("error", e))
-            return
-        self._put_forever(_SENTINEL)
-
-    def _put_forever(self, item):
-        while not self._stop.is_set():
-            try:
-                self._q.put(item, timeout=0.1)
-                return
-            except queue.Full:
-                continue
-
-    def next(self) -> Optional[Page]:
-        item = self._q.get()
-        if item is _SENTINEL:
-            return None
-        if isinstance(item, tuple) and len(item) == 2 and item[0] == "error":
-            raise item[1]
-        return item
-
-    def close(self):
-        self._stop.set()
-        # drain so a blocked producer can observe the stop flag and exit
-        while True:
-            try:
-                self._q.get_nowait()
-            except queue.Empty:
-                break
-
-
 class TableScanOperator(Operator):
     def __init__(self, context: OperatorContext, source: ConnectorPageSource,
                  types: List[Type], processor: Optional[PageProcessor] = None,
-                 device=None, ready=None, process_fn=None, prefetch: bool = True):
+                 device=None, ready=None, process_fn=None, prefetch: bool = True,
+                 scan_options: Optional[dict] = None):
         super().__init__(context)
         self.source = source
         self._types = types
@@ -181,7 +124,11 @@ class TableScanOperator(Operator):
         self._ready = ready  # None = always ready; else poll before reading
         self._done = False
         self._prefetch_enabled = prefetch
-        self._prefetcher: Optional[_Prefetcher] = None
+        # session-resolved pipeline knobs (exec/local_planner): reader pool
+        # size, re-batch target rows, in-flight byte bound, rebatch on/off
+        self._scan_options = scan_options or {}
+        self._pipeline: Optional[ScanPipeline] = None
+        self._pipeline_stats: Optional[dict] = None
         self._iter: Optional[Iterator[Page]] = None
         # device-resident replay: a deterministic source's uploaded pages are
         # cached across queries (see _ResidentPageCache); keyed by target
@@ -224,9 +171,19 @@ class TableScanOperator(Operator):
         if self._replay is not None:
             return next(self._replay, None)
         if self._prefetch_enabled:
-            if self._prefetcher is None:
-                self._prefetcher = _Prefetcher(self.source, self.device)
-            page = self._prefetcher.next()
+            if self._pipeline is None:
+                # None/0 thread/byte knobs fall through to ScanPipeline's
+                # engine defaults; target_rows has NO default — without a
+                # planner-resolved page capacity the pipeline runs the
+                # passthrough path (source page shapes, no split fan-out)
+                opts = self._scan_options
+                self._pipeline = ScanPipeline(
+                    self.source, self.device,
+                    reader_threads=opts.get("reader_threads"),
+                    target_rows=opts.get("target_rows"),
+                    prefetch_bytes=opts.get("prefetch_bytes"),
+                    rebatch=bool(opts.get("rebatch", True)))
+            page = self._pipeline.next()
         else:
             if self._iter is None:
                 self._iter = iter(self.source)
@@ -275,10 +232,21 @@ class TableScanOperator(Operator):
     def is_finished(self) -> bool:
         return self._done or self._finishing
 
+    def pipeline_stats(self) -> Optional[dict]:
+        """Per-stage busy/stall seconds of this scan's pipeline (None when
+        the scan replayed resident pages or ran the serial path). Survives
+        close() so the runner can roll it into QueryResult.stats."""
+        if self._pipeline is not None:
+            return self._pipeline.stats()
+        return self._pipeline_stats
+
     def close(self) -> None:
-        if self._prefetcher is not None:
-            self._prefetcher.close()
-            self._prefetcher = None
+        if self._pipeline is not None:
+            self._pipeline_stats = self._pipeline.stats()
+            # stops every stage and JOINS the threads (bounded) — a producer
+            # mid jax.device_put must never race interpreter teardown
+            self._pipeline.close()
+            self._pipeline = None
         super().close()
 
 
@@ -297,6 +265,9 @@ class TableScanOperatorFactory(OperatorFactory):
         # worker w's pages live on mesh device w and downstream fragment
         # chains stay device-resident; None = default device)
         self.devices = None
+        # scan-pipeline knobs resolved from the session by the planner
+        # (None = ScanPipeline defaults for directly-constructed factories)
+        self.scan_options = None
         if callable(page_sources):
             self._sources_fn = page_sources
         else:
@@ -351,4 +322,5 @@ class TableScanOperatorFactory(OperatorFactory):
                                  self._processor, device=device,
                                  ready=self._ready(worker) if self._ready else None,
                                  process_fn=self._process_fn,
-                                 prefetch=self._prefetch)
+                                 prefetch=self._prefetch,
+                                 scan_options=self.scan_options)

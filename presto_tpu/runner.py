@@ -54,9 +54,26 @@ class QueryResult:
     rows: List[list]
     column_names: List[str]
     types: Optional[List] = None  # output Type objects when the engine knows them
-    # cluster-tier execution stats (query/task attempts, retries, faults
-    # injected, backoff time) — None for purely local execution
+    # execution stats: cluster tier adds query/task attempts, retries, faults
+    # injected, backoff time; the local tier adds the streaming scan
+    # pipeline's per-stage busy/stall breakdown under "scan_pipeline".
+    # None when there is nothing to report.
     stats: Optional[dict] = None
+
+
+def _scan_pipeline_stats(drivers) -> Optional[dict]:
+    """Roll every scan's pipeline stage breakdown (ops/scan_pipeline.py) up
+    to one query-level dict — the wall-clock attribution bench rounds read."""
+    agg: Dict[str, float] = {}
+    for d in drivers:
+        for op in d.operators:
+            fn = getattr(op, "pipeline_stats", None)
+            s = fn() if fn is not None else None
+            if not s:
+                continue
+            for k, v in s.items():
+                agg[k] = round(agg.get(k, 0) + v, 6)
+    return agg or None
 
 
 class LocalQueryRunner:
@@ -215,16 +232,23 @@ class LocalQueryRunner:
         if g is not None:
             self.last_grouped = g.bucket_count
             results, names, types = [], None, None
+            scan_stats: Dict[str, float] = {}
             for b in range(g.bucket_count):
-                exec_plan, _d, _w = self._run_plan(plan, bucket_filter=b)
+                exec_plan, drivers, _w = self._run_plan(plan, bucket_filter=b)
                 results.append(exec_plan.sink.rows())
                 names = exec_plan.output_names
                 types = exec_plan.output_types
-            return QueryResult(merge_rows(results, g), names, types)
+                for k, v in (_scan_pipeline_stats(drivers) or {}).items():
+                    scan_stats[k] = round(scan_stats.get(k, 0) + v, 6)
+            return QueryResult(merge_rows(results, g), names, types,
+                               stats={"scan_pipeline": scan_stats}
+                               if scan_stats else None)
 
-        exec_plan, _drivers, _wall = self._run_plan(plan)
+        exec_plan, drivers, _wall = self._run_plan(plan)
+        scan = _scan_pipeline_stats(drivers)
         return QueryResult(exec_plan.sink.rows(), exec_plan.output_names,
-                           exec_plan.output_types)
+                           exec_plan.output_types,
+                           stats={"scan_pipeline": scan} if scan else None)
 
     def _execute_write(self, stmt) -> QueryResult:
         """CTAS / INSERT / DROP: plan the source query, swap the result sink
@@ -372,8 +396,12 @@ class LocalQueryRunner:
             from .ops.local_exchange import (LocalExchangeFactory,
                                              LocalExchangeSinkFactory,
                                              LocalExchangeSourceFactory)
+            # pages are DEALT round-robin over the writers: every writer
+            # must get a share (and write a file) no matter how fast the
+            # scan pipeline bursts pages into the buffer
             lx = LocalExchangeFactory(n_producers=1,
-                                      max_pages=2 * n_writers + 2)
+                                      max_pages=2 * n_writers + 2,
+                                      deal_slots=n_writers)
             exec_plan.pipelines[-1] = exec_plan.pipelines[-1][:-1] + \
                 [LocalExchangeSinkFactory(9002, lx, [])]
             for _ in range(n_writers):

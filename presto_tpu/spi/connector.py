@@ -116,6 +116,15 @@ class ConnectorPageSource(abc.ABC):
     def completed_bytes(self) -> int:
         return 0
 
+    def split_readers(self, target_rows: int):
+        """Optional scan-pipeline decomposition: a list of zero-arg callables,
+        each returning an iterable of `ops.scan_pipeline.HostChunk`s for one
+        independently-readable row range, in stream order. The streaming scan
+        reads them concurrently on a shared reader pool and re-batches the
+        chunks into device-shaped pages (order-preserving). None = this
+        source only supports serial page iteration."""
+        return None
+
     @property
     def cache_token(self) -> Optional[tuple]:
         """Hashable identity of a DETERMINISTIC, IMMUTABLE page stream, or None.

@@ -32,6 +32,15 @@ class MetricsRegistry:
         with self._lock:
             self._counters[name] = self._counters.get(name, 0) + delta
 
+    def count_many(self, values: Dict[str, float], prefix: str = "") -> None:
+        """Batch counter update under ONE lock acquisition — the scan
+        pipeline flushes a whole stage-stat dict per stream this way."""
+        with self._lock:
+            for k, v in values.items():
+                if v:
+                    name = prefix + k
+                    self._counters[name] = self._counters.get(name, 0) + v
+
     def set_gauge(self, name: str, fn: Callable[[], float]) -> None:
         with self._lock:
             self._gauges[name] = fn

@@ -21,7 +21,7 @@ from tools.prestocheck import (all_pass_ids, load_baseline, run,  # noqa: E402
 
 EXPECTED_PASSES = {"undefined-name", "tracer-safety", "lock-discipline",
                    "exception-hygiene", "retry-discipline",
-                   "mutable-default-args"}
+                   "mutable-default-args", "sleep-poll"}
 
 
 def _scan(tmp_path, source, select=None, name="mod.py"):
@@ -323,6 +323,76 @@ def test_retry_discipline_flags_adhoc_loop_not_backoff(tmp_path):
         """, select=["retry-discipline"])
     assert len(findings) == 2, _messages(findings)
     assert {f.line for f in findings} == {6, 13}
+
+
+# ----------------------------------------------------------------- sleep-poll
+
+def test_sleep_poll_flags_fixed_interval_polling_loop(tmp_path):
+    findings = _scan(tmp_path, """
+        import time
+
+        def busy_poll(blocked_on):
+            b = blocked_on()
+            while b is not None and not b():
+                time.sleep(0.001)      # the driver.run_to_completion bug
+
+        def backed_off(blocked_on, backoff):
+            b = blocked_on()
+            while b is not None and not b():
+                backoff.failure()
+                backoff.wait()
+
+        def parked(event):
+            while not event.is_set():
+                event.wait(0.1)        # sanctioned: condition/event wait
+        """, select=["sleep-poll"])
+    assert len(findings) == 1, _messages(findings)
+    assert findings[0].line == 6
+
+
+def test_sleep_poll_exempts_retry_streaming_and_inner_loops(tmp_path):
+    findings = _scan(tmp_path, """
+        import time
+        import urllib.request
+
+        def retry(url):
+            while True:
+                try:                   # retry-discipline's domain, not ours
+                    return urllib.request.urlopen(url).read()
+                except OSError:
+                    time.sleep(1.0)
+
+        def stream(client):
+            while True:
+                yield client.poll()    # pacing an external peer
+                time.sleep(0.5)
+
+        def nested(jobs):
+            for j in jobs:             # only the INNER loop is the poll site
+                while not j.done():
+                    time.sleep(0.01)
+
+        def inner_wait_no_excuse(jobs, flag):
+            while not flag:            # OUTER sleep still flagged: the
+                for j in jobs:         # inner loop's wait() is not ITS wait
+                    j.cond.wait(0.1)
+                time.sleep(0.5)
+        """, select=["sleep-poll"])
+    assert len(findings) == 2, _messages(findings)
+    # the nested inner while, and the outer loop whose own sleep is not
+    # excused by a sanctioned wait inside a nested loop
+    assert {f.line for f in findings} == {19, 23}
+
+
+def test_sleep_poll_suppression(tmp_path):
+    findings = _scan(tmp_path, """
+        import time
+
+        def poll(flag):
+            while not flag:  # prestocheck: ignore[sleep-poll]
+                time.sleep(0.5)
+        """, select=["sleep-poll"])
+    assert findings == []
 
 
 # ------------------------------------------------------- mutable-default-args

@@ -4,4 +4,5 @@ from . import tracer_safety  # noqa: F401
 from . import lock_discipline  # noqa: F401
 from . import exception_hygiene  # noqa: F401
 from . import retry_discipline  # noqa: F401
+from . import sleep_poll  # noqa: F401
 from . import mutable_defaults  # noqa: F401

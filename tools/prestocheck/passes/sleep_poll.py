@@ -1,0 +1,82 @@
+"""sleep-poll: fixed-interval ``time.sleep`` polling loops.
+
+The streaming-scan PR fixed ``exec/driver.run_to_completion`` busy-polling
+``blocked_on()`` at a fixed 1ms sleep — a parked driver was burning the host
+CPU the scan pipeline's decode pool needs. This pass keeps the pattern from
+reappearing: a loop that spins on a condition with a constant ``time.sleep``
+should re-arm through ``cluster/retry.Backoff`` (jittered exponential,
+accounted) or park on an event/condition wait.
+
+Detection: a ``while``/``for`` loop whose body directly calls ``time.sleep``
+(a sleep inside a NESTED loop is attributed to that inner loop, so one poll
+site yields one finding), with no reference to a backoff object and no
+``.wait(...)`` call (Event/Condition/Backoff waits are the sanctioned
+parking primitives). Loops containing a ``try`` are retry loops — the
+``retry-discipline`` pass's domain — and loops that ``yield`` are streaming
+protocols pacing an external peer (e.g. the HTTP client's nextUri poll),
+not host-side busy-waits; both are exempt. Detection and exemption both
+look only at the loop's DIRECT body (nested loops/functions excluded), so
+an inner loop's sanctioned wait never excuses an outer loop's own sleep.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..core import Finding, Module, Pass, dotted_name, register
+
+_BARRIERS = (ast.While, ast.For, ast.FunctionDef, ast.AsyncFunctionDef,
+             ast.Lambda, ast.ClassDef)
+
+
+def _direct_body(loop: ast.AST):
+    """Nodes of `loop`'s body NOT inside a nested loop/function/class — both
+    the sleep detection and the exemptions look only here, so an inner
+    loop's .wait() can never excuse the outer loop's own fixed sleep."""
+    stack = list(ast.iter_child_nodes(loop))
+    while stack:
+        n = stack.pop()
+        if isinstance(n, _BARRIERS):
+            continue
+        yield n
+        stack.extend(ast.iter_child_nodes(n))
+
+
+def _directly_sleeps(loop: ast.AST) -> bool:
+    return any(isinstance(n, ast.Call) and dotted_name(n.func) == "time.sleep"
+               for n in _direct_body(loop))
+
+
+def _exempt(loop: ast.AST) -> bool:
+    for sub in _direct_body(loop):
+        if isinstance(sub, ast.Try):
+            return True  # retry loop: retry-discipline's domain
+        if isinstance(sub, (ast.Yield, ast.YieldFrom)):
+            return True  # streaming protocol pacing an external peer
+        if isinstance(sub, ast.Call) and \
+                isinstance(sub.func, ast.Attribute) and sub.func.attr == "wait":
+            return True  # Event/Condition/Backoff parking
+        if isinstance(sub, ast.Name) and "backoff" in sub.id.lower():
+            return True
+        if isinstance(sub, ast.Attribute) and "backoff" in sub.attr.lower():
+            return True
+    return False
+
+
+@register
+class SleepPollPass(Pass):
+    id = "sleep-poll"
+    description = ("fixed time.sleep polling loop — re-arm through "
+                   "cluster/retry.Backoff or park on an event wait")
+
+    def check_module(self, module: Module):
+        for loop in ast.walk(module.tree):
+            if not isinstance(loop, (ast.While, ast.For)):
+                continue
+            if not _directly_sleeps(loop) or _exempt(loop):
+                continue
+            kind = "while" if isinstance(loop, ast.While) else "for"
+            yield Finding(
+                module.path, loop.lineno, loop.col_offset, self.id,
+                f"fixed time.sleep polling {kind}-loop — use "
+                "cluster/retry.Backoff (jitter, accounting) or an "
+                "event/condition wait")
