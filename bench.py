@@ -220,7 +220,8 @@ class _CompileCounter:
 
 def bench_sql_query(query_id: int, schema: str, seconds_budget: float,
                     escalate_to: str = None, escalate_budget_s: float = 30.0,
-                    escalate_ratio: float = 100.0):
+                    escalate_ratio: float = 100.0,
+                    compare_unfused: bool = False):
     """One rung of the SQL ladder: the FULL engine path (parse -> plan ->
     optimize -> drivers), the presto-benchmark BenchmarkSuite pattern run
     through LocalQueryRunner rather than hand-built pipelines — rung numbers
@@ -245,21 +246,45 @@ def bench_sql_query(query_id: int, schema: str, seconds_budget: float,
         with _CompileCounter() as cc:
             rows0 = len(runner.execute(sql).rows)  # warm-up compiles kernels
         compile_wall = time.time() - t0
-        runs, t0 = 0, time.time()
+        runs, t0, last = 0, time.time(), None
         while True:
-            runner.execute(sql)
+            last = runner.execute(sql)
             runs += 1
             if time.time() - t0 > seconds_budget or runs >= 3:
                 break
         wall = (time.time() - t0) / runs
         src_rows = hq.source_rows(f"q{query_id}", sch)
-        return {"schema": sch,
-                "rows_per_sec": round(src_rows / wall),
-                "source_rows": src_rows,
-                "wall_s": round(wall, 3),
-                "first_run_s": round(compile_wall, 3),
-                "kernel_compiles": cc.n,
-                "output_rows": rows0}
+        out = {"schema": sch,
+               "rows_per_sec": round(src_rows / wall),
+               "source_rows": src_rows,
+               "wall_s": round(wall, 3),
+               "first_run_s": round(compile_wall, 3),
+               "kernel_compiles": cc.n,
+               "output_rows": rows0}
+        # fused-segment observability: per-segment dispatch/compile counts
+        # of the LAST timed run (exec/local_planner segment compiler)
+        seg = (last.stats or {}).get("segments") if last is not None else None
+        if seg:
+            out["segments"] = {
+                "count": seg["count"], "dispatches": seg["dispatches"],
+                "compiles": seg["compiles"],
+                "fused": [s["operators"] for s in seg["segments"]]}
+        return out
+
+    def unfused_wall(sch):
+        """One warm per-operator run at `sch` (global kernel/resident caches
+        keep a fresh runner warm): the fusion speedup denominator. Runs only
+        for the FINALLY-reported schema — measuring it pre-escalation would
+        pay the unfused compile set twice for a discarded number."""
+        runner = LocalQueryRunner(session=Session(
+            catalog="tpch", schema=sch).with_properties(segment_fusion=False))
+        try:
+            runner.execute(sql)  # compile/warm the per-operator kernels
+            t0 = time.time()
+            runner.execute(sql)
+            return {"unfused_wall_s": round(time.time() - t0, 3)}
+        except Exception as e:
+            return {"unfused_error": repr(e)[:200]}
 
     out = measure(schema)
     # the escalated schema costs ~(warm-up + >=1 timed run + recompile
@@ -285,6 +310,8 @@ def bench_sql_query(query_id: int, schema: str, seconds_budget: float,
             out = escalated
         except Exception as e:  # keep the small-schema number
             out["escalate_error"] = repr(e)[:200]
+    if compare_unfused:
+        out.update(unfused_wall(out["schema"]))
     return out
 
 
@@ -443,16 +470,20 @@ def main():
     # would only measure dispatch overhead); on the CPU fallback, tiny with
     # escalation so a slow environment never blows the round's time budget
     rung_budget = 5.0 if args.quick else 15.0
-    for rung, qid in (("q6", 6), ("q3", 3)):
+    for rung, qid in (("q6", 6), ("q1", 1), ("q3", 3)):
+        # q1/q3 additionally record per-segment dispatch counts and the
+        # fused-vs-unfused warm wall (the segment compiler's win, measured)
+        compare = rung in ("q1", "q3") and not args.quick
         try:
             if platform != "cpu" and not args.quick:
                 detail[rung] = bench_sql_query(
-                    qid, schema="sf1", seconds_budget=rung_budget)
+                    qid, schema="sf1", seconds_budget=rung_budget,
+                    compare_unfused=compare)
             else:
                 detail[rung] = bench_sql_query(
                     qid, schema="tiny", seconds_budget=rung_budget,
                     escalate_to=None if args.quick else "sf1",
-                    escalate_budget_s=60.0)
+                    escalate_budget_s=60.0, compare_unfused=compare)
         except Exception as e:
             detail[rung] = {"error": repr(e)[:300]}
 

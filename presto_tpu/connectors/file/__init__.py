@@ -88,11 +88,15 @@ class _ExternalFile:
 
 class _TableInfo:
     def __init__(self, metadata: TableMetadata, files: List[str],
-                 rows: int, signature):
+                 rows: int, signature, pcol_headers: Optional[Dict] = None):
         self.metadata = metadata
         self.files = files
         self.rows = rows
         self.signature = signature
+        # path -> parsed pcol header (the _load pass already parsed every
+        # header for schema/rows/dict-union): split readers reuse these so
+        # pipeline construction re-opens and re-parses NOTHING
+        self.pcol_headers = pcol_headers or {}
 
 
 class FileMetadata(ConnectorMetadata):
@@ -153,10 +157,12 @@ class FileMetadata(ConnectorMetadata):
         if exts in ({"parquet"}, {"orc"}, {"rc"}):
             return self._load_external(name, files, sig)
         headers = []
+        by_path = {}
         rows = 0
         for f in files:
             pf = PcolFile(f)
             headers.append(pf.header)
+            by_path[f] = pf.header
             rows += pf.rows
             pf.close()
         # schema from the first file; dictionaries UNION across files so
@@ -178,7 +184,8 @@ class FileMetadata(ConnectorMetadata):
             cols.append(ColumnMetadata(
                 e["name"], _type_from_tag(e["type"], e["scale"]),
                 dictionary=d))
-        info = _TableInfo(TableMetadata(name, tuple(cols)), files, rows, sig)
+        info = _TableInfo(TableMetadata(name, tuple(cols)), files, rows, sig,
+                          pcol_headers=by_path)
         with self._lock:
             self._cache[name] = info
         return info
@@ -339,7 +346,7 @@ def iter_pcol_pages(path: str, names, type_of, table_dicts, capacity: int,
             cols[n] = (data, nulls)
         # one remap implementation for the serial and split-parallel paths —
         # they must stay row-identical by construction
-        remap = pcol_dict_remaps(pf, names, table_dicts)
+        remap = pcol_dict_remaps(pf.columns, names, table_dicts)
         for lo in range(0, pf.rows, capacity):
             hi = min(lo + capacity, pf.rows)
             n_rows = hi - lo
@@ -375,13 +382,15 @@ def iter_pcol_pages(path: str, names, type_of, table_dicts, capacity: int,
 _RANGE_ROWS = 1 << 20
 
 
-def pcol_dict_remaps(pf: PcolFile, names, table_dicts):
+def pcol_dict_remaps(columns, names, table_dicts):
     """{column: int32 remap array} for columns whose FILE dictionary differs
     from the TABLE's unioned one. O(dict size) — computed once per file and
-    shared by every range reader of that file."""
+    shared by every range reader of that file. `columns` is the header's
+    column-entry mapping (``PcolFile.columns`` or the metadata cache's
+    parsed header) — no open file needed."""
     remaps = {}
     for cname in names:
-        e = pf.columns.get(cname)
+        e = columns.get(cname)
         td = table_dicts.get(cname)
         if e is None or "dict" not in e or td is None or \
                 list(e["dict"]) == list(td.values):
@@ -410,7 +419,7 @@ def read_pcol_range_chunk(path: str, names, type_of, table_dicts,
     pf = PcolFile(path, header=header)
     try:
         if remaps is None:
-            remaps = pcol_dict_remaps(pf, names, table_dicts)
+            remaps = pcol_dict_remaps(pf.columns, names, table_dicts)
         keep = None
         if prefilter_fn is not None:
             pre = prefilter_fn(pf, lo, hi)
@@ -441,6 +450,28 @@ def read_pcol_range_chunk(path: str, names, type_of, table_dicts,
                                [table_dicts.get(c) for c in names], rows)
     finally:
         pf.close()
+
+
+class _LazyRemaps:
+    """Once-per-file dictionary remaps, computed by the first range reader
+    that runs (on the scan pipeline's pool) instead of serially at pipeline
+    construction — the lazy split-reader setup."""
+
+    def __init__(self, columns, names, table_dicts):
+        self._columns = columns
+        self._names = names
+        self._table_dicts = table_dicts
+        self._lock = threading.Lock()
+        self._val = None
+        self._done = False
+
+    def get(self):
+        with self._lock:
+            if not self._done:
+                self._val = pcol_dict_remaps(self._columns, self._names,
+                                             self._table_dicts)
+                self._done = True
+            return self._val
 
 
 class FileSplitManager(ConnectorSplitManager):
@@ -558,13 +589,20 @@ class FilePageSource(ConnectorPageSource):
         names = [c.name for c in self.columns]
         type_of = {c.name: info.metadata.column(c.name).type
                    for c in self.columns}
-        pf = PcolFile(path)
-        rows = pf.rows
-        # header-derived work (JSON parse, dictionary remaps) hoisted out of
-        # the range readers: once per FILE, not once per row range
-        header = pf.header
-        remaps = pcol_dict_remaps(pf, names, table_dicts)
-        pf.close()
+        # LAZY per-file setup: the header was already parsed (and cached)
+        # by the metadata load, so pipeline construction opens NO files —
+        # a 1000-file table fans out instantly. The dictionary remaps
+        # (O(dict size) host work per file) are deferred into a shared
+        # once-holder that the FIRST scheduled range reader computes on a
+        # pool thread; sibling ranges reuse it.
+        header = info.pcol_headers.get(path)
+        if header is None:  # stale cache entry (file swapped in place)
+            pf = PcolFile(path)
+            header = pf.header
+            pf.close()
+        rows = header["rows"]
+        columns = {e["name"]: e for e in header["columns"]}
+        lazy = _LazyRemaps(columns, names, table_dicts)
         from ...formats.pcol import row_ranges
         step = max(1, min(int(target_rows), _RANGE_ROWS))
 
@@ -572,8 +610,8 @@ class FilePageSource(ConnectorPageSource):
             def read():
                 yield read_pcol_range_chunk(path, names, type_of,
                                             table_dicts, lo, hi,
-                                            self._native_prefilter, remaps,
-                                            header)
+                                            self._native_prefilter,
+                                            lazy.get(), header)
             return read
 
         return [reader(lo, hi) for lo, hi in row_ranges(rows, step)]

@@ -261,6 +261,9 @@ class LocalExecutionPlan:
     output_types: List[Type] = dataclasses.field(default_factory=list)
     output_dicts: List[Optional[Dictionary]] = dataclasses.field(default_factory=list)
     remote_slots: Dict[int, RemoteSourceSlot] = dataclasses.field(default_factory=dict)
+    # segment-compiler fusion decisions (exec/fused_segment): one entry per
+    # candidate run of page-local operators, fused or not, with the reason
+    segment_decisions: List[dict] = dataclasses.field(default_factory=list)
 
     def create_drivers(self, worker: int = 0) -> List[Driver]:
         """Instantiate one driver set for `worker`. The factory list is planned
@@ -348,6 +351,9 @@ class LocalExecutionPlanner:
             sink = PageConsumerFactory(next(self._ids),
                                        [s.type for s in chain.symbols])
         self._add_pipeline(chain.factories + [sink])
+        # segment fusion BEFORE memory wiring: fused factories must receive
+        # the query memory context too (they forward it to their terminal)
+        decisions = self._fuse_pipelines()
         mem = getattr(self, "_memory_ctx", None)
         if mem is not None:
             check = getattr(self, "_revoke_check", None)
@@ -364,7 +370,83 @@ class LocalExecutionPlanner:
                         fac.scan_options = self.scan_options
         return LocalExecutionPlan(self.pipelines, sink, root.column_names,
                                   [s.type for s in chain.symbols],
-                                  list(chain.dicts), self.remote_slots)
+                                  list(chain.dicts), self.remote_slots,
+                                  decisions)
+
+    # ------------------------------------------------------ segment fusion
+
+    def _fuse_pipelines(self) -> List[dict]:
+        """Pipeline-segment compiler: replace each maximal run of fusible
+        page-local operator factories (filter/project -> page-local join
+        probe -> partial hash-agg / TopN contribution) with ONE
+        FusedSegmentOperatorFactory whose whole chain traces into a single
+        jitted dispatch per page (ops/fused_segment.py). Single-operator
+        runs stay unfused (nothing to merge); blocking operators, join
+        builds, exchanges and sorts are barriers. `segment_fusion = False`
+        keeps the per-operator pipeline as the differential-testing oracle."""
+        from ..ops.fused_segment import (FusedSegmentOperatorFactory,
+                                         mid_stage_fusible,
+                                         terminal_stage_fusible)
+
+        decisions: List[dict] = []
+        if not self.session.get("segment_fusion", True):
+            return decisions
+        for pi, chain in enumerate(self.pipelines):
+            out = [chain[0]]  # the source operator never fuses
+            i = 1
+            while i < len(chain):
+                if not (mid_stage_fusible(chain[i]) or
+                        terminal_stage_fusible(chain[i])):
+                    out.append(chain[i])
+                    i += 1
+                    continue
+                run: List[object] = []
+                while i < len(chain) and mid_stage_fusible(chain[i]):
+                    run.append(chain[i])
+                    i += 1
+                terminal = None
+                if i < len(chain) and terminal_stage_fusible(chain[i]):
+                    terminal = chain[i]
+                    i += 1
+                members = run + ([terminal] if terminal is not None else [])
+                entry = {"pipeline": pi,
+                         "operators": [m.name for m in members]}
+                if len(members) >= 2:
+                    types, dicts = self._segment_output_meta(members[-1])
+                    out.append(FusedSegmentOperatorFactory(
+                        next(self._ids), run, terminal, types, dicts))
+                    entry["fused"] = True
+                else:
+                    out.extend(members)
+                    entry["fused"] = False
+                    entry["reason"] = "single-operator run"
+                decisions.append(entry)
+            self.pipelines[pi] = out
+        return decisions
+
+    @staticmethod
+    def _segment_output_meta(last) -> Tuple[List[Type], List]:
+        """Output (types, dicts) of a segment = those of its last member."""
+        if isinstance(last, HashAggregationOperatorFactory):
+            out = list(last.key_types)
+            dicts = list(last.key_dicts)
+            for c in last.calls:
+                if last.step == "partial":
+                    out.extend(c.function.intermediate_types)
+                    dicts.extend([None] * len(c.function.intermediate_types))
+                else:
+                    out.append(c.function.output_type)
+                    dicts.append(c.output_dictionary)
+            return out, dicts
+        if isinstance(last, TopNOperatorFactory):
+            return list(last.types), list(last.dicts)
+        if isinstance(last, FilterProjectOperatorFactory):
+            return list(last.processor.output_types), \
+                list(last.processor.output_dicts)
+        assert isinstance(last, LookupJoinOperatorFactory), type(last)
+        return list(last.output_types), \
+            [d for _, d in last.probe_output_meta] + \
+            [d for _, d in last.build_output_meta]
 
     # --------------------------------------------------- driver parallelism
 
@@ -645,7 +727,7 @@ class LocalExecutionPlanner:
         probe_fac = LookupJoinOperatorFactory(
             next(self._ids), build_fac.lookup_factory, probe_key_ch,
             probe_out_ch, probe_meta, list(range(len(payload_ch))),
-            payload_meta, jt)
+            payload_meta, jt, unique_build=unique)
         out_dicts = [probe_chain.dicts[c] for c in probe_out_ch] + \
                     [d for _, d in payload_meta]
         return Chain(probe_chain.factories + [probe_fac],
@@ -682,7 +764,8 @@ class LocalExecutionPlanner:
         probe_fac = LookupJoinOperatorFactory(
             next(self._ids), build_fac.lookup_factory,
             [left.channel(ck_l.name)], probe_out_ch, probe_meta,
-            list(range(len(payload_ch))), payload_meta, self._join_type(node))
+            list(range(len(payload_ch))), payload_meta, self._join_type(node),
+            unique_build=build_fac.unique)
         out_dicts = [left.dicts[c] for c in probe_out_ch] + \
                     [d for _, d in payload_meta]
         return Chain(left.factories + [probe_fac], probe_out + build_out,

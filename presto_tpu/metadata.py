@@ -58,6 +58,11 @@ class Session:
         # stateful operators (ops/coalesce.py) — downstream kernel work and
         # per-page dispatches then scale with selectivity
         "coalesce_pages": True,
+        # fuse maximal runs of page-local operators (filter/project -> join
+        # probe -> partial hash-agg / TopN contribution) into ONE jitted
+        # dispatch per page (ops/fused_segment.py). False = per-operator
+        # dispatches — the differential-testing oracle
+        "segment_fusion": True,
         # --- streaming scan pipeline (ops/scan_pipeline.py) ---
         # staged host->HBM ingest: split-parallel readers -> ordered
         # re-batch into device-shaped pages -> async upload. False =

@@ -111,16 +111,18 @@ class CoalesceOperator(Operator):
         if self._mode is None:
             # adapt on the FIRST page: an unselective filter makes packing
             # pure overhead, so switch to permanent pass-through (per-scan
-            # selectivity is stationary — one decision suffices)
+            # selectivity is stationary — one decision suffices). The sync
+            # below runs once per stream, not per page — and through numpy,
+            # so the decision compiles no throwaway XLA kernels.
             import numpy as np
 
-            frac = float(np.asarray(jnp.mean(
-                page.mask.astype(jnp.float32))))
-            if frac > self.PASSTHROUGH_SELECTIVITY:
+            mask_np = np.asarray(page.mask)  # prestocheck: ignore[host-sync]
+            if mask_np.mean() > self.PASSTHROUGH_SELECTIVITY:
                 self._mode = "pass"
                 self._pending.append(page)
                 return
             self._mode = "pack"
+            self._first_count = int(mask_np.sum())
         compacted = _compact(page)
         if self._acc is not None and \
                 self._acc.capacity != compacted.capacity:
@@ -131,7 +133,17 @@ class CoalesceOperator(Operator):
             self._acc = None
         if self._acc is None:
             self._acc = compacted
-            self._count = jnp.sum(compacted.mask.astype(jnp.int32))
+            # host int (counted during the mode decision) — _pack takes it
+            # as a traced argument either way, and the eager jnp.sum here
+            # compiled two throwaway kernels per schema
+            import numpy as np
+
+            count = getattr(self, "_first_count", None)
+            if count is None:  # capacity-change restart mid-stream
+                count = int(np.asarray(  # prestocheck: ignore[host-sync]
+                    compacted.mask).sum())
+            self._first_count = None
+            self._count = np.int32(count)
             return
         out, emit, rest, new_count = _pack(self._acc, self._count, compacted)
         self._acc, self._count = rest, new_count

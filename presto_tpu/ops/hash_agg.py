@@ -39,18 +39,22 @@ from .operator import Operator, OperatorContext, OperatorFactory, timed
 from .sorting import lexsort_fast
 
 
-def _builder_key(tag: str, b, page: "Page" = None) -> tuple:
+def _builder_key(tag: str, b, page: "Page" = None, input_dicts=None) -> tuple:
     """Kernel-cache identity of a builder's static config: everything its
     jitted kernel reads from `self` (channels, call fingerprints, domains)
     PLUS the input page's dictionary versions — _call_contributions embeds
     `d.sort_keys()` as a trace constant for min/max over unsorted
     dictionaries, and Dictionary.extend mutates IN PLACE (same identity), so
     the (token, len) version must be part of the key or an INSERT-extended
-    dictionary would replay a stale kernel."""
+    dictionary would replay a stale kernel. `input_dicts` supplies the
+    dictionaries directly when the caller knows the builder's input layout
+    without a live page (the fused-segment compiler)."""
     dicts = ()
     if page is not None:
         dicts = tuple(kernel_cache.dict_key(blk.dictionary)
                       for blk in page.blocks)
+    elif input_dicts is not None:
+        dicts = tuple(kernel_cache.dict_key(d) for d in input_dicts)
     return ("agg", tag,
             tuple(t.name for t in getattr(b, "key_types", ())),
             getattr(b, "_key_channels", None),
@@ -268,11 +272,14 @@ def sort_group_reduce(keys: Tuple[jnp.ndarray, ...], mask: jnp.ndarray,
     gid = jnp.minimum(gid, out_groups)    # overflow also lands in the bin
 
     states = _reduce_all(sc, kinds, identities, widths, gid, out_groups)
-    gkeys = []
-    for k in sk:
-        out = jnp.zeros(out_groups, dtype=k.dtype)
-        out = out.at[gid].set(k, mode="drop")  # last write per slot; same key anyway
-        gkeys.append(out)
+    # group keys: first sorted row per group, ONE segment_min + a cheap
+    # gather per key column (the old per-key scatter into an out_groups
+    # table cost a full scatter pass per key — the dominant fold cost on
+    # multi-key aggregations). Empty slots gather garbage; gvalid masks them.
+    first = jax.ops.segment_min(jnp.arange(n, dtype=jnp.int32), gid,
+                                num_segments=out_groups + 1)[:out_groups]
+    safe = jnp.clip(first, 0, max(n - 1, 0))
+    gkeys = [k[safe] for k in sk]
     gvalid = jnp.arange(out_groups, dtype=jnp.int32) < jnp.minimum(num_groups, out_groups)
     # overwrite empty-group states with identities so MIN/MAX don't leak sentinels
     fixed_states = [_where_valid(gvalid, s, ident)
@@ -329,6 +336,21 @@ class GroupedAggregationBuilder:
         # of MultiChannelGroupByHash.java:363-409, but table growth here re-runs one
         # sort kernel at the next size bucket instead of rehashing in place
         self._table_size: Optional[int] = None
+        # adaptive PER-PAGE strategy, decided once from the first page's true
+        # group count (one scalar sync, the price the fold already pays):
+        # - defer=True: grouping is NOT reducing (groups ~ rows), so the
+        #   per-page sort+reduce is pure overhead — pages contribute their
+        #   raw (keys, contribs, mask) rows and ONE fold does all the sort
+        #   work. No further syncs: raw absorption is shape-static.
+        # - _out_groups: grouping reduces a lot — later partials emit a
+        #   SHRUNKEN table sized to the observed count, so fold inputs and
+        #   per-page segment reductions scale with groups, not capacity.
+        #   Needs a per-page overflow check (one scalar sync), so it engages
+        #   only on the synchronous CPU backend; accelerators keep full-size
+        #   partials and their fully async dispatch.
+        self._defer: Optional[bool] = None
+        self._out_groups: Optional[int] = None
+        self._raw_kernel = None
 
     # --- per page ---------------------------------------------------------
 
@@ -338,6 +360,14 @@ class GroupedAggregationBuilder:
         contribs = _call_contributions(self.calls, page, self.from_intermediate)
         return sort_group_reduce(keys, mask, tuple(contribs), self.kinds,
                                  self.identities, out_groups, self.widths)
+
+    def _page_raw(self, page: Page):
+        """Defer mode: per-row keys/contributions, no per-page reduction.
+        Structurally identical to a partial's (keys, states, valid) triple,
+        so the fold/spill machinery consumes both interchangeably."""
+        keys = _null_safe_keys(page, self._key_channels)
+        contribs = _call_contributions(self.calls, page, self.from_intermediate)
+        return keys, tuple(contribs), page.mask
 
     def set_channels(self, key_channels: Sequence[int]):
         self._key_channels = tuple(key_channels)
@@ -349,32 +379,108 @@ class GroupedAggregationBuilder:
         per worker."""
         self._page_kernel = donor._page_kernel
 
-    def add_page(self, page: Page) -> None:
+    def page_out_groups(self, capacity: int) -> int:
+        og = capacity if self._wide_cap is None \
+            else min(capacity, self._wide_cap)
+        if self._out_groups is not None:
+            og = min(og, self._out_groups)
+        return og
+
+    def defer_raw(self) -> bool:
+        """True once the first page proved grouping does not reduce."""
+        return self._defer is True
+
+    def _install_page_kernel(self, page: Page) -> None:
         if self._page_kernel is None:
             self._page_kernel = kernel_cache.get_or_install(
                 _builder_key("sort", self, page), lambda: jax.jit(
                     self._page_partial, static_argnames=("out_groups",)))
-        cap = page.capacity
-        out_groups = cap if self._wide_cap is None else min(cap, self._wide_cap)
-        gkeys, gstates, gvalid, ng = self._page_kernel(page, out_groups)
-        if self._wide_cap is not None and int(ng) > out_groups:
+
+    def _install_raw_kernel(self, page: Page) -> None:
+        if self._raw_kernel is None:
+            self._raw_kernel = kernel_cache.get_or_install(
+                _builder_key("sort-raw", self, page),
+                lambda: jax.jit(self._page_raw))
+
+    def add_page(self, page: Page) -> None:
+        if self.defer_raw():
+            self._install_raw_kernel(page)
+            self.absorb_raw(self._raw_kernel(page), page.capacity)
+            return
+        self._install_page_kernel(page)
+        out_groups = self.page_out_groups(page.capacity)
+        if not self.absorb_partial(self._page_kernel(page, out_groups),
+                                   page.capacity, out_groups):
+            # shrunken table overflowed: redo this one page at full size
+            out_groups = self.page_out_groups(page.capacity)
+            ok = self.absorb_partial(self._page_kernel(page, out_groups),
+                                     page.capacity, out_groups)
+            assert ok, "full-size partial cannot overflow"
+
+    def absorb_raw(self, raw, capacity: int) -> None:
+        """Defer mode: install one page's per-row (keys, contribs, mask)."""
+        keys, contribs, mask = raw
+        self._pending.append((keys, contribs, mask))
+        self._pending_rows += capacity
+        if self._pending_rows >= 4 * self.max_groups:
+            self._fold()
+
+    def absorb_partial(self, partial, capacity: int, out_groups: int) -> bool:
+        """Install one page's (gkeys, gstates, gvalid, ng) partial — computed
+        by this builder's own kernel or by a fused pipeline segment whose
+        final stage ran the identical `_page_partial` config. Returns False
+        when a SHRUNKEN table overflowed (the page's tail groups were clamped
+        into the trash bin): the caller must recompute that page at the
+        then-reset full size."""
+        gkeys, gstates, gvalid, ng = partial
+        full = capacity if self._wide_cap is None \
+            else min(capacity, self._wide_cap)
+        if out_groups < full:
+            # shrunken partial: verify the observed bound still holds (one
+            # scalar sync — the shrink is only picked on sync-cheap backends)
+            if int(ng) > out_groups:
+                self._out_groups = None  # data disproved the bound
+                return False
+        elif self._wide_cap is not None and int(ng) > out_groups:
             # a capped group table would silently merge groups — fail loudly
             # (sketch aggregates target few groups; the reference's qdigest /
             # HLL states would OOM long before this bound too)
             raise RuntimeError(
                 f"sketch aggregate over more than {out_groups} groups in one "
                 f"page is not supported")
+        elif self._defer is None and self._wide_cap is None:
+            self._decide_strategy(int(ng), capacity)
         self._pending.append((gkeys, gstates, gvalid))
-        self._pending_rows += cap
+        # account the partial's actual table rows (static shape, no sync):
+        # shrunken partials then reach the fold threshold by live state, not
+        # by input capacity, sparing needless mid-stream folds
+        self._pending_rows += int(gvalid.shape[0])
         if self._pending_rows >= 4 * self.max_groups:
             self._fold()
+        return True
+
+    def _decide_strategy(self, first_ng: int, capacity: int) -> None:
+        """One-shot adaptation from the first page's true group count (one
+        scalar sync, same price a fold pays). Groups ~ rows: per-page
+        sort+reduce buys nothing — defer pages as raw rows into the fold.
+        Groups << rows: shrink later partials' tables to the observed count
+        (CPU backend only: the overflow guard syncs per page)."""
+        self._defer = first_ng > capacity // 2
+        if self._defer:
+            return
+        import jax as _jax
+
+        if _jax.default_backend() == "cpu" and first_ng <= capacity // 8:
+            self._out_groups = max(1024, _pow2(int(first_ng * 1.5) + 1))
 
     # --- combine ----------------------------------------------------------
 
-    def _fold(self) -> None:
+    def _fold(self, final: bool = False) -> None:
         """Merge pending partials (+ current table) into a fresh compact table.
         If the live group count exceeds max_groups, the inputs are SPILLED to
-        host RAM instead (merged exactly at finish) — never silently dropped."""
+        host RAM instead (merged exactly at finish) — never silently dropped.
+        `final` marks the finish()-time fold: no further folds will read the
+        table, so the tighten-to-pow2 slicing pass is skipped."""
         parts = list(self._pending)
         self._pending = []
         self._pending_rows = 0
@@ -387,12 +493,14 @@ class GroupedAggregationBuilder:
         n_parts = len(parts)
         want = _pow2_count(n_parts)
         if want > n_parts:
-            z_keys = tuple(jnp.zeros(0, dtype=p.dtype)
+            # numpy zeros: eager jnp.zeros dispatches compile a throwaway
+            # kernel per dtype; np arrays device_put at the jit call
+            z_keys = tuple(np.zeros(0, dtype=p.dtype)
                            for p in parts[0][0])
             z_states = tuple(
-                jnp.zeros((0,) + tuple(s.shape[1:]), dtype=s.dtype)
+                np.zeros((0,) + tuple(s.shape[1:]), dtype=s.dtype)
                 for s in parts[0][1])
-            z_valid = jnp.zeros(0, dtype=jnp.bool_)
+            z_valid = np.zeros(0, dtype=np.bool_)
             parts = parts + [(z_keys, z_states, z_valid)] * (want - n_parts)
         key_parts = tuple(tuple(p[0][i] for p in parts)
                           for i in range(len(self.key_types)))
@@ -424,9 +532,11 @@ class GroupedAggregationBuilder:
             self._table_size = None
             return
         # shrink the table to the true group count's bucket: gvalid is a prefix,
-        # so slicing keeps every live group and future folds sort less
+        # so slicing keeps every live group and future folds sort less. The
+        # FINAL fold skips this — nothing reads the table again, and the
+        # slice kernels would be pure overhead
         tight = min(_pow2(max(n, 1)), self.max_groups)
-        if tight < size:
+        if tight < size and not final:
             gkeys = tuple(k[:tight] for k in gkeys)
             gstates = tuple(s[:tight] for s in gstates)
             gvalid = gvalid[:tight]
@@ -528,7 +638,7 @@ class GroupedAggregationBuilder:
                 return z, _empty_state(self.widths), \
                     jnp.zeros(0, dtype=jnp.bool_)
             if self._pending:
-                self._fold()
+                self._fold(final=True)
         if self._spilled:
             out = self._merge_spilled()
         else:
@@ -643,18 +753,28 @@ class DirectAggregationBuilder:
             mask.astype(jnp.int32), gid, num_segments=self.D + 1)[: self.D] > 0)
         return tuple(new_table), new_seen
 
-    def add_page(self, page: Page) -> None:
-        if self._kernel is None:
-            self._kernel = kernel_cache.get_or_install(
-                _builder_key("direct", self, page),
-                lambda: jax.jit(self._accumulate))
+    def init_state(self):
+        """(table, seen) accumulator, materialized on first use — threaded
+        through the page kernel as jit arguments (fused segments pass it the
+        same way)."""
         if self._table is None:
             self._table = tuple(
                 _fill((self.D, col.width) if col.width > 1 else (self.D,),
                       np.dtype(col.dtype), col.identity)
                 for c in self.calls for col in c.function.state)
             self._seen = jnp.zeros(self.D, dtype=jnp.bool_)
-        self._table, self._seen = self._kernel(page, self._table, self._seen)
+        return self._table, self._seen
+
+    def absorb_state(self, state) -> None:
+        self._table, self._seen = state
+
+    def add_page(self, page: Page) -> None:
+        if self._kernel is None:
+            self._kernel = kernel_cache.get_or_install(
+                _builder_key("direct", self, page),
+                lambda: jax.jit(self._accumulate))
+        table, seen = self.init_state()
+        self.absorb_state(self._kernel(page, table, seen))
 
     def finish(self):
         if self._table is None:
@@ -752,14 +872,20 @@ class GlobalAggregationBuilder:
             jnp.asarray(col.identity, dtype=np.dtype(col.dtype))
             for c in self.calls for col in c.function.state)
 
+    def init_state(self):
+        if self._state is None:
+            self._state = self._identity_state()
+        return self._state
+
+    def absorb_state(self, state) -> None:
+        self._state = state
+
     def add_page(self, page: Page) -> None:
         if self._kernel is None:
             self._kernel = kernel_cache.get_or_install(
                 _builder_key("global", self, page),
                 lambda: jax.jit(self._accumulate))
-        if self._state is None:
-            self._state = self._identity_state()
-        self._state = self._kernel(page, self._state)
+        self.absorb_state(self._kernel(page, self.init_state()))
 
     def finish(self):
         if self._state is None:
@@ -848,7 +974,9 @@ class HashAggregationOperator(Operator):
         # direct-builder tables are domain-indexed with holes: keep the full (small)
         # table and let the page masks carry liveness.
         if getattr(self.builder, "compact_table", True):
-            total = int(jnp.sum(valid))
+            # count on host: result building runs once per query, and the
+            # eager jnp.sum dispatch compiled a kernel per valid-shape
+            total = int(np.asarray(valid).sum())
         else:
             total = int(valid.shape[0])
         cap = self.output_capacity

@@ -256,14 +256,16 @@ class JoinBuildOperator(Operator):
         pages = list(self._pages)
         want = 1 << max(0, (len(pages) - 1).bit_length())
         if want > len(pages):
+            # numpy zeros, not jnp: an eager jnp.zeros dispatch compiles a
+            # throwaway kernel per dtype; np arrays device_put at the jit call
             p0 = pages[0]
             zb = tuple(Block(b.type,
-                             jnp.zeros((0,), dtype=b.data.dtype),
-                             jnp.zeros((0,), dtype=jnp.bool_)
+                             np.zeros((0,), dtype=b.data.dtype),
+                             np.zeros((0,), dtype=np.bool_)
                              if b.nulls is not None else None,
                              b.dictionary)
                        for b in p0.blocks)
-            zp = Page(zb, jnp.zeros((0,), dtype=jnp.bool_))
+            zp = Page(zb, np.zeros((0,), dtype=np.bool_))
             pages.extend([zp] * (want - len(pages)))
         pages = tuple(pages)
         if self.f.strategy == "dense" and kc == 1:
@@ -273,7 +275,7 @@ class JoinBuildOperator(Operator):
             src = LookupSource(
                 kind="dense", key_arrays=keys, payload=payload,
                 payload_meta=self.f.payload_meta,
-                build_count=n_dev.astype(jnp.int32), unique=self.f.unique,
+                build_count=n_dev, unique=self.f.unique,
                 table=table, base=self.f.dense_min)
         elif kc == 1:
             keys, payload, pnulls, mask, n_dev, sorted_key, sorted_row = \
@@ -281,14 +283,14 @@ class JoinBuildOperator(Operator):
             src = LookupSource(
                 kind="sorted", key_arrays=keys, payload=payload,
                 payload_meta=self.f.payload_meta,
-                build_count=n_dev.astype(jnp.int32), unique=self.f.unique,
+                build_count=n_dev, unique=self.f.unique,
                 sorted_key=sorted_key, sorted_row=sorted_row)
         else:
             # multi-key: the bijective packing plan needs host min/max
             keys, payload, pnulls, mask, n_dev = _concat_parts(
                 pages, kc, null_cols)
             src = _build_sorted(tuple(keys), tuple(payload), mask,
-                                n_dev.astype(jnp.int32),
+                                n_dev,
                                 self.f.payload_meta, self.f.unique)
         src.payload_nulls = tuple(pnulls)
         src.has_null_key = bool(self._saw_null_key) if self._saw_null_key is not None else False
@@ -515,12 +517,141 @@ class JoinBuildOperatorFactory(OperatorFactory):
 
 
 # ---------------------------------------------------------------------------
+# probe stage (pure): the page-local fast paths as ONE composable function
+# ---------------------------------------------------------------------------
+#
+# The unique-build INNER/LEFT probe and the exact-key SEMI/ANTI probe are
+# page-local (one output page per probe page, no host sync), so they can run
+# as a single fused kernel — standalone (the operator below jits exactly this
+# function) or inlined into a pipeline segment (ops/fused_segment.py). The
+# lookup-source arrays arrive as jit ARGUMENTS, never trace constants, so a
+# rebuilt build side (new query, same shapes) replays the compiled kernel.
+
+@dataclasses.dataclass(frozen=True)
+class ProbeStageConfig:
+    """Static (hashable) config of a page-local probe stage. Everything the
+    traced function branches on lives here; everything data lives in the aux
+    pytree from :func:`probe_stage_aux`."""
+
+    kind: str                              # "dense" | "sorted"
+    join_type: str                         # INNER | LEFT | SEMI | ANTI
+    probe_key_channels: Tuple[int, ...]
+    probe_output_channels: Tuple[int, ...]
+    build_output_channels: Tuple[int, ...]
+    payload_meta: Tuple                    # ((type, dict), ...) per SELECTED build col
+    null_aware: bool = False
+
+
+def probe_plan_fusible(join_type: str, key_channels, unique: bool,
+                       filter_fn=None, semi_output_channel=None) -> bool:
+    """Plan-time test: will every page of this probe take the page-local
+    stage path? INNER/LEFT need a unique single-key build (one output row
+    per probe row); SEMI/ANTI need exact keys (single key) and no join
+    filter. FULL joins track visited build rows across pages and RIGHT is
+    planner-flipped — neither is page-local."""
+    if len(key_channels) != 1:
+        return False  # multi-key exactness is a runtime (packing) property
+    if join_type in (SEMI, ANTI):
+        return filter_fn is None and semi_output_channel is None
+    if join_type in (INNER, LEFT):
+        return unique
+    return False
+
+
+def probe_stage_cfg(f: "LookupJoinOperatorFactory",
+                    src: LookupSource) -> ProbeStageConfig:
+    return ProbeStageConfig(
+        kind=src.kind, join_type=f.join_type,
+        probe_key_channels=tuple(f.probe_key_channels),
+        probe_output_channels=tuple(f.probe_output_channels),
+        build_output_channels=tuple(f.build_output_channels),
+        payload_meta=tuple(_payload_meta_selected(src, f)),
+        null_aware=f.null_aware)
+
+
+def probe_stage_aux(src: LookupSource):
+    """Traced pytree of everything the stage reads from the build side.
+    Host scalars stay numpy (an eager jnp.asarray would compile a throwaway
+    convert kernel per query); they device_put at the jit call."""
+    if src.kind == "dense":
+        match = (src.table, np.asarray(src.base, np.int64))
+    else:
+        match = (src.sorted_key, src.sorted_row, tuple(src.key_arrays))
+    return (match, tuple(src.payload), tuple(src.payload_nulls),
+            np.asarray(src.has_null_key))
+
+
+def probe_stage_key(cfg: ProbeStageConfig) -> tuple:
+    """Global kernel-cache identity (dictionary versions included: payload
+    meta dictionaries ride into output blocks as static aux data)."""
+    from ..utils import kernel_cache as kc
+
+    return ("probe-stage", cfg.kind, cfg.join_type, cfg.probe_key_channels,
+            cfg.probe_output_channels, cfg.build_output_channels,
+            tuple((t.name, kc.dict_key(d)) for t, d in cfg.payload_meta),
+            cfg.null_aware)
+
+
+def apply_probe_stage(page: Page, aux, cfg: ProbeStageConfig) -> Page:
+    """Pure page -> page probe: match rows then emit, in one traceable body.
+
+    Semantics identical to the operator's _match_rows + _emit_unique pair
+    (the differential-tested contract): null probe keys never match; SEMI
+    keeps matches, ANTI keeps non-matches (null-aware NOT IN empties the
+    result under any NULL build key, via the has_null_key aux scalar); LEFT
+    emits null build columns for unmatched probe rows."""
+    match, payload, payload_nulls, has_null_key = aux
+    probe_keys = [page.blocks[c].data for c in cfg.probe_key_channels]
+    probe_mask = page.mask
+    for c in cfg.probe_key_channels:
+        if page.blocks[c].nulls is not None:
+            probe_mask = probe_mask & ~page.blocks[c].nulls
+    if cfg.kind == "dense":
+        table, base = match
+        row = probe_match_dense(table, base, probe_keys[0], probe_mask)
+    else:
+        sorted_key, sorted_row, key_arrays = match
+        row = probe_match_sorted(sorted_key, sorted_row,
+                                 combined_key(tuple(probe_keys)),
+                                 tuple(probe_keys), probe_mask, key_arrays)
+    matched = row >= 0
+    if cfg.join_type in (SEMI, ANTI):
+        if cfg.join_type == SEMI:
+            keep = page.mask & matched
+        else:
+            keep = page.mask & ~matched
+            if cfg.null_aware:
+                # NOT IN: NULL probe key -> UNKNOWN -> filtered; any NULL
+                # build key makes every non-match UNKNOWN -> empty result
+                keep = keep & probe_mask & ~has_null_key
+        sel = page.select_channels(list(cfg.probe_output_channels))
+        return Page(sel.blocks, keep)
+    return unique_join_page(page, row, payload, payload_nulls,
+                            cfg.probe_output_channels,
+                            cfg.build_output_channels, cfg.payload_meta,
+                            cfg.join_type == INNER,
+                            cfg.join_type in (LEFT, FULL))
+
+
+def probe_stage_kernel(cfg: ProbeStageConfig):
+    """Jitted stage shared through the global kernel cache: identical-config
+    probes across operators, workers and queries replay one compile (the
+    hash_agg share_kernels pattern, generalized to the join probe)."""
+    from ..utils import kernel_cache as kc
+
+    return kc.get_or_install(
+        probe_stage_key(cfg),
+        lambda: jax.jit(apply_probe_stage, static_argnames=("cfg",)))
+
+
+# ---------------------------------------------------------------------------
 # probe
 # ---------------------------------------------------------------------------
 
-@jax.jit
-def _probe_match_unique(source_table, base, probe_keys, probe_mask):
-    """DENSE unique build: one gather -> build row per probe row (-1 = no match)."""
+def probe_match_dense(source_table, base, probe_keys, probe_mask):
+    """DENSE unique build: one gather -> build row per probe row (-1 = no
+    match). Pure body — the standalone kernel below and the fused stage
+    both call it."""
     domain = source_table.shape[0]
     idx = (probe_keys.astype(jnp.int64) - base).astype(jnp.int32)
     in_range = (idx >= 0) & (idx < domain) & probe_mask
@@ -529,11 +660,14 @@ def _probe_match_unique(source_table, base, probe_keys, probe_mask):
     return row
 
 
-@jax.jit
-def _probe_match_sorted_unique(sorted_key, sorted_row, ck, probe_keys_list,
-                               probe_mask, key_arrays):
+_probe_match_unique = jax.jit(probe_match_dense)
+
+
+def probe_match_sorted(sorted_key, sorted_row, ck, probe_keys_list,
+                       probe_mask, key_arrays):
     """SORTED unique build: binary search + verify (ck = the build's
-    combined-key space, packed when exact)."""
+    combined-key space, packed when exact). Pure body shared by the
+    standalone kernel and the fused stage."""
     pos = jnp.searchsorted(sorted_key, ck)
     pos = jnp.clip(pos, 0, sorted_key.shape[0] - 1)
     hit = (sorted_key[pos] == ck) & probe_mask
@@ -543,6 +677,9 @@ def _probe_match_sorted_unique(sorted_key, sorted_row, ck, probe_keys_list,
         bv = bk[jnp.where(row >= 0, row, 0)]
         row = jnp.where((row >= 0) & (bv == pk), row, jnp.int32(-1))
     return row
+
+
+_probe_match_sorted_unique = jax.jit(probe_match_sorted)
 
 
 class LookupJoinOperator(Operator):
@@ -556,6 +693,10 @@ class LookupJoinOperator(Operator):
         self._source: Optional[LookupSource] = None
         self._visited = None  # FULL: device bool per build row, OR-accumulated
         self._unmatched_emitted = False
+        # page-local stage path (one fused kernel per page, shared via the
+        # global kernel cache): resolved lazily from the live lookup source
+        self._stage_cfg: Optional[ProbeStageConfig] = None
+        self._stage_aux = None
 
     @property
     def output_types(self) -> List[Type]:
@@ -600,15 +741,37 @@ class LookupJoinOperator(Operator):
         # multi-key hashes must range-scan + verify via the expansion path
         if self.f.join_type in (SEMI, ANTI):
             if self.f.filter_fn is None and src.exact_keys:
-                row = self._match_rows(src, probe_keys, probe_mask)
-                self._emit_unique(page, row, probe_mask)
+                if self._stage_eligible(src):
+                    self._push(self._stage_call(src, page))
+                else:
+                    row = self._match_rows(src, probe_keys, probe_mask)
+                    self._emit_unique(page, row, probe_mask)
             else:
                 self._emit_semi_expanded(page, probe_keys, probe_mask)
         elif src.unique and (src.kind == "dense" or src.exact_keys):
-            row = self._match_rows(src, probe_keys, probe_mask)
-            self._emit_unique(page, row, probe_mask)
+            if self._stage_eligible(src):
+                self._push(self._stage_call(src, page))
+            else:
+                row = self._match_rows(src, probe_keys, probe_mask)
+                self._emit_unique(page, row, probe_mask)
         else:
             self._emit_expanded(page, probe_keys, probe_mask)
+
+    def _stage_eligible(self, src: LookupSource) -> bool:
+        """One-kernel page-local path — THE plan-time fusion predicate,
+        evaluated against the live build, so the fused and standalone paths
+        can never drift apart."""
+        return probe_plan_fusible(self.f.join_type,
+                                  self.f.probe_key_channels, src.unique,
+                                  self.f.filter_fn,
+                                  self.f.semi_output_channel)
+
+    def _stage_call(self, src: LookupSource, page: Page) -> Page:
+        if self._stage_cfg is None:
+            self._stage_cfg = probe_stage_cfg(self.f, src)
+            self._stage_aux = probe_stage_aux(src)
+            self._stage_kernel = probe_stage_kernel(self._stage_cfg)
+        return self._stage_kernel(page, self._stage_aux, cfg=self._stage_cfg)
 
     def _match_rows(self, src, probe_keys, probe_mask):
         if src.kind == "dense":
@@ -834,14 +997,13 @@ def _payload_meta_selected(src: LookupSource, f) -> List[Tuple[Type, Optional[Di
     return [src.payload_meta[i] for i in f.build_output_channels]
 
 
-@functools.partial(jax.jit, static_argnames=("probe_channels", "build_channels",
-                                             "meta", "inner", "left_outer"))
-def _emit_unique_kernel(page: Page, row, payload, payload_nulls,
-                        probe_channels, build_channels, meta,
-                        inner: bool, left_outer: bool) -> Page:
-    """Unique-build join output as ONE fused kernel: probe-channel passthrough
-    plus a gather per build column (eagerly this was ~15 separate dispatches
-    per page — measurable host overhead on short queries)."""
+def unique_join_page(page: Page, row, payload, payload_nulls,
+                     probe_channels, build_channels, meta,
+                     inner: bool, left_outer: bool) -> Page:
+    """Unique-build join output: probe-channel passthrough plus a gather per
+    build column. Pure body — the standalone kernel below runs it as ONE
+    fused dispatch (eagerly this was ~15 separate dispatches per page);
+    the fused segment inlines it into its whole-chain kernel."""
     matched = row >= 0
     out_mask = page.mask & (matched if inner else jnp.ones_like(matched))
     safe_row = jnp.where(matched, row, 0)
@@ -855,6 +1017,11 @@ def _emit_unique_kernel(page: Page, row, payload, payload_nulls,
             nulls = unmatched if nulls is None else (nulls | unmatched)
         blocks.append(Block(t, arr, nulls, d))
     return Page(tuple(blocks), out_mask)
+
+
+_emit_unique_kernel = functools.partial(
+    jax.jit, static_argnames=("probe_channels", "build_channels", "meta",
+                              "inner", "left_outer"))(unique_join_page)
 
 
 @jax.jit
@@ -942,8 +1109,13 @@ class LookupJoinOperatorFactory(OperatorFactory):
                  null_aware: bool = False, filter_fn=None,
                  filter_probe_channels: Optional[List[int]] = None,
                  filter_build_channels: Optional[List[int]] = None,
-                 filter_key: Optional[tuple] = None):
+                 filter_key: Optional[tuple] = None,
+                 unique_build: bool = False):
         super().__init__(operator_id, f"LookupJoin({join_type})")
+        # plan-time build-side uniqueness claim (JoinBuildOperatorFactory's
+        # `unique`): the segment compiler fuses INNER/LEFT probes only when
+        # the build guarantees one output row per probe row
+        self.unique_build = unique_build
         # global kernel-cache identity of the compiled join filter (expression
         # + layout fingerprint from the local planner); None -> per-factory jit
         self.filter_key = filter_key
@@ -958,6 +1130,7 @@ class LookupJoinOperatorFactory(OperatorFactory):
         self.probe_key_channels = probe_key_channels
         self.probe_output_channels = probe_output_channels
         self.probe_output_meta = list(probe_output_meta)
+        self.build_output_meta = list(build_output_meta)
         self.build_output_channels = build_output_channels
         self.join_type = join_type
         self.semi_output_channel = semi_output_channel

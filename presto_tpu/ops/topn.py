@@ -70,10 +70,14 @@ def _sort_key_arrays(page: Page, orders: Sequence[SortOrder]) -> Tuple[jnp.ndarr
     return tuple(keys)
 
 
-@functools.partial(jax.jit, static_argnames=("orders", "n"))
-def _topn_merge(page: Page, buffer: Optional[Page], orders: Tuple[SortOrder, ...],
-                n: int) -> Page:
-    """Shared across operator instances: one compile per (schema, orders, n)."""
+def topn_merge_stage(page: Page, buffer: Optional[Page],
+                     orders: Tuple[SortOrder, ...], n: int) -> Page:
+    """Pure TopN contribution: sort [page ++ buffer], keep the first n rows.
+
+    The un-jitted stage body — the standalone operator dispatches the jitted
+    `_topn_merge` below; a fused pipeline segment (ops/fused_segment.py)
+    inlines this into its one-kernel-per-page composition with the buffer
+    threaded through as a jit argument."""
     if buffer is not None:
         blocks = tuple(
             Block(b.type,
@@ -93,6 +97,11 @@ def _topn_merge(page: Page, buffer: Optional[Page], orders: Tuple[SortOrder, ...
         nulls = b.nulls[top] if b.nulls is not None else None
         blocks.append(Block(b.type, b.data[top], nulls, b.dictionary))
     return Page(tuple(blocks), merged.mask[top])
+
+
+# shared across operator instances: one compile per (schema, orders, n)
+_topn_merge = functools.partial(jax.jit, static_argnames=("orders", "n"))(
+    topn_merge_stage)
 
 
 class TopNOperator(Operator):

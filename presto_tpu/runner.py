@@ -76,6 +76,29 @@ def _scan_pipeline_stats(drivers) -> Optional[dict]:
     return agg or None
 
 
+def _segment_stats(exec_plan) -> Optional[dict]:
+    """Fused-segment observability (ops/fused_segment.py): the compiler's
+    fusion decisions plus per-segment dispatch/compile counts, rolled into
+    QueryResult.stats["segments"]."""
+    from .ops.fused_segment import FusedSegmentOperatorFactory
+
+    segs = []
+    dispatches = compiles = 0
+    for pi, chain in enumerate(exec_plan.pipelines):
+        for fac in chain:
+            if isinstance(fac, FusedSegmentOperatorFactory):
+                d = fac.describe()
+                d["pipeline"] = pi
+                segs.append(d)
+                dispatches += d["dispatches"]
+                compiles += d["compiles"]
+    if not segs and not exec_plan.segment_decisions:
+        return None
+    return {"count": len(segs), "dispatches": dispatches,
+            "compiles": compiles, "segments": segs,
+            "decisions": exec_plan.segment_decisions}
+
+
 class LocalQueryRunner:
     """In-process engine instance bound to a catalog registry."""
 
@@ -233,6 +256,7 @@ class LocalQueryRunner:
             self.last_grouped = g.bucket_count
             results, names, types = [], None, None
             scan_stats: Dict[str, float] = {}
+            seg_stats: Optional[dict] = None
             for b in range(g.bucket_count):
                 exec_plan, drivers, _w = self._run_plan(plan, bucket_filter=b)
                 results.append(exec_plan.sink.rows())
@@ -240,15 +264,32 @@ class LocalQueryRunner:
                 types = exec_plan.output_types
                 for k, v in (_scan_pipeline_stats(drivers) or {}).items():
                     scan_stats[k] = round(scan_stats.get(k, 0) + v, 6)
+                s = _segment_stats(exec_plan)
+                if s is not None:
+                    if seg_stats is None:
+                        seg_stats = s
+                    else:  # sum counters across buckets, keep one decision set
+                        for k in ("count", "dispatches", "compiles"):
+                            seg_stats[k] += s[k]
+                        seg_stats["segments"].extend(s["segments"])
+            stats = {}
+            if scan_stats:
+                stats["scan_pipeline"] = scan_stats
+            if seg_stats is not None:
+                stats["segments"] = seg_stats
             return QueryResult(merge_rows(results, g), names, types,
-                               stats={"scan_pipeline": scan_stats}
-                               if scan_stats else None)
+                               stats=stats or None)
 
         exec_plan, drivers, _wall = self._run_plan(plan)
         scan = _scan_pipeline_stats(drivers)
+        seg = _segment_stats(exec_plan)
+        stats = {}
+        if scan:
+            stats["scan_pipeline"] = scan
+        if seg is not None:
+            stats["segments"] = seg
         return QueryResult(exec_plan.sink.rows(), exec_plan.output_names,
-                           exec_plan.output_types,
-                           stats={"scan_pipeline": scan} if scan else None)
+                           exec_plan.output_types, stats=stats or None)
 
     def _execute_write(self, stmt) -> QueryResult:
         """CTAS / INSERT / DROP: plan the source query, swap the result sink

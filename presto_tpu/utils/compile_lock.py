@@ -68,8 +68,17 @@ def install() -> None:
     except Exception:  # jax internals moved: fail open (no serialization)
         return
 
-    inner = getattr(_compiler, "backend_compile_and_load", None)
-    if inner is None or getattr(inner, "_presto_tpu_locked", False):
+    # the hook point was renamed across jax versions: 0.4.x calls the
+    # module-global `backend_compile` from _compile_and_write_cache; newer
+    # jax split out `backend_compile_and_load`. Bind whichever exists —
+    # silently failing open here re-exposes the concurrent-LLVM segfault
+    # on every runner thread that compiles mid-execution.
+    attr = next((a for a in ("backend_compile_and_load", "backend_compile")
+                 if getattr(_compiler, a, None) is not None), None)
+    if attr is None:
+        return
+    inner = getattr(_compiler, attr)
+    if getattr(inner, "_presto_tpu_locked", False):
         return
 
     import itertools
@@ -97,4 +106,4 @@ def install() -> None:
         return inner(backend, *args, **kwargs)
 
     locked._presto_tpu_locked = True
-    _compiler.backend_compile_and_load = locked
+    setattr(_compiler, attr, locked)

@@ -21,7 +21,7 @@ from tools.prestocheck import (all_pass_ids, load_baseline, run,  # noqa: E402
 
 EXPECTED_PASSES = {"undefined-name", "tracer-safety", "lock-discipline",
                    "exception-hygiene", "retry-discipline",
-                   "mutable-default-args", "sleep-poll"}
+                   "mutable-default-args", "sleep-poll", "host-sync"}
 
 
 def _scan(tmp_path, source, select=None, name="mod.py"):
@@ -393,6 +393,76 @@ def test_sleep_poll_suppression(tmp_path):
                 time.sleep(0.5)
         """, select=["sleep-poll"])
     assert findings == []
+
+
+# ----------------------------------------------------------------- host-sync
+
+def test_host_sync_flags_syncs_in_operator_hot_methods(tmp_path):
+    findings = _scan(tmp_path, """
+        import numpy as np
+        import jax
+
+        class FancyOperator:
+            def add_input(self, page):
+                n = int(np.asarray(page.mask).sum())
+                v = page.blocks[0].data.sum().item()
+                host = jax.device_get(page)
+                self._n = n + v
+
+            def get_output(self):
+                if self._pending is not None:
+                    self._pending.mask.block_until_ready()
+                return self._pending
+        """, select=["host-sync"])
+    msgs = "\n".join(_messages(findings))
+    assert len(findings) == 4, msgs
+    assert "np.asarray(...)" in msgs
+    assert ".item()" in msgs
+    assert "jax.device_get(...)" in msgs
+    assert ".block_until_ready()" in msgs
+
+
+def test_host_sync_ignores_non_operators_and_cold_methods(tmp_path):
+    findings = _scan(tmp_path, """
+        import numpy as np
+
+        class PageCodec:                  # not an operator class
+            def add_input(self, page):
+                return np.asarray(page)
+
+        class SinkOperator:
+            def finish(self):             # not a per-page hot method
+                return np.asarray(self._acc)
+
+            def add_input(self, page):
+                self._acc = page          # no sync: clean
+        """, select=["host-sync"])
+    assert findings == [], _messages(findings)
+
+
+def test_host_sync_detects_operator_by_base_class(tmp_path):
+    findings = _scan(tmp_path, """
+        import numpy as np
+        from presto_tpu.ops.operator import Operator
+
+        class Passthrough(Operator):
+            def add_input(self, page):
+                self._rows += int(np.asarray(page.mask).sum())
+        """, select=["host-sync"])
+    assert len(findings) == 1, _messages(findings)
+
+
+def test_host_sync_suppression(tmp_path):
+    findings = _scan(tmp_path, """
+        import numpy as np
+
+        class AdaptiveOperator:
+            def add_input(self, page):
+                if self._mode is None:  # once per stream, not per page
+                    frac = np.asarray(page.mask).mean()  # prestocheck: ignore[host-sync]
+                    self._mode = "pack" if frac < 0.5 else "pass"
+        """, select=["host-sync"])
+    assert findings == [], _messages(findings)
 
 
 # ------------------------------------------------------- mutable-default-args

@@ -278,6 +278,43 @@ def test_split_parallel_pcol_rows_identical_to_serial(pcol_runner):
     assert pipelined.stats and "scan_pipeline" in pipelined.stats
 
 
+def test_split_reader_setup_is_lazy(pcol_runner, monkeypatch):
+    """split_readers must open NO files at pipeline construction: headers
+    come from the metadata cache and dictionary remaps defer to the first
+    scheduled reader — 1000-file tables must not pay serial per-file setup
+    before the first page can flow."""
+    import presto_tpu.connectors.file as filemod
+    from presto_tpu.spi.connector import Constraint
+
+    r = pcol_runner()
+    r.execute("create table store.w.lazy as select l_orderkey, l_comment "
+              "from lineitem where l_orderkey < 400")
+    conn = r.metadata.connector("store")
+    table = conn.metadata().get_table_handle(
+        filemod.SchemaTableName("w", "lazy"))
+    splits = conn.split_manager().get_splits(table, Constraint.all(), 8)
+    cols = list(conn.metadata().get_table_metadata(table).columns)
+    src = conn.page_source_provider().create_page_source(
+        splits[0], cols, 1 << 10, Constraint.all())
+    if src.split_readers(1 << 10) is None:
+        pytest.skip("no native pcol: serial path has no split readers")
+
+    opens = []
+    real = filemod.PcolFile
+
+    def counting(path, *a, **kw):
+        opens.append(path)
+        return real(path, *a, **kw)
+
+    monkeypatch.setattr(filemod, "PcolFile", counting)
+    readers = src.split_readers(1 << 10)
+    assert readers, "expected at least one range reader"
+    assert opens == []  # construction touched no files
+    chunk = next(iter(readers[0]()))
+    assert chunk.rows > 0
+    assert opens  # the scheduled reader did the (deferred) open
+
+
 def test_query_stats_carry_stage_breakdown(pcol_runner):
     r = pcol_runner()
     r.execute("create table store.w.t as select * from nation")
