@@ -387,6 +387,59 @@ def bench_pcol_scan(sf: float, seconds_budget: float = 30.0,
     return out
 
 
+def bench_multichip_exchange(n_devices: int = 2,
+                             budget_s: float = 300.0) -> dict:
+    """Streaming mesh-exchange rung: a distributed group-by + broadcast-join
+    mix over an n-device VIRTUAL cpu mesh in a subprocess (the real-TPU mesh
+    numbers come from the round driver's dryrun_multichip, which prints the
+    same stats blob into MULTICHIP_*.json). Records per-exchange chunk
+    counts, collective compile counts (expect <= one per (kind, shape) per
+    query — the fixed chunk shape replaced the barrier path's per-pow2-bucket
+    recompiles) and overlap/stall seconds."""
+    import subprocess
+
+    script = (
+        "import os, json\n"
+        "os.environ['JAX_PLATFORMS'] = 'cpu'\n"
+        "flags = os.environ.get('XLA_FLAGS', '')\n"
+        "if 'host_platform_device_count' not in flags:\n"
+        f"    os.environ['XLA_FLAGS'] = (flags + "
+        f"' --xla_force_host_platform_device_count={n_devices}').strip()\n"
+        "import jax\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        "from presto_tpu.metadata import Session\n"
+        "from presto_tpu.parallel.mesh import MeshContext\n"
+        "from presto_tpu.parallel.runner import DistributedQueryRunner\n"
+        f"mesh = MeshContext(jax.devices()[:{n_devices}])\n"
+        "r = DistributedQueryRunner(mesh, session=Session(\n"
+        "    catalog='tpch', schema='tiny',\n"
+        "    properties={'exchange_chunk_rows': 256}))\n"
+        "out = {}\n"
+        "for name, sql in (\n"
+        "    ('group_by', 'select o_custkey % 11, count(*), "
+        "sum(o_totalprice) from orders group by 1'),\n"
+        "    ('join', 'select c_name, o_orderkey from customer join orders "
+        "on c_custkey = o_custkey order by o_orderkey limit 20'),\n"
+        "):\n"
+        "    res = r.execute(sql)\n"
+        "    ex = dict((res.stats or {}).get('exchange', {}))\n"
+        "    ex.pop('per_exchange', None)\n"
+        "    out[name] = ex\n"
+        "print('EXCH=' + json.dumps(out))\n")
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", script], capture_output=True, text=True,
+            timeout=budget_s, env=dict(os.environ, JAX_PLATFORMS="cpu"))
+        for line in proc.stdout.splitlines():
+            if line.startswith("EXCH="):
+                out = json.loads(line[5:])
+                out["n_devices"] = n_devices
+                return out
+        return {"error": (proc.stderr or proc.stdout)[-300:]}
+    except Exception as e:  # noqa: BLE001 - the rung must never kill the run
+        return {"error": repr(e)[:300]}
+
+
 def _cpu_engine_q3_baseline(budget_s: float = 300.0) -> int:
     """Q3 SF1 through the SAME engine pinned to the CPU backend, measured in
     a subprocess (the single-node CPU engine baseline the TPU number is
@@ -493,6 +546,11 @@ def main():
             seconds_budget=10.0 if args.quick else 30.0)
     except Exception as e:
         detail["pcol_q6"] = {"error": repr(e)[:300]}
+
+    # streaming mesh exchange: chunk/compile/overlap accounting on a small
+    # virtual mesh (subprocess — must not disturb this process's backend)
+    if not args.quick:
+        detail["multichip_exchange"] = bench_multichip_exchange()
 
     baseline = cpu_baseline_rows_per_sec()
     rps, batch_rows, step_ms, stream = bench_q1_kernel(

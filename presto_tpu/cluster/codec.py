@@ -77,7 +77,7 @@ _BOOTSTRAPPED = False
 
 def register(cls: type) -> type:
     """Add a dataclass to the wire allow-list (used by task.py's DTOs)."""
-    _REGISTRY[cls.__name__] = cls
+    _REGISTRY[cls.__name__] = cls  # prestocheck: ignore[unbounded-cache] - one entry per DTO class, fixed at import
     return cls
 
 

@@ -123,6 +123,14 @@ class Driver:
                 op.close()
             self._closed = True
 
+    def close(self) -> None:
+        """Release operator resources exactly once. The normal path closes on
+        FINISHED; this is for ABANDONED drivers (an executor run that raised
+        leaves the rest un-driven — their scan pipelines/exchange sinks must
+        still tear down so threads and device buffers don't outlive the
+        query)."""
+        self._close_operators()
+
     def run_to_completion(self, poll_sleep_s: float = 0.001) -> None:
         """Convenience for tests/benchmarks: drive until FINISHED.
 

@@ -239,6 +239,11 @@ class RemoteSourceSlot:
         # — the cluster task wires a MergingRemoteSource instead of the
         # interleaving StreamingRemoteSource
         self.merge_orderings = None
+        # STREAMING mode (the mesh runner's default): a
+        # parallel/streaming_exchange.StreamingExchange attached after
+        # planning and before driver creation — consumers then block on
+        # chunk arrival instead of replaying preloaded page lists
+        self.stream = None
 
     def set_pages(self, worker: int, pages: List[Page]) -> None:
         self._pages_by_worker[worker] = list(pages)
@@ -251,6 +256,33 @@ class RemoteSourceSlot:
         if self.source_factory is not None:
             return self.source_factory(worker)
         return FixedPageSource(self.pages(worker))
+
+
+class RemoteSourceOperatorFactory(TableScanOperatorFactory):
+    """Exchange endpoint factory (ExchangeOperator.java:35 analogue).
+
+    The mode is decided at DRIVER-CREATION time, after the runner has wired
+    the slot: with a StreamingExchange attached, consumers are
+    LocalExchangeSources over the exchange's per-worker chunk queue —
+    blocking on chunk arrival while the producer fragment still runs; the
+    barrier/cluster modes keep the inherited TableScanOperator replay of
+    deposited pages (or the cluster's streaming HTTP source_factory)."""
+
+    def __init__(self, operator_id: int, slot: RemoteSourceSlot,
+                 types: List[Type]):
+        super().__init__(operator_id, lambda w: [slot.make_source(w)], types,
+                         None)
+        self.name = "RemoteSource"
+        self.slot = slot
+
+    def create_operator(self, worker: int = 0):
+        stream = self.slot.stream
+        if stream is not None:
+            from ..parallel.streaming_exchange import StreamingExchangeSource
+            return StreamingExchangeSource(self.context(worker),
+                                           stream.out_buffer(worker),
+                                           list(self._types))
+        return super().create_operator(worker)
 
 
 @dataclasses.dataclass
@@ -629,9 +661,8 @@ class LocalExecutionPlanner:
         if slot is None:
             slot = self.remote_slots[node.fragment_id] = \
                 RemoteSourceSlot(node.fragment_id)
-        fac = TableScanOperatorFactory(
-            next(self._ids), lambda w: [slot.make_source(w)],
-            [s.type for s in node.symbols], None)
+        fac = RemoteSourceOperatorFactory(
+            next(self._ids), slot, [s.type for s in node.symbols])
         dicts = self.remote_dicts.get(node.fragment_id,
                                       [None] * len(node.symbols))
         out = Chain([fac], list(node.symbols), list(dicts))

@@ -79,6 +79,23 @@ class Session:
         # bytes (not page count) lets prefetch depth adapt to page size;
         # 0 = engine default (scan_pipeline.DEFAULT_PREFETCH_BYTES, 256MB)
         "scan_prefetch_bytes": 0,
+        # --- streaming mesh exchange (parallel/streaming_exchange.py) ---
+        # stream fixed-capacity chunks through the inter-fragment collectives
+        # while producer drivers still run (producer/consumer fragments share
+        # one task executor). False = the stage-barrier exchange — each
+        # fragment drains fully before one variable-shape collective — kept
+        # as the differential oracle, exactly like segment_fusion
+        "streaming_exchange": True,
+        # per-worker chunk capacity in rows (pow2-rounded); 0 = engine
+        # default (streaming_exchange.DEFAULT_CHUNK_ROWS, 4096). The chunk
+        # shape is FIXED per query, so each exchange kind compiles ONE
+        # collective program per query shape instead of one per pow2 volume
+        "exchange_chunk_rows": 0,
+        # in-flight byte bound per exchange: producer sinks park (BLOCKED)
+        # while staged + undelivered bytes exceed it — no stage ever holds a
+        # full intermediate result; 0 = engine default
+        # (streaming_exchange.DEFAULT_INFLIGHT_BYTES, 256MB)
+        "exchange_inflight_bytes": 0,
         # --- cluster fault tolerance (cluster/retry.py) ---
         # NONE fails fast; QUERY re-plans + re-runs the whole query on
         # retryable failures (failed nodes excluded from placement); TASK

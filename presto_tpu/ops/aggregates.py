@@ -477,7 +477,7 @@ EXTERNAL_AGGREGATES: dict = {}
 
 
 def register_aggregate(name: str, resolver) -> None:
-    EXTERNAL_AGGREGATES[name.lower()] = resolver
+    EXTERNAL_AGGREGATES[name.lower()] = resolver  # prestocheck: ignore[unbounded-cache] - plugin registry: one entry per registered function, not per request
 
 
 def _sortable_i64(y):

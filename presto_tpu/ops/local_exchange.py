@@ -30,17 +30,32 @@ class LocalExchangeBuffer:
 
     `max_pages` > 0 bounds the queue (the reference LocalExchange's
     maxBufferedBytes analogue): producers observe `has_room` and park as
-    BLOCKED until the consumer drains. The bound is only enabled when the
-    pipelines run under the task executor — a sequentially-driven producer
-    with no concurrent consumer must never deadlock on a full buffer."""
+    BLOCKED until the consumer drains. `max_bytes` > 0 bounds by PAYLOAD
+    size instead (the streaming mesh exchange's consumer queues — byte
+    bounds let depth adapt to page size, exactly like the scan pipeline's
+    prefetch budget); a put into an EMPTY buffer always succeeds so one
+    oversized page can never wedge the stream. The bound is only enabled
+    when the pipelines run under the task executor — a sequentially-driven
+    producer with no concurrent consumer must never deadlock on a full
+    buffer.
+
+    ``poison(exc)`` routes a producer-side failure (or a teardown while
+    consumers are still blocked) to every consumer: blocked parties wake and
+    the next ``poll``/blocking ``put`` raises instead of reporting a
+    silently truncated stream."""
 
     def __init__(self, n_producers: int, max_pages: int = 0,
-                 deal_slots: int = 0):
+                 deal_slots: int = 0, max_bytes: int = 0):
         self._pages: List[Page] = []
         self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
         self._open_producers = n_producers
         self.max_pages = max_pages
+        self.max_bytes = max_bytes
         self.rows_in = 0
+        self._bytes = 0
+        self._poison: Optional[BaseException] = None
+        self._abandoned = False
         # deal_slots > 0: pages are DEALT round-robin to that many consumer
         # slots instead of work-stolen from one shared list — the
         # reference's unpartitioned writer exchange, where every scaled
@@ -51,41 +66,114 @@ class LocalExchangeBuffer:
         self._dealt: List[List[Page]] = [[] for _ in range(deal_slots)]
         self._deal_next = 0
 
-    def put(self, page: Page) -> None:
-        with self._lock:
+    @staticmethod
+    def _page_bytes(page: Page) -> int:
+        from .scan_pipeline import page_nbytes
+        return page_nbytes(page)
+
+    def put(self, page: Page, block: bool = False) -> None:
+        """Append a page; with ``block=True`` wait for room under the byte/
+        page bound (poison aborts the wait with the poisoning exception)."""
+        with self._cv:
+            while block and not self._abandoned and \
+                    not self._has_room_locked():
+                if self._poison is not None:
+                    raise RuntimeError("local exchange buffer poisoned") \
+                        from self._poison
+                self._cv.wait(timeout=0.05)
+            if self._poison is not None and block:
+                raise RuntimeError("local exchange buffer poisoned") \
+                    from self._poison
+            if self._abandoned:
+                return  # consumer is gone: accept and discard
             if self.deal_slots:
                 self._dealt[self._deal_next].append(page)
                 self._deal_next = (self._deal_next + 1) % self.deal_slots
             else:
                 self._pages.append(page)
+            if self.max_bytes > 0:
+                # byte accounting only for byte-bounded buffers: the
+                # page-bounded local exchanges on the driver hot path must
+                # not pay a per-page nbytes walk for a counter nobody reads
+                self._bytes += self._page_bytes(page)
+            self._cv.notify_all()
 
     def _buffered(self) -> int:
         return len(self._pages) + sum(len(d) for d in self._dealt)
 
+    def _has_room_locked(self) -> bool:
+        if self._buffered() == 0:
+            return True
+        if self.max_pages > 0 and self._buffered() >= self.max_pages:
+            return False
+        if self.max_bytes > 0 and self._bytes >= self.max_bytes:
+            return False
+        return True
+
     def has_room(self) -> bool:
-        if self.max_pages <= 0:
+        if self.max_pages <= 0 and self.max_bytes <= 0:
             return True
         with self._lock:
-            return self._buffered() < self.max_pages
+            return self._has_room_locked()
+
+    def buffered_bytes(self) -> int:
+        with self._lock:
+            return self._bytes
+
+    def poison(self, exc: BaseException) -> None:
+        """Fail every current and future blocked consumer/producer."""
+        with self._cv:
+            if self._poison is None:
+                self._poison = exc
+            self._cv.notify_all()
+
+    def abandon(self) -> None:
+        """The (sole) consumer is gone and will never drain: drop buffered
+        pages and accept-and-discard future puts so producers can't block on
+        a queue nobody reads (an early-finishing LIMIT above an exchange
+        must not wedge the producers still streaming into it). Only valid
+        for single-consumer buffers — the streaming exchange's per-worker
+        queues; a shared work-stealing buffer must NOT be abandoned on one
+        consumer's close."""
+        with self._cv:
+            self._abandoned = True
+            self._pages.clear()
+            for d in self._dealt:
+                d.clear()
+            self._bytes = 0
+            self._cv.notify_all()
 
     def producer_finished(self) -> None:
-        with self._lock:
+        with self._cv:
             self._open_producers -= 1
+            self._cv.notify_all()
 
     def poll(self, slot: Optional[int] = None) -> Optional[Page]:
-        with self._lock:
+        with self._cv:
+            if self._poison is not None:
+                raise RuntimeError("local exchange buffer poisoned") \
+                    from self._poison
             pages = self._dealt[slot] if slot is not None else self._pages
             if pages:
-                return pages.pop(0)
+                page = pages.pop(0)
+                if self.max_bytes > 0:
+                    self._bytes = max(0,
+                                      self._bytes - self._page_bytes(page))
+                self._cv.notify_all()
+                return page
             return None
 
     def is_done(self, slot: Optional[int] = None) -> bool:
         with self._lock:
+            if self._poison is not None:
+                return False  # poll must run (and raise) — never "done"
             pages = self._dealt[slot] if slot is not None else self._pages
             return not pages and self._open_producers <= 0
 
     def has_output(self, slot: Optional[int] = None) -> bool:
         with self._lock:
+            if self._poison is not None:
+                return True  # wake blocked consumers so poll raises
             pages = self._dealt[slot] if slot is not None else self._pages
             return bool(pages) or self._open_producers <= 0
 

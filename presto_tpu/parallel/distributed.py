@@ -22,7 +22,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 from jax.sharding import PartitionSpec as P
-from jax import shard_map
+from .mesh import shard_map
 
 from ..ops.hash_agg import sort_group_reduce
 from .exchange import repartition

@@ -79,11 +79,51 @@ def repartition_by_pid(arrays: Sequence[jnp.ndarray], mask: jnp.ndarray,
     order = jnp.argsort(pid, stable=True)
     pid_s = pid[order]
     # slot of each row within its partition
-    ones = jnp.ones(n, dtype=jnp.int32)
     pos_in_part = jnp.arange(n, dtype=jnp.int32) - jnp.searchsorted(
         pid_s, pid_s, side="left").astype(jnp.int32)
     keep = (pid_s < n_parts) & (pos_in_part < out_cap_per_peer)
     dropped = jnp.sum((pid_s < n_parts) & ~keep)
+    outs, recv_mask = _route_kept(arrays, order, pid_s, pos_in_part, keep,
+                                  n_parts, out_cap_per_peer, axis_name)
+    return outs, recv_mask, dropped
+
+
+def repartition_by_pid_with_carry(arrays: Sequence[jnp.ndarray],
+                                  mask: jnp.ndarray, pid: jnp.ndarray,
+                                  n_parts: int, out_cap_per_peer: int,
+                                  axis_name: str = WORKER_AXIS):
+    """Carry-over variant for the STREAMING exchange: overflow rows (the ones
+    `repartition_by_pid` would drop when a peer's slice of this chunk exceeds
+    `out_cap_per_peer`) are returned compacted to the front of same-shape
+    carry buffers instead, staying resident on this worker for the pump to
+    re-feed into the next chunk. Skewed keys are therefore correct by
+    construction — capacity only bounds per-dispatch volume, never rows.
+
+    Returns (recv_arrays, recv_mask, carry_arrays, carry_mask)."""
+    n = mask.shape[0]
+    order = jnp.argsort(pid, stable=True)
+    pid_s = pid[order]
+    pos_in_part = jnp.arange(n, dtype=jnp.int32) - jnp.searchsorted(
+        pid_s, pid_s, side="left").astype(jnp.int32)
+    live = pid_s < n_parts
+    keep = live & (pos_in_part < out_cap_per_peer)
+    overflow = live & ~keep
+    outs, recv_mask = _route_kept(arrays, order, pid_s, pos_in_part, keep,
+                                  n_parts, out_cap_per_peer, axis_name)
+    # compact the overflow rows to the front of (n,) carry buffers
+    cpos = jnp.cumsum(overflow.astype(jnp.int32)) - 1
+    ctgt = jnp.where(overflow, cpos, n)
+    carry_mask = jnp.zeros(n, dtype=jnp.bool_).at[ctgt].set(overflow,
+                                                            mode="drop")
+    carry = [jnp.zeros(n, dtype=a.dtype).at[ctgt].set(a[order], mode="drop")
+             for a in arrays]
+    return outs, recv_mask, carry, carry_mask
+
+
+def _route_kept(arrays, order, pid_s, pos_in_part, keep, n_parts: int,
+                out_cap_per_peer: int, axis_name: str):
+    """Scatter the kept (sorted-by-pid) rows into (n_parts, cap) send buffers
+    and run the all_to_all; shared tail of the drop and carry repartitions."""
     # scatter into (n_parts, cap) send buffers
     tgt = jnp.where(keep, pid_s * out_cap_per_peer + pos_in_part,
                     n_parts * out_cap_per_peer)
@@ -101,7 +141,7 @@ def repartition_by_pid(arrays: Sequence[jnp.ndarray], mask: jnp.ndarray,
     recv_mask = lax.all_to_all(send_mask, axis_name, split_axis=0, concat_axis=0,
                                tiled=False)
     outs = [r.reshape(n_parts * out_cap_per_peer) for r in recv]
-    return outs, recv_mask.reshape(n_parts * out_cap_per_peer), dropped
+    return outs, recv_mask.reshape(n_parts * out_cap_per_peer)
 
 
 def broadcast_gather(arrays: Sequence[jnp.ndarray], mask: jnp.ndarray,

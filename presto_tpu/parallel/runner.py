@@ -6,21 +6,32 @@ where the reference boots N HTTP servers, here "workers" are mesh devices:
   parse -> analyze/plan -> optimize -> AddExchanges -> PlanFragmenter
   -> per fragment (bottom-up): drive each worker's operator pipeline over its
      shard (worker-scoped splits or exchange-output pages)
-  -> route the fragment's output through ONE shard_map collective over the ICI
+  -> route the fragment's output through shard_map collectives over the ICI
      mesh (all_to_all repartition / all_gather broadcast / gather-to-root)
 
 The data plane between fragments is the real XLA collective — the engine's
 answer to the reference's HTTP+LZ4 shuffle (PartitionedOutputOperator.java:380,
-ExchangeClient.java). Within a fragment, EVERY worker's drivers are enqueued
-on one shared TaskExecutor and time-slice across its runner threads (so 8
-virtual workers never host-serialize; build/probe pipelines of different
-workers overlap); the collective itself always runs as one SPMD program over
-all workers.
+ExchangeClient.java). Two modes:
+
+- STREAMING (default, `streaming_exchange=True`): every fragment's drivers
+  run concurrently on ONE task executor; fragment boundaries are
+  StreamingExchange instances (parallel/streaming_exchange.py) moving
+  fixed-capacity chunks through one compiled collective per chunk while
+  producers still run — the ExchangeClient pull-while-producing shape, with
+  byte-bounded backpressure on both sides.
+- BARRIER (`streaming_exchange=False`, the differential oracle): fragments
+  execute bottom-up, each draining fully before `run_exchange` routes ALL of
+  its output in one variable-shape collective — the pre-streaming data plane,
+  kept bit-for-bit for A/B testing exactly like `segment_fusion=False`.
+
+Within a fragment, EVERY worker's drivers are enqueued on one shared
+TaskExecutor and time-slice across its runner threads (so 8 virtual workers
+never host-serialize; build/probe pipelines of different workers overlap);
+the collective itself always runs as one SPMD program over all workers.
 """
 from __future__ import annotations
 
-import dataclasses
-import functools
+import sys
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -39,7 +50,15 @@ from ..sql.planner.plan import (BROADCAST, GATHER, MERGE, OutputNode,
                                 REPARTITION, RemoteSourceNode, plan_to_text)
 from ..sql.planner.planner import LogicalPlanner
 from ..types import Type
+from ..utils.metrics import METRICS
 from .mesh import MeshContext, WORKER_AXIS
+# shared exchange plumbing (one accounting + device-helper set for both data
+# planes); EXCHANGE_STATS re-exported here because the multichip dryrun (and
+# history) imports it from this module
+from .streaming_exchange import (EXCHANGE_STATS, ExchangeSinkOperatorFactory,  # noqa: F401
+                                 ExchangeStatsBook, StreamingExchange,
+                                 _compact_pad_jit, _range_key_for,
+                                 _zeros_shard, record_exchange_stat)
 
 # (pages for each worker, shared column dictionaries)
 RemoteInput = Tuple[List[Page], List[Optional[Dictionary]]]
@@ -101,20 +120,142 @@ class DistributedQueryRunner:
     # ------------------------------------------------------------ execution
 
     def _execute_subplan(self, sub: SubPlan) -> QueryResult:
+        book = ExchangeStatsBook()
+        if bool(self.session.get("streaming_exchange", True)):
+            result = self._execute_streaming(sub, book)
+        else:
+            result = self._execute_barrier(sub, book)
+        snap = book.snapshot()
+        if snap:
+            snap["mode"] = "streaming" \
+                if bool(self.session.get("streaming_exchange", True)) \
+                else "barrier"
+            result.stats = dict(result.stats or {}, exchange=snap)
+            METRICS.count_many(
+                {k: v for k, v in snap.items()
+                 if isinstance(v, (int, float))}, prefix="exchange.")
+        return result
+
+    def _fragment_root(self, sub: SubPlan, frag: Fragment) -> OutputNode:
+        if frag is sub.root_fragment:
+            return OutputNode(frag.root, sub.column_names, sub.output_symbols)
+        syms = frag.root.outputs()
+        return OutputNode(frag.root, [s.name for s in syms], syms)
+
+    def _routing_spec(self, frag: Fragment):
+        """-> (key_idx, orderings) for the fragment's output exchange."""
+        names = [s.name for s in frag.root.outputs()]
+        key_idx = None
+        orderings = None
+        if frag.output_kind == REPARTITION:
+            key_idx = [names.index(k.name) for k in frag.output_keys]
+        elif frag.output_kind == MERGE:
+            orderings = tuple(
+                (names.index(o.symbol.name), o.descending, o.nulls_first)
+                for o in frag.output_orderings)
+        return key_idx, orderings
+
+    def _execute_streaming(self, sub: SubPlan, book: ExchangeStatsBook) \
+            -> QueryResult:
+        """Plan every fragment, connect them with StreamingExchanges, then
+        run ALL fragments' drivers in ONE task-executor pass: producer and
+        consumer fragments time-slice on the same runner threads while the
+        exchange pumps move chunks between them."""
+        W = self.mesh.n_workers
+        frag_dicts: Dict[int, List[Optional[Dictionary]]] = {}
+        exchanges: Dict[int, StreamingExchange] = {}
+        sink_facs: Dict[int, ExchangeSinkOperatorFactory] = {}
+        query_memory = self.local._query_memory()
+        chunk_rows = int(self.session.get("exchange_chunk_rows") or 0)
+        inflight = int(self.session.get("exchange_inflight_bytes") or 0)
+        page_cap = int(self.session.get("page_capacity") or (1 << 14))
+        drivers = []
+        root_ep = None
+        try:
+            for frag in sub.fragments:
+                is_root = frag is sub.root_fragment
+                root = self._fragment_root(sub, frag)
+                workers = [0] if frag.partitioning == SINGLE_PART \
+                    else list(range(W))
+                lp = LocalExecutionPlanner(self.metadata, self.session,
+                                           n_workers=W,
+                                           remote_dicts=frag_dicts,
+                                           devices=self.mesh.devices)
+                lp.attach_memory(*query_memory)
+                if is_root:
+                    ep = lp.plan(root)
+                else:
+                    key_idx, orderings = self._routing_spec(frag)
+                    holder: dict = {}
+
+                    def sink_factory(types, dicts, _frag=frag, _key=key_idx,
+                                     _ord=orderings, _holder=holder, _lp=lp):
+                        ex = StreamingExchange(
+                            self.mesh, _frag.id, _frag.output_kind, _key,
+                            types, dicts, orderings=_ord,
+                            chunk_rows=chunk_rows, inflight_bytes=inflight,
+                            page_capacity=page_cap, book=book)
+                        fac = ExchangeSinkOperatorFactory(
+                            next(_lp._ids), ex, types)
+                        _holder["exchange"] = ex
+                        _holder["factory"] = fac
+                        return fac
+
+                    ep = lp.plan(root, sink_factory=sink_factory)
+                    exchanges[frag.id] = holder["exchange"]
+                    sink_facs[frag.id] = holder["factory"]
+                    frag_dicts[frag.id] = ep.output_dicts
+                # consumer endpoints: attach the producers' streams (created
+                # in fragment order, so every referenced exchange exists)
+                for fid, slot in ep.remote_slots.items():
+                    slot.stream = exchanges[fid]
+                for w in workers:
+                    drivers.extend(ep.create_drivers(w))
+                if is_root:
+                    root_ep = ep
+            # all drivers exist: producer counts are exact — start the pumps
+            for fid, ex in exchanges.items():
+                ex.start(sink_facs[fid].created)
+            TaskExecutor(
+                int(self.session.get("task_concurrency"))).execute(drivers)
+            return QueryResult(root_ep.sink.rows(), sub.column_names,
+                               root_ep.output_types)
+        finally:
+            err = sys.exc_info()[1]
+            for ex in exchanges.values():
+                ex.close(error=err)
+            if err is not None:
+                for d in drivers:
+                    try:
+                        d.close()
+                    except Exception:  # noqa: BLE001 - teardown best effort
+                        pass
+
+    def _execute_barrier(self, sub: SubPlan, book: ExchangeStatsBook) \
+            -> QueryResult:
+        """The pre-streaming stage-barrier loop, kept as the differential
+        oracle: each fragment drains fully, then ONE variable-shape
+        collective routes all of its output."""
+        # ONE memory pool + query context + task executor for the whole
+        # query: every fragment's operators draw on the same budget and the
+        # runner threads are reused across stages instead of rebuilt
+        query_memory = self.local._query_memory()
+        executor = TaskExecutor(int(self.session.get("task_concurrency")),
+                                persistent=True)
+        try:
+            return self._run_barrier_stages(sub, executor, query_memory, book)
+        finally:
+            executor.close()
+
+    def _run_barrier_stages(self, sub: SubPlan, executor: TaskExecutor,
+                            query_memory, book: ExchangeStatsBook) \
+            -> QueryResult:
         W = self.mesh.n_workers
         frag_dicts: Dict[int, List[Optional[Dictionary]]] = {}
         routed: Dict[int, List[List[Page]]] = {}  # fid -> per-worker pages
-        # ONE memory pool + query context for the whole query: every
-        # fragment's operators draw on the same budget
-        query_memory = self.local._query_memory()
         for frag in sub.fragments:
             is_root = frag is sub.root_fragment
-            if is_root:
-                root = OutputNode(frag.root, sub.column_names,
-                                  sub.output_symbols)
-            else:
-                syms = frag.root.outputs()
-                root = OutputNode(frag.root, [s.name for s in syms], syms)
+            root = self._fragment_root(sub, frag)
             workers = [0] if frag.partitioning == SINGLE_PART else list(range(W))
             # plan ONCE per fragment: every worker shares the factories (and so
             # the jit-compiled kernels); only splits/exchange pages differ
@@ -129,85 +270,35 @@ class DistributedQueryRunner:
             # all workers' drivers share one executor: worker tasks and their
             # build/probe pipelines time-slice across runner threads
             drivers = [d for w in workers for d in ep.create_drivers(w)]
-            TaskExecutor(
-                int(self.session.get("task_concurrency"))).execute(drivers)
+            executor.execute(drivers)
             if is_root:
                 return QueryResult(ep.sink.rows(), sub.column_names,
                                    ep.output_types)
             per_worker = [ep.sink.pages_for(w) for w in range(W)]
-            key_idx = None
-            orderings = None
-            names = [s.name for s in frag.root.outputs()]
-            if frag.output_kind == REPARTITION:
-                key_idx = [names.index(k.name) for k in frag.output_keys]
-            elif frag.output_kind == MERGE:
-                orderings = tuple(
-                    (names.index(o.symbol.name), o.descending, o.nulls_first)
-                    for o in frag.output_orderings)
+            key_idx, orderings = self._routing_spec(frag)
             routed[frag.id] = run_exchange(
                 self.mesh, frag.output_kind, key_idx, per_worker,
                 ep.output_types, ep.output_dicts,
                 page_capacity=int(self.session.get("page_capacity")
                                   or (1 << 14)),
-                orderings=orderings)
+                orderings=orderings, book=book)
             frag_dicts[frag.id] = ep.output_dicts
         raise AssertionError("root fragment must terminate execution")
 
 
 # ---------------------------------------------------------------------------
-# the exchange bridge: per-worker page lists -> one collective -> per-worker
-# page lists (the engine's entire shuffle data plane)
+# the barrier exchange bridge: per-worker page lists -> one collective ->
+# per-worker page lists (the oracle data plane; the streaming plane lives in
+# parallel/streaming_exchange.py and shares this module's device helpers)
 # ---------------------------------------------------------------------------
-
-# observability for the multichip dryrun's "no host copies between fragments"
-# check: host_uploads counts PAGE DATA crossing host->device in the exchange
-# (must stay zero — fragment chains are device-resident); zero_backfills
-# counts constant all-zero shards for workers that produced nothing, which
-# are cached per (device, dtype, length) and uploaded at most once ever
-EXCHANGE_STATS = {"host_uploads": 0, "zero_backfills": 0, "exchanges": 0}
-
-_ZEROS_CACHE: dict = {}
-
-
-def _zeros_shard(dev, dtype, L: int):
-    """Cached all-zero device array (immutable, safely shared as a read-only
-    collective input)."""
-    import jax
-
-    key = (dev, np.dtype(dtype).str, L)
-    z = _ZEROS_CACHE.get(key)
-    if z is None:
-        EXCHANGE_STATS["zero_backfills"] += 1
-        z = _ZEROS_CACHE[key] = jax.device_put(np.zeros(L, dtype=dtype), dev)
-    return z
 
 # shape floor for exchange buffers: below this, padding is free but every
 # distinct capacity would compile (and cache) another XLA collective
 _MIN_EXCHANGE_CAP = 1 << 9
 
 
-@functools.lru_cache(maxsize=1)
-def _compact_pad_jit():
-    """(R,) columns + mask -> (L,) prefix-compacted columns + mask, on the
-    inputs' device. The reference materializes selected positions the same
-    way before serializing (PartitionedOutputOperator.java:380); here it is
-    one fused scatter and the result never leaves the worker's chip."""
-    import jax
-    import jax.numpy as jnp
-
-    def fn(datas, nulls, mask, L):
-        pos = jnp.cumsum(mask.astype(jnp.int32)) - 1
-        tgt = jnp.where(mask, pos, L)  # dead rows scatter out of bounds
-        out_mask = jnp.zeros(L, dtype=jnp.bool_).at[tgt].set(mask, mode="drop")
-        out_d = tuple(jnp.zeros(L, dtype=a.dtype).at[tgt].set(a, mode="drop")
-                      for a in datas)
-        out_n = tuple(jnp.zeros(L, dtype=jnp.bool_).at[tgt].set(n, mode="drop")
-                      for n in nulls)
-        return out_d, out_n, out_mask
-    return jax.jit(fn, static_argnames=("L",))
-
-
-def _worker_device_columns(pages: List[Page], types: Sequence[Type]):
+def _worker_device_columns(pages: List[Page], types: Sequence[Type],
+                           book: Optional[ExchangeStatsBook] = None):
     """Concat+widen one worker's pages ON ITS DEVICE -> (datas, nulls, mask,
     live_count). Eager jnp ops follow the pages' committed device, so a worker
     whose pipeline ran on mesh device w compacts on device w."""
@@ -219,7 +310,7 @@ def _worker_device_columns(pages: List[Page], types: Sequence[Type]):
     for p in pages:
         if isinstance(p.mask, np.ndarray) or \
                 any(isinstance(b.data, np.ndarray) for b in p.blocks):
-            EXCHANGE_STATS["host_uploads"] += 1
+            record_exchange_stat("host_uploads", 1, book)
 
     ncols = len(types)
     masks = [jnp.asarray(p.mask) for p in pages]
@@ -238,59 +329,42 @@ def _worker_device_columns(pages: List[Page], types: Sequence[Type]):
     return datas, nulls, mask, jnp.sum(mask.astype(jnp.int32))
 
 
-def _range_key_for(data, nulls, type_, dictionary, descending: bool,
-                   nulls_first: bool):
-    """One worker's MERGE routing key (device, eager): the primary ORDER BY
-    column mapped to a monotone int64/float64 code — mirrors the local sort's
-    transform (ops/topn.py _sort_key_arrays) so range routing and the
-    per-worker sort can never disagree on order."""
-    import jax.numpy as jnp
-
-    from ..types import is_string
-
-    x = data
-    if is_string(type_) and dictionary is not None:
-        if hasattr(dictionary, "values"):
-            x = jnp.asarray(dictionary.sort_keys())[x]
-        elif not getattr(dictionary, "monotonic", False):
-            raise NotImplementedError(
-                f"distributed ORDER BY over non-monotonic virtual "
-                f"dictionary {dictionary!r}")
-    if jnp.issubdtype(x.dtype, jnp.floating):
-        key = x.astype(jnp.float64)
-        lo, hi = -jnp.inf, jnp.inf
-    else:
-        key = x.astype(jnp.int64)
-        info = np.iinfo(np.int64)
-        lo, hi = info.min + 1, info.max
-    if descending:
-        key = -key
-    if nulls is not None:
-        key = jnp.where(nulls, lo if nulls_first else hi, key)
-    return key
-
-
-@functools.lru_cache(maxsize=256)
 def _exchange_program(mesh, kind: str, key_idx: Optional[Tuple[int, ...]],
                       ncols: int, W: int, L: int, out_cap: int,
                       range_dtype: Optional[str] = None):
-    """Build + jit the exchange collective ONCE per (mesh, kind, keys, shape)
-    signature — repeated exchanges of the same shape reuse the compiled XLA
-    program (the reference reuses its HTTP buffer machinery similarly).
+    """-> (program, compiled_now). Build + jit the exchange collective ONCE
+    per (mesh, kind, keys, shape) signature — repeated exchanges of the same
+    shape reuse the compiled XLA program via the global LRU kernel cache
+    (the reference reuses its HTTP buffer machinery similarly).
+    `compiled_now` feeds the per-query compile counter race-free (a global
+    cache-stats diff would misattribute compiles between concurrently
+    executing queries).
 
     `out_cap` is the per-peer receive capacity. For REPARTITION the caller
     sizes it from the measured max (worker, peer) send count — sizing it to L
     (the worst case) would make every downstream page W/occupancy times
     padding, which on an 8-way mesh was a ~10x compute blowup."""
+    from ..utils import kernel_cache as kc
+
+    key = ("exchange-barrier", mesh, kind, key_idx, ncols, W, L, out_cap,
+           range_dtype)
+    return kc.get_or_build(
+        key, lambda: _build_exchange_program(mesh, kind, key_idx, ncols, W,
+                                             L, out_cap))
+
+
+def _build_exchange_program(mesh, kind: str,
+                            key_idx: Optional[Tuple[int, ...]],
+                            ncols: int, W: int, L: int, out_cap: int):
     import jax
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
-    from jax import shard_map
 
     from ..ops.hash_join import combined_key
     from .exchange import (broadcast_gather, gather_to_single,
                            range_partition_ids, repartition,
                            repartition_by_pid)
+    from .mesh import shard_map
 
     n_arrays = 2 * ncols
 
@@ -336,7 +410,8 @@ def run_exchange(mesh: MeshContext, kind: str, key_idx: Optional[List[int]],
                  per_worker_pages: List[List[Page]], types: Sequence[Type],
                  dicts: Sequence[Optional[Dictionary]],
                  page_capacity: int = 1 << 14,
-                 orderings=None) -> List[List[Page]]:
+                 orderings=None,
+                 book: Optional[ExchangeStatsBook] = None) -> List[List[Page]]:
     """Route every worker's output pages to their consumers with ONE shard_map
     collective over the mesh (REPARTITION=all_to_all, BROADCAST=all_gather,
     GATHER=all_gather masked to worker 0).
@@ -355,12 +430,12 @@ def run_exchange(mesh: MeshContext, kind: str, key_idx: Optional[List[int]],
 
     W = mesh.n_workers
     ncols = len(types)
-    EXCHANGE_STATS["exchanges"] += 1
+    record_exchange_stat("exchanges", 1, book)
 
     compacted = [None] * W
     for w, pages in enumerate(per_worker_pages):
         if pages:
-            compacted[w] = _worker_device_columns(pages, types)
+            compacted[w] = _worker_device_columns(pages, types, book)
     # ONE batched host transfer for all workers' live counts (device_get on
     # the list issues every d2h together, not W serialized blocking syncs)
     live_devs = [c[3] for c in compacted if c is not None]
@@ -381,11 +456,11 @@ def run_exchange(mesh: MeshContext, kind: str, key_idx: Optional[List[int]],
         dev = mesh.devices[w]
         if compacted[w] is None:
             # no output on this worker: cached constant zero shards
-            shard_datas[w] = [_zeros_shard(dev, types[c].np_dtype, L)
+            shard_datas[w] = [_zeros_shard(dev, types[c].np_dtype, L, book)
                               for c in range(ncols)]
-            shard_nulls[w] = [_zeros_shard(dev, bool, L)
+            shard_nulls[w] = [_zeros_shard(dev, bool, L, book)
                               for _ in range(ncols)]
-            shard_masks[w] = _zeros_shard(dev, bool, L)
+            shard_masks[w] = _zeros_shard(dev, bool, L, book)
             continue
         datas, nulls, mask, _ = compacted[w]
         out_d, out_n, out_m = compact(tuple(datas), tuple(nulls), mask, L)
@@ -471,22 +546,27 @@ def run_exchange(mesh: MeshContext, kind: str, key_idx: Optional[List[int]],
         out_cap = min(out_cap, L)
 
     # jax.sharding.Mesh is hashable and value-equal: safe as the cache key
-    program = _exchange_program(
+    program, compiled_now = _exchange_program(
         mesh.mesh, kind, tuple(key_idx) if key_idx is not None else None,
         ncols, W, L, out_cap,
         str(range_keys[0].dtype) if kind == MERGE else None)
-    if kind == MERGE:
-        g_rangekey = assemble([range_keys[w] for w in range(W)])
-        out_arrays, out_mask, dropped = program(
-            tuple(dev_arrays), dev_mask, g_rangekey, splitters)
-    else:
-        out_arrays, out_mask, dropped = program(tuple(dev_arrays), dev_mask)
+    if book is not None and compiled_now:
+        book.bump("collective_compiles")
+    from .streaming_exchange import COLLECTIVE_DISPATCH_LOCK
+    with COLLECTIVE_DISPATCH_LOCK:
+        if kind == MERGE:
+            g_rangekey = assemble([range_keys[w] for w in range(W)])
+            out_arrays, out_mask, dropped = program(
+                tuple(dev_arrays), dev_mask, g_rangekey, splitters)
+        else:
+            out_arrays, out_mask, dropped = program(tuple(dev_arrays),
+                                                    dev_mask)
     n_dropped = int(np.asarray(dropped).sum())
     if n_dropped:
         # the send buffers are sized to the fullest worker's live rows, so a
         # drop means a sizing bug upstream — corrupt results must fail loudly
-        # (the reference's OutputBuffer applies backpressure instead; see
-        # parallel/exchange.py repartition docstring)
+        # (the streaming exchange carries overflow over to the next chunk
+        # instead; see parallel/streaming_exchange.py)
         raise RuntimeError(
             f"repartition exchange dropped {n_dropped} rows "
             f"(capacity {L} per peer, {W} workers)")

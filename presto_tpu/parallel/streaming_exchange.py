@@ -1,0 +1,987 @@
+"""Streaming mesh exchange: chunked, overlapped inter-fragment collectives.
+
+The barrier exchange (parallel/runner.run_exchange) drains a whole fragment,
+materializes ALL of its output, and only then lets the consumer fragment
+start — the device idles at every stage boundary and an entire intermediate
+result is resident at once. The reference never works that way: its
+ExchangeClient pulls pages over HTTP while producers still run
+(operator/ExchangeClient.java), and OutputBuffer backpressure bounds what is
+in flight. This module is that data plane, TPU-shaped:
+
+- producer drivers of fragment F end in an :class:`ExchangeSinkOperator`
+  feeding per-worker CHUNK buffers (fixed pow2 capacity) instead of
+  accumulating pages;
+- an exchange pump thread dispatches ONE compiled shard_map collective per
+  chunk; the shape is static per query, so the repartition/broadcast/merge
+  program compiles once per (kind, shape) and is reused for every chunk —
+  unlike the barrier path's per-exchange pow2-volume recompiles;
+- dispatch is double-buffered: the collective for chunk k is issued async
+  (XLA dispatch returns futures) and its delivery sync is deferred until
+  chunk k+1 has been absorbed and dispatched, so host-side compaction of the
+  next chunk overlaps the in-flight collective;
+- REPARTITION/MERGE overflow rows (what `repartition_by_pid` would drop)
+  come back as same-shape CARRY buffers, re-fed into the next chunk — skewed
+  keys are correct by construction, not by worst-case capacity sizing;
+- in-flight bytes are bounded on both sides: producers park (BLOCKED, the
+  task executor's poll-able future) when staged + undelivered bytes exceed
+  `exchange_inflight_bytes`, mirroring the scan pipeline's byte budget; no
+  stage ever holds a full intermediate result.
+
+MERGE exchanges fix their range splitters at the first dispatch and route
+every chunk through the same ranges, so worker shards stay globally
+disjoint; the consumer fragment's per-worker sort (the bounded re-order the
+mesh plan already carries downstream of every MERGE) restores within-worker
+order regardless of chunk arrival interleaving.
+"""
+from __future__ import annotations
+
+import functools
+import threading
+import time
+from collections import OrderedDict
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..block import Block, Dictionary, Page
+from ..ops.local_exchange import LocalExchangeBuffer, LocalExchangeSource
+from ..ops.operator import Operator, OperatorContext, OperatorFactory, timed
+from ..ops.scan_pipeline import page_nbytes
+from ..sql.planner.plan import BROADCAST, GATHER, MERGE, REPARTITION
+from ..types import Type
+from .mesh import MeshContext, WORKER_AXIS
+
+# ---------------------------------------------------------------------------
+# shared exchange observability + device helpers (the barrier path in
+# parallel/runner.py imports these — one accounting, two data planes)
+# ---------------------------------------------------------------------------
+
+# process-wide aggregate for the multichip dryrun's "no host copies between
+# fragments" check: host_uploads counts PAGE DATA crossing host->device in
+# the exchange (must stay zero — fragment chains are device-resident);
+# zero_backfills counts constant all-zero shards, cached and uploaded at
+# most once per (device, dtype, length). Mutate via record_exchange_stat.
+EXCHANGE_STATS = {"host_uploads": 0, "zero_backfills": 0, "exchanges": 0}
+
+_STATS_LOCK = threading.Lock()
+
+
+class ExchangeStatsBook:
+    """Per-query exchange counters (rolled into QueryResult.stats["exchange"]
+    and flushed to /v1/metrics as `exchange.*`). Thread-safe: producer
+    drivers, the pump threads and the runner all write concurrently."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.counters: Dict[str, float] = {}
+        self.per_exchange: List[dict] = []
+
+    def bump(self, name: str, delta: float = 1) -> None:
+        with self._lock:
+            self.counters[name] = self.counters.get(name, 0) + delta
+
+    def add_exchange(self, entry: dict) -> None:
+        with self._lock:
+            self.per_exchange.append(entry)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            out = {k: (round(v, 6) if isinstance(v, float) else v)
+                   for k, v in self.counters.items()}
+            if self.per_exchange:
+                out["per_exchange"] = [dict(e) for e in self.per_exchange]
+            return out
+
+
+def record_exchange_stat(name: str, delta: int = 1,
+                         book: Optional[ExchangeStatsBook] = None) -> None:
+    """Bump the process-wide EXCHANGE_STATS counter (under its lock — pump
+    threads and the runner mutate concurrently) and, when given, the active
+    query's book."""
+    with _STATS_LOCK:
+        if name in EXCHANGE_STATS:
+            EXCHANGE_STATS[name] += delta
+    if book is not None:
+        book.bump(name, delta)
+
+
+# cached constant all-zero device shards. LRU-bounded: every distinct
+# (device, dtype, length) is a resident device allocation — the pow2 shape
+# discipline keeps the key set tiny, and evicting the COLDEST entry (not
+# clearing wholesale) keeps the hot chunk templates every _fresh_chunk
+# needs resident even when a shape-churning workload cycles past the bound.
+_ZEROS_CACHE: "OrderedDict[tuple, object]" = OrderedDict()
+_ZEROS_CACHE_MAX = 256
+_ZEROS_LOCK = threading.Lock()
+
+
+def _zeros_shard(dev, dtype, L: int, book: Optional[ExchangeStatsBook] = None):
+    """Cached all-zero device array (immutable, safely shared as a read-only
+    collective input). Pump threads and the barrier path hit this
+    concurrently — LRU bookkeeping is not atomic, hence the lock."""
+    import jax
+
+    key = (dev, np.dtype(dtype).str, L)
+    with _ZEROS_LOCK:
+        z = _ZEROS_CACHE.get(key)
+        if z is not None:
+            _ZEROS_CACHE.move_to_end(key)
+            return z
+    record_exchange_stat("zero_backfills", 1, book)
+    z = jax.device_put(np.zeros(L, dtype=dtype), dev)
+    with _ZEROS_LOCK:
+        cur = _ZEROS_CACHE.get(key)
+        if cur is not None:
+            return cur
+        while len(_ZEROS_CACHE) >= _ZEROS_CACHE_MAX:
+            _ZEROS_CACHE.popitem(last=False)
+        _ZEROS_CACHE[key] = z
+    return z
+
+
+@functools.lru_cache(maxsize=1)
+def _compact_pad_jit():
+    """(R,) columns + mask -> (L,) prefix-compacted columns + mask, on the
+    inputs' device. The reference materializes selected positions the same
+    way before serializing (PartitionedOutputOperator.java:380); here it is
+    one fused scatter and the result never leaves the worker's chip."""
+    import jax
+    import jax.numpy as jnp
+
+    def fn(datas, nulls, mask, L):
+        pos = jnp.cumsum(mask.astype(jnp.int32)) - 1
+        tgt = jnp.where(mask, pos, L)  # dead rows scatter out of bounds
+        out_mask = jnp.zeros(L, dtype=jnp.bool_).at[tgt].set(mask, mode="drop")
+        out_d = tuple(jnp.zeros(L, dtype=a.dtype).at[tgt].set(a, mode="drop")
+                      for a in datas)
+        out_n = tuple(jnp.zeros(L, dtype=jnp.bool_).at[tgt].set(n, mode="drop")
+                      for n in nulls)
+        return out_d, out_n, out_mask
+    return jax.jit(fn, static_argnames=("L",))
+
+
+def _range_key_for(data, nulls, type_, dictionary, descending: bool,
+                   nulls_first: bool):
+    """One worker's MERGE routing key (device, eager): the primary ORDER BY
+    column mapped to a monotone int64/float64 code — mirrors the local sort's
+    transform (ops/topn.py _sort_key_arrays) so range routing and the
+    per-worker sort can never disagree on order."""
+    import jax.numpy as jnp
+
+    from ..types import is_string
+
+    x = data
+    if is_string(type_) and dictionary is not None:
+        if hasattr(dictionary, "values"):
+            x = jnp.asarray(dictionary.sort_keys())[x]
+        elif not getattr(dictionary, "monotonic", False):
+            raise NotImplementedError(
+                f"distributed ORDER BY over non-monotonic virtual "
+                f"dictionary {dictionary!r}")
+    if jnp.issubdtype(x.dtype, jnp.floating):
+        key = x.astype(jnp.float64)
+        lo, hi = -jnp.inf, jnp.inf
+    else:
+        key = x.astype(jnp.int64)
+        info = np.iinfo(np.int64)
+        lo, hi = info.min + 1, info.max
+    if descending:
+        key = -key
+    if nulls is not None:
+        key = jnp.where(nulls, lo if nulls_first else hi, key)
+    return key
+
+
+def _pow2(n: int, floor: int = 1) -> int:
+    return max(1 << (max(int(n), 1) - 1).bit_length(), floor)
+
+
+# ---------------------------------------------------------------------------
+# chunk fill kernel: append a page's live rows to a fixed-capacity chunk
+# ---------------------------------------------------------------------------
+
+# default per-worker chunk capacity (rows) and in-flight byte budget; session
+# knobs exchange_chunk_rows / exchange_inflight_bytes override
+DEFAULT_CHUNK_ROWS = 1 << 12
+DEFAULT_INFLIGHT_BYTES = 1 << 28
+
+# per-peer receive floor for the streaming repartition: smaller than the
+# barrier path's _MIN_EXCHANGE_CAP because the chunk shape is FIXED per
+# query anyway (no compile-diversity concern) and carry-over makes small
+# capacities correct; tiny floors only cost extra dispatches under skew
+_MIN_STREAM_OUT_CAP = 1 << 6
+
+
+@functools.lru_cache(maxsize=128)
+def _fill_chunk_jit(ncols: int, C: int):
+    """(chunk state, page) -> (new chunk state, leftover page).
+
+    Live page rows append densely at chunk positions count..count+live-1;
+    rows past capacity C compact to the front of same-shape leftover buffers
+    (the pump dispatches the full chunk and re-feeds the leftover). One
+    fused scatter per page — the chunk buffers never round-trip the host."""
+    import jax
+    import jax.numpy as jnp
+
+    def fn(ch_d, ch_n, ch_m, count, pd, pn, pm):
+        P = pm.shape[0]
+        pos = count + jnp.cumsum(pm.astype(jnp.int32)) - 1
+        into = pm & (pos < C)
+        tgt = jnp.where(into, pos, C)
+        new_m = ch_m.at[tgt].set(into, mode="drop")
+        new_d = tuple(d.at[tgt].set(p, mode="drop")
+                      for d, p in zip(ch_d, pd))
+        new_n = tuple(x.at[tgt].set(p, mode="drop")
+                      for x, p in zip(ch_n, pn))
+        left = pm & (pos >= C)
+        lpos = jnp.cumsum(left.astype(jnp.int32)) - 1
+        ltgt = jnp.where(left, lpos, P)
+        left_m = jnp.zeros(P, dtype=jnp.bool_).at[ltgt].set(left, mode="drop")
+        left_d = tuple(jnp.zeros(P, dtype=p.dtype).at[ltgt].set(p, mode="drop")
+                       for p in pd)
+        left_n = tuple(jnp.zeros(P, dtype=jnp.bool_).at[ltgt].set(p,
+                                                                  mode="drop")
+                       for p in pn)
+        return new_d, new_n, new_m, left_d, left_n, left_m
+    return jax.jit(fn)
+
+
+# ---------------------------------------------------------------------------
+# the per-chunk collective program (compiled once per (kind, shape), reused
+# for every chunk of every exchange with that signature)
+# ---------------------------------------------------------------------------
+
+# Collective LAUNCH order must be identical on every device: two pump
+# threads (or a pump and a barrier exchange) each dispatching an SPMD
+# program could otherwise enqueue their collectives in different orders on
+# different devices — the classic concurrent-collective deadlock. Dispatch
+# is async (returns futures), so serializing the launch keeps all the
+# overlap while guaranteeing one global enqueue order.
+COLLECTIVE_DISPATCH_LOCK = threading.Lock()
+
+
+def _streaming_program(mesh, kind: str, key_idx: Optional[Tuple[int, ...]],
+                       ncols: int, W: int, C: int, out_cap: int,
+                       range_dtype: Optional[str]):
+    """-> (program, compiled_now). Carry-aware analogue of the barrier
+    path's _exchange_program: REPARTITION/MERGE return
+    (out_arrays, out_mask, carry_arrays, carry_mask); BROADCAST/GATHER
+    return (out_arrays, out_mask) — an all_gather has full capacity, so
+    nothing can ever overflow. Programs live in the global LRU kernel cache
+    (one compile per (mesh, kind, keys, shape), ever)."""
+    from ..utils import kernel_cache as kc
+
+    key = ("exchange-stream", mesh, kind, key_idx, ncols, W, C, out_cap,
+           range_dtype)
+    return kc.get_or_build(
+        key, lambda: _build_streaming_program(mesh, kind, key_idx, ncols, W,
+                                              C, out_cap))
+
+
+def _build_streaming_program(mesh, kind: str,
+                             key_idx: Optional[Tuple[int, ...]],
+                             ncols: int, W: int, C: int, out_cap: int):
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from ..ops.hash_join import combined_key
+    from .mesh import shard_map
+    from .exchange import (broadcast_gather, gather_to_single, partition_ids,
+                           range_partition_ids, repartition_by_pid_with_carry)
+
+    n_arrays = 2 * ncols
+    sharded = tuple(P(WORKER_AXIS) for _ in range(n_arrays))
+
+    if kind == MERGE:
+        def merge_stage(arrays, mask, range_key, splitters):
+            pid = range_partition_ids(range_key, splitters, mask, W)
+            out, m, carry, cm = repartition_by_pid_with_carry(
+                list(arrays) + [range_key], mask, pid, W, out_cap)
+            # the carried range_key is dropped: the pump recomputes it when
+            # the carry refills the next chunk (same transform, same answer)
+            return tuple(out[:-1]), m, tuple(carry[:-1]), cm
+
+        smapped = shard_map(
+            merge_stage, mesh=mesh,
+            in_specs=(sharded, P(WORKER_AXIS), P(WORKER_AXIS), P()),
+            out_specs=(sharded, P(WORKER_AXIS), sharded, P(WORKER_AXIS)))
+        prog = jax.jit(smapped)
+    elif kind == REPARTITION:
+        def repart_stage(arrays, mask):
+            keys = [jnp.where(arrays[ncols + i], 0,
+                              arrays[i]).astype(jnp.int64) for i in key_idx]
+            pid = jnp.where(mask, partition_ids(combined_key(keys), W), W)
+            out, m, carry, cm = repartition_by_pid_with_carry(
+                list(arrays), mask, pid, W, out_cap)
+            return tuple(out), m, tuple(carry), cm
+
+        smapped = shard_map(
+            repart_stage, mesh=mesh,
+            in_specs=(sharded, P(WORKER_AXIS)),
+            out_specs=(sharded, P(WORKER_AXIS), sharded, P(WORKER_AXIS)))
+        prog = jax.jit(smapped)
+    else:
+        def gather_stage(arrays, mask):
+            if kind == BROADCAST:
+                out, m = broadcast_gather(list(arrays), mask)
+            elif kind == GATHER:
+                out, m = gather_to_single(list(arrays), mask)
+            else:
+                raise AssertionError(kind)
+            return tuple(out), m
+
+        smapped = shard_map(
+            gather_stage, mesh=mesh,
+            in_specs=(sharded, P(WORKER_AXIS)),
+            out_specs=(sharded, P(WORKER_AXIS)))
+        prog = jax.jit(smapped)
+    return prog
+
+
+class _Closed(Exception):
+    """Internal pump-unwind signal for close-while-running teardown."""
+
+
+# ---------------------------------------------------------------------------
+# the exchange itself
+# ---------------------------------------------------------------------------
+
+class _ChunkState:
+    """One worker's in-progress send chunk: fixed-capacity device buffers
+    plus the host-tracked fill count (rows are packed densely at the front,
+    so `count` fully describes the live prefix)."""
+
+    __slots__ = ("datas", "nulls", "mask", "count")
+
+    def __init__(self, datas, nulls, mask):
+        self.datas = datas
+        self.nulls = nulls
+        self.mask = mask
+        self.count = 0
+
+
+class _QueuedPage:
+    """A column batch awaiting absorption into a chunk.
+
+    `live` is None until the batched device_get resolves it. `is_carry`
+    marks a re-queued overflow buffer (counted as carry, not input rows);
+    `charged_bytes` is EXACTLY what add_page charged against the in-flight
+    budget for this batch's source page (0 for leftovers and carry, whose
+    backing page was already released or never charged) — releasing the
+    same figure keeps the accounting symmetric no matter how widening or
+    null-mask materialization changed the device footprint."""
+
+    __slots__ = ("datas", "nulls", "mask", "live", "is_carry",
+                 "charged_bytes")
+
+    def __init__(self, datas, nulls, mask, live=None, is_carry=False,
+                 charged_bytes=0):
+        self.datas = datas
+        self.nulls = nulls
+        self.mask = mask
+        self.live = live
+        self.is_carry = is_carry
+        self.charged_bytes = charged_bytes
+
+
+class StreamingExchange:
+    """Producer chunk buffers -> per-chunk collective -> consumer queues.
+
+    One instance per fragment boundary. Producer sinks call
+    :meth:`add_page` / :meth:`producer_finished`; consumers read the
+    per-worker :class:`LocalExchangeBuffer` from :meth:`out_buffer`. The
+    pump thread owns all device work between the two."""
+
+    def __init__(self, mesh: MeshContext, fragment_id: int, kind: str,
+                 key_idx: Optional[List[int]], types: Sequence[Type],
+                 dicts: Sequence[Optional[Dictionary]],
+                 orderings=None, chunk_rows: int = 0,
+                 inflight_bytes: int = 0, page_capacity: int = 1 << 14,
+                 book: Optional[ExchangeStatsBook] = None):
+        self.mesh = mesh
+        self.fragment_id = fragment_id
+        self.kind = kind
+        self.key_idx = tuple(key_idx) if key_idx is not None else None
+        self.types = list(types)
+        self.dicts = list(dicts)
+        self.orderings = orderings
+        self.book = book
+        W = mesh.n_workers
+        self.W = W
+        self.chunk_rows = _pow2(chunk_rows or DEFAULT_CHUNK_ROWS, floor=64)
+        self.inflight_bytes = int(inflight_bytes or DEFAULT_INFLIGHT_BYTES)
+        self.page_capacity = page_capacity
+        if kind in (REPARTITION, MERGE):
+            # per-peer receive slice: 2x the balanced share, floored low —
+            # overflow carries over, so this only trades dispatch count
+            # against padding bandwidth, never correctness
+            self.out_cap = min(self.chunk_rows,
+                               _pow2(-(-2 * self.chunk_rows // W),
+                                     floor=_MIN_STREAM_OUT_CAP))
+        else:
+            self.out_cap = self.chunk_rows
+        self._cv = threading.Condition()
+        self._inbox: List[List[Page]] = [[] for _ in range(W)]
+        self._inbox_bytes = 0
+        self._open_producers: Optional[int] = None
+        self._error: Optional[BaseException] = None
+        self._closed = False
+        # consumer queues: byte-bounded so a slow consumer backpressures the
+        # pump (and through it the producers) instead of buffering the world
+        per_worker_bytes = max(self.inflight_bytes // (2 * W), 1 << 16)
+        self._out = [LocalExchangeBuffer(n_producers=1,
+                                         max_bytes=per_worker_bytes)
+                     for _ in range(W)]
+        self._pump: Optional[threading.Thread] = None
+        self._finished_ok = False
+        # stats (pump-thread private until publish)
+        self.stats = {"fragment": fragment_id, "kind": kind,
+                      "chunk_rows": self.chunk_rows, "out_cap": self.out_cap,
+                      "chunks": 0, "overlap_chunks": 0, "rows_in": 0,
+                      "rows_out": 0, "carry_rows": 0, "compiles": 0,
+                      "dispatch_s": 0.0, "overlap_s": 0.0, "stall_s": 0.0}
+
+    # ------------------------------------------------------------- lifecycle
+
+    def start(self, n_producers: int) -> None:
+        """Called once all producer sinks are created (driver instantiation
+        precedes execution, so the count is exact before any page flows)."""
+        with self._cv:
+            self._open_producers = n_producers
+            self._cv.notify_all()
+        record_exchange_stat("exchanges", 1, self.book)
+        self._pump = threading.Thread(
+            target=self._pump_loop, daemon=True,
+            name=f"exchange-pump-f{self.fragment_id}")
+        self._pump.start()
+
+    def close(self, error: Optional[BaseException] = None) -> None:
+        """Tear down: wake every blocked party, poison the consumer queues
+        (so a consumer blocked mid-stream raises instead of silently seeing
+        a truncated input) and join the pump. Idempotent; a no-op after a
+        clean pump finish except for the thread join."""
+        with self._cv:
+            self._closed = True
+            if error is not None and self._error is None:
+                self._error = error
+            self._cv.notify_all()
+        # poison BEFORE joining: a pump blocked on a full consumer queue (or
+        # a consumer blocked on an empty one) wakes through the buffer's own
+        # condition, not the exchange's
+        if error is not None or not self._finished_ok:
+            exc = error or RuntimeError(
+                f"streaming exchange (fragment {self.fragment_id}) closed "
+                "before its stream completed")
+            for b in self._out:
+                b.poison(exc)
+        if self._pump is not None:
+            self._pump.join(timeout=10.0)
+
+    # ---------------------------------------------------------- producer api
+
+    def add_page(self, worker: int, page: Page) -> None:
+        with self._cv:
+            if self._error is not None:
+                raise RuntimeError(
+                    f"streaming exchange (fragment {self.fragment_id}) "
+                    f"failed") from self._error
+            if self._closed:
+                raise RuntimeError(
+                    f"streaming exchange (fragment {self.fragment_id}) "
+                    "is closed")
+            self._inbox[worker].append(page)
+            self._inbox_bytes += page_nbytes(page)
+            self._cv.notify_all()
+
+    def has_capacity(self) -> bool:
+        """Producer backpressure poll. True also on error/close so parked
+        sinks wake and surface the failure from add_input."""
+        if self._error is not None or self._closed:
+            return True
+        out_bytes = sum(b.buffered_bytes() for b in self._out)
+        with self._cv:
+            return self._inbox_bytes + out_bytes < self.inflight_bytes
+
+    def producer_finished(self) -> None:
+        with self._cv:
+            if self._open_producers is not None:
+                self._open_producers -= 1
+            self._cv.notify_all()
+
+    # ---------------------------------------------------------- consumer api
+
+    def out_buffer(self, worker: int) -> LocalExchangeBuffer:
+        return self._out[worker]
+
+    # -------------------------------------------------------------- the pump
+
+    def _pump_loop(self) -> None:
+        try:
+            self._pump_run()
+        except _Closed:
+            pass  # close() already poisoned the consumer side
+        except BaseException as e:  # noqa: BLE001 - relayed to both sides
+            with self._cv:
+                if self._error is None:
+                    self._error = e
+                self._cv.notify_all()
+            for b in self._out:
+                b.poison(e)
+        else:
+            self._finished_ok = True
+            for b in self._out:
+                b.producer_finished()
+        finally:
+            # even an interrupted pump (close mid-flush, producer error)
+            # publishes what it measured — chunk counts bumped at dispatch
+            # must never appear without their overlap/stall attribution
+            self._publish_stats()
+
+    def _check_live(self) -> None:
+        with self._cv:
+            if self._error is not None:
+                raise self._error
+            if self._closed:
+                raise _Closed()
+
+    def _pump_run(self) -> None:
+        W = self.W
+        devices = self.mesh.devices
+        state = [self._fresh_chunk(w) for w in range(W)]
+        # (datas, nulls, mask, live_or_None) pages awaiting absorption; a
+        # None live count is resolved in the next batched device_get
+        queue: List[List[list]] = [[] for _ in range(W)]
+        pending_delivery = None
+        self._splitters = None
+        self._range_dtype = None
+
+        while True:
+            # ---- wait for pages / completion ------------------------------
+            with self._cv:
+                idle = not any(self._inbox)
+            if pending_delivery is not None and idle:
+                # the pump is about to park: hand the in-flight chunk to the
+                # consumers now instead of letting it ride until the next
+                # dispatch (double buffering must never become starvation)
+                self._deliver(pending_delivery)
+                pending_delivery = None
+            with self._cv:
+                t0 = time.perf_counter()
+                while not any(self._inbox) and \
+                        (self._open_producers is None or
+                         self._open_producers > 0) and \
+                        self._error is None and not self._closed:
+                    self._cv.wait(timeout=0.05)
+                self.stats["stall_s"] += time.perf_counter() - t0
+                drained = self._inbox
+                self._inbox = [[] for _ in range(W)]
+                producers_done = (self._open_producers is not None and
+                                  self._open_producers <= 0)
+            self._check_live()
+
+            # ---- ingest drained pages into the absorb queues --------------
+            for w in range(W):
+                for p in drained[w]:
+                    queue[w].append(self._page_columns(p, devices[w]))
+
+            # ---- absorb, dispatching whenever a chunk fills ---------------
+            pending_delivery = self._absorb(state, queue, pending_delivery)
+
+            if producers_done and not any(queue) and \
+                    not any(s.count for s in state):
+                break
+            if producers_done and not any(self._inbox):
+                # flush: drain partial chunks (and any carry they generate)
+                while any(queue) or any(s.count for s in state):
+                    self._check_live()
+                    pending_delivery = self._absorb(state, queue,
+                                                    pending_delivery,
+                                                    flush=True)
+                break
+        if pending_delivery is not None:
+            self._deliver(pending_delivery)
+
+    # ------------------------------------------------------------ page intake
+
+    def _page_columns(self, page: Page, dev) -> list:
+        """Page -> [datas tuple, nulls tuple, mask, live_count(None=unknown)]
+        on the worker's device, widened to the exchange's declared types.
+        Host-sourced (numpy) pages are uploads the multichip dryrun's
+        device-residency assertion exists to catch — counted exactly like
+        the barrier path does."""
+        import jax
+        import jax.numpy as jnp
+
+        if isinstance(page.mask, np.ndarray) or \
+                any(isinstance(b.data, np.ndarray) for b in page.blocks):
+            record_exchange_stat("host_uploads", 1, self.book)
+        datas, nulls = [], []
+        for c in range(len(self.types)):
+            dt = np.dtype(self.types[c].np_dtype)
+            b = page.blocks[c]
+            datas.append(jax.device_put(jnp.asarray(b.data).astype(dt), dev))
+            nraw = b.nulls if b.nulls is not None else \
+                _zeros_shard(dev, bool, page.capacity, self.book)
+            nulls.append(jax.device_put(jnp.asarray(nraw), dev))
+        mask = jax.device_put(jnp.asarray(page.mask), dev)
+        return _QueuedPage(tuple(datas), tuple(nulls), mask,
+                           charged_bytes=page_nbytes(page))
+
+    def _resolve_lives(self, queue, include_carry: bool = True) -> None:
+        """Fill in unknown live counts with ONE batched device_get.
+
+        ``include_carry=False`` defers the carry buffers: their counts are
+        OUTPUTS of the in-flight collective, so syncing them immediately
+        would stall chunk k+1's host-side fill behind collective k — the
+        absorb loop resolves them only when a carry entry is actually
+        reached (by which point the collective has usually drained)."""
+        import jax
+        import jax.numpy as jnp
+
+        unknown = [entry for q in queue for entry in q
+                   if entry.live is None and
+                   (include_carry or not entry.is_carry)]
+        if not unknown:
+            return
+        counts = jax.device_get(
+            [jnp.sum(e.mask.astype(jnp.int32)) for e in unknown])
+        for e, n in zip(unknown, counts):
+            e.live = int(n)
+            if e.is_carry:  # a re-queued carry buffer, not a producer page
+                self.stats["carry_rows"] += int(n)
+
+    def _fresh_chunk(self, w: int) -> _ChunkState:
+        dev = self.mesh.devices[w]
+        C = self.chunk_rows
+        datas = tuple(_zeros_shard(dev, t.np_dtype, C, self.book)
+                      for t in self.types)
+        nulls = tuple(_zeros_shard(dev, bool, C, self.book)
+                      for _ in self.types)
+        return _ChunkState(datas, nulls, _zeros_shard(dev, bool, C, self.book))
+
+    # ---------------------------------------------------------------- absorb
+
+    def _absorb(self, state, queue, pending_delivery, flush: bool = False):
+        """Move queued pages into chunk buffers; dispatch whenever a worker's
+        chunk fills with more rows waiting (or, in flush mode, whenever any
+        rows remain at all). Returns the still-undelivered dispatch."""
+        C = self.chunk_rows
+        fill = _fill_chunk_jit(len(self.types), C)
+        while True:
+            self._check_live()
+            # resolve producer pages' live counts in one batched transfer;
+            # carry counts stay deferred so this never syncs on the
+            # in-flight collective
+            self._resolve_lives(queue, include_carry=False)
+            for w in range(self.W):
+                st = state[w]
+                while queue[w] and st.count < C:
+                    if queue[w][0].live is None:
+                        # a carry buffer reached the front: NOW its count is
+                        # worth the sync (it gates further progress here)
+                        self._resolve_lives(queue)
+                    qp = queue[w].pop(0)
+                    if qp.charged_bytes:
+                        self._release_bytes(qp.charged_bytes)
+                    if not qp.live:
+                        continue
+                    nd, nn, nm, ld, ln, lm = fill(
+                        st.datas, st.nulls, st.mask, st.count,
+                        qp.datas, qp.nulls, qp.mask)
+                    st.datas, st.nulls, st.mask = nd, nn, nm
+                    absorbed = min(C - st.count, qp.live)
+                    st.count += absorbed
+                    if not qp.is_carry:
+                        self.stats["rows_in"] += absorbed
+                    if qp.live > absorbed:
+                        # leftover goes back to the FRONT; its live count is
+                        # known arithmetically — no device sync
+                        queue[w].insert(0, _QueuedPage(
+                            ld, ln, lm, live=qp.live - absorbed,
+                            is_carry=qp.is_carry))
+            must_dispatch = any(
+                state[w].count >= C and queue[w] for w in range(self.W))
+            if not must_dispatch and flush and any(s.count for s in state):
+                must_dispatch = True
+            if not must_dispatch:
+                return pending_delivery
+            pending_delivery = self._dispatch(state, queue, pending_delivery)
+
+    def _release_bytes(self, n: int) -> None:
+        """A page absorbed into chunk buffers stops counting against the
+        in-flight budget (the chunk buffers are fixed-shape). `n` is the
+        exact amount add_page charged for it."""
+        with self._cv:
+            self._inbox_bytes = max(0, self._inbox_bytes - n)
+            self._cv.notify_all()
+
+    # -------------------------------------------------------------- dispatch
+
+    def _assemble(self, shards, L):
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        return jax.make_array_from_single_device_arrays(
+            (self.W * L,), NamedSharding(self.mesh.mesh, P(WORKER_AXIS)),
+            shards)
+
+    def _dispatch(self, state, queue, pending_delivery):
+        """Issue the collective for the current chunks (async), re-queue the
+        carry at the BACK of the absorb queue (its live count is an output
+        of this collective — back placement plus the deferred sync keep the
+        next chunk's fill off the collective's critical path), THEN deliver
+        the previous dispatch — its live-count sync overlaps this chunk's
+        in-flight collective (double buffering)."""
+        W, C = self.W, self.chunk_rows
+        ncols = len(self.types)
+        t0 = time.perf_counter()
+        range_keys = None
+        if self.kind == MERGE:
+            range_keys = self._merge_range_keys(state)
+        dev_arrays = [self._assemble([state[w].datas[c] for w in range(W)], C)
+                      for c in range(ncols)]
+        dev_arrays += [self._assemble([state[w].nulls[c] for w in range(W)],
+                                      C) for c in range(ncols)]
+        dev_mask = self._assemble([state[w].mask for w in range(W)], C)
+        program, compiled = _streaming_program(
+            self.mesh.mesh, self.kind, self.key_idx, ncols, W, C,
+            self.out_cap, self._range_dtype)
+        if compiled:
+            self.stats["compiles"] += 1
+            if self.book is not None:
+                self.book.bump("collective_compiles")
+        with COLLECTIVE_DISPATCH_LOCK:
+            if self.kind == MERGE:
+                g_rk = self._assemble(range_keys, C)
+                out_arrays, out_mask, carry_arrays, carry_mask = program(
+                    tuple(dev_arrays), dev_mask, g_rk, self._splitters)
+            elif self.kind == REPARTITION:
+                out_arrays, out_mask, carry_arrays, carry_mask = program(
+                    tuple(dev_arrays), dev_mask)
+            else:
+                out_arrays, out_mask = program(tuple(dev_arrays), dev_mask)
+                carry_arrays = carry_mask = None
+        with self._cv:
+            producing = (self._open_producers or 0) > 0
+        dt = time.perf_counter() - t0
+        self.stats["chunks"] += 1
+        self.stats["dispatch_s"] += dt
+        if producing:
+            self.stats["overlap_chunks"] += 1
+            self.stats["overlap_s"] += dt
+        if self.book is not None:
+            self.book.bump("chunks")
+            if producing:
+                self.book.bump("overlap_chunks")
+
+        # reset chunks to the cached zero shards and re-queue the carry as a
+        # front-of-queue pseudo-page (live count resolved in the next batch)
+        for w in range(W):
+            state[w] = self._fresh_chunk(w)
+        if carry_mask is not None:
+            # re-queued at the BACK with live=None: producer pages already
+            # staged absorb first (their counts are known), and the carry's
+            # count — an output of the collective just dispatched — is only
+            # synced when the entry is actually reached, so nothing here
+            # blocks on the collective. Order across the queue is free:
+            # repartition/merge consumers are order-insensitive (hash state
+            # or a downstream sort).
+            carry_per_worker = self._shards_by_worker(carry_mask, C)
+            carry_cols = [self._shards_by_worker(a, C)
+                          for a in carry_arrays]
+            for w in range(W):
+                queue[w].append(_QueuedPage(
+                    tuple(carry_cols[c][w] for c in range(ncols)),
+                    tuple(carry_cols[ncols + c][w] for c in range(ncols)),
+                    carry_per_worker[w], is_carry=True))
+        # deliver the PREVIOUS chunk now that this one is in flight
+        if pending_delivery is not None:
+            self._deliver(pending_delivery)
+        return (out_arrays, out_mask)
+
+    def _merge_range_keys(self, state):
+        """Per-worker routing keys for this chunk (eager, on each worker's
+        device); splitters fix at the FIRST dispatch so every later chunk
+        routes through the same ranges (global disjointness across the
+        whole stream, the invariant worker-order concatenation needs)."""
+        import jax
+
+        ch, desc, nf = self.orderings[0]
+        keys = []
+        for w in range(self.W):
+            st = state[w]
+            keys.append(_range_key_for(st.datas[ch], st.nulls[ch],
+                                       self.types[ch], self.dicts[ch],
+                                       desc, nf))
+        self._range_dtype = str(keys[0].dtype)
+        if self._splitters is None:
+            samples = []
+            for w in range(self.W):
+                lw = state[w].count
+                if lw:
+                    stride = max(1, lw // 128)
+                    samples.append(np.asarray(keys[w][:lw:stride][:128]))
+            pooled = np.sort(np.concatenate(samples)) if samples else \
+                np.zeros(1, dtype=keys[0].dtype)
+            self._splitters = np.asarray(
+                [pooled[len(pooled) * i // self.W]
+                 for i in range(1, self.W)], dtype=pooled.dtype)
+        return [jax.device_put(keys[w], self.mesh.devices[w])
+                for w in range(self.W)]
+
+    # -------------------------------------------------------------- delivery
+
+    def _shards_by_worker(self, arr, L: int):
+        out = [None] * self.W
+        for sh in arr.addressable_shards:
+            start = sh.index[0].start or 0  # W=1: index is slice(None)
+            out[start // L] = sh.data
+        return out
+
+    def _deliver(self, dispatched) -> None:
+        """Compact each worker's received shard and enqueue it as standard
+        pow2 pages on the consumer queue (blocking on the queue's byte bound
+        — the downstream half of the backpressure loop)."""
+        import jax
+        import jax.numpy as jnp
+
+        out_arrays, out_mask = dispatched
+        W, ncols = self.W, len(self.types)
+        out_len = out_mask.shape[0] // W
+        compact = _compact_pad_jit()
+        data_shards = [self._shards_by_worker(out_arrays[c], out_len)
+                       for c in range(ncols)]
+        null_shards = [self._shards_by_worker(out_arrays[ncols + c], out_len)
+                       for c in range(ncols)]
+        mask_shards = self._shards_by_worker(out_mask, out_len)
+        compacted = []
+        for w in range(W):
+            compacted.append(compact(
+                tuple(data_shards[c][w] for c in range(ncols)),
+                tuple(null_shards[c][w] for c in range(ncols)),
+                mask_shards[w], out_len))
+        # ONE host sync for all workers' live counts + null-mask presence
+        live_devs = [jnp.sum(m.astype(jnp.int32)) for _, _, m in compacted]
+        null_devs = [jnp.stack([jnp.any(n) for n in nn]) if ncols else None
+                     for _, nn, _ in compacted]
+        synced = jax.device_get(live_devs + [x for x in null_devs
+                                             if x is not None])
+        lives = [int(x) for x in synced[:W]]
+        has_nulls = synced[W:]
+        cap = min(max(self.page_capacity, 1 << 9), out_len)
+        for w in range(W):
+            live_w = lives[w]
+            if not live_w:
+                continue
+            out_d, out_n, out_m = compacted[w]
+            hn = has_nulls[w] if ncols else ()
+            n_pages = -(-live_w // cap)
+            for off in range(0, n_pages * cap, cap):
+                blocks = []
+                for c in range(ncols):
+                    nm = out_n[c][off:off + cap] if hn[c] else None
+                    blocks.append(Block(self.types[c],
+                                        out_d[c][off:off + cap], nm,
+                                        self.dicts[c]))
+                page = Page(tuple(blocks), out_m[off:off + cap])
+                self._out[w].put(page, block=True)
+            self.stats["rows_out"] += live_w
+            if self.book is not None:
+                self.book.bump("rows", live_w)
+
+    def _publish_stats(self) -> None:
+        if self.book is not None:
+            entry = dict(self.stats)
+            for k in ("dispatch_s", "overlap_s", "stall_s"):
+                entry[k] = round(entry[k], 6)
+            self.book.add_exchange(entry)
+            self.book.bump("overlap_s", self.stats["overlap_s"])
+            self.book.bump("stall_s", self.stats["stall_s"])
+            self.book.bump("dispatch_s", self.stats["dispatch_s"])
+            self.book.bump("carry_rows", self.stats["carry_rows"])
+
+
+# ---------------------------------------------------------------------------
+# consumer-side operator
+# ---------------------------------------------------------------------------
+
+class StreamingExchangeSource(LocalExchangeSource):
+    """Consumer endpoint over one worker's chunk queue. Identical protocol
+    to a local-exchange source, plus: closing ABANDONS the queue — an
+    early-finishing consumer (a satisfied LIMIT above the exchange) must
+    not leave a full byte-bounded buffer wedging the pump and, through the
+    budget, every producer driver."""
+
+    def close(self) -> None:
+        self.buffer.abandon()
+        super().close()
+
+
+# ---------------------------------------------------------------------------
+# producer-side operator
+# ---------------------------------------------------------------------------
+
+class ExchangeSinkOperator(Operator):
+    """Tail of a producer driver: pages flow into the streaming exchange's
+    staging (the PartitionedOutputOperator analogue — but the 'serialize +
+    enqueue' here is appending a device-page handle). Parks BLOCKED when the
+    exchange's in-flight byte budget is full."""
+
+    def __init__(self, context: OperatorContext, exchange: StreamingExchange,
+                 types: List[Type]):
+        super().__init__(context)
+        self.exchange = exchange
+        self._types = types
+        self._reported = False
+
+    @property
+    def output_types(self) -> List[Type]:
+        return self._types
+
+    def needs_input(self) -> bool:
+        return super().needs_input() and self.exchange.has_capacity()
+
+    def is_blocked(self):
+        if self.exchange.has_capacity():
+            return None
+        return self.exchange.has_capacity  # poll-able: drain frees budget
+
+    @timed("add_input_ns")
+    def add_input(self, page: Page) -> None:
+        self.context.record_input(page, page.capacity)
+        self.exchange.add_page(self.context.worker, page)
+
+    def get_output(self) -> Optional[Page]:
+        return None
+
+    def finish(self) -> None:
+        if not self._reported:
+            self._reported = True
+            self.exchange.producer_finished()
+        super().finish()
+
+    def close(self) -> None:
+        self.finish()
+        super().close()
+
+    def is_finished(self) -> bool:
+        return self._finishing
+
+
+class ExchangeSinkOperatorFactory(OperatorFactory):
+    """Sink factory for a non-root fragment in streaming mode. `created`
+    counts sink operators so the runner can declare the exact producer count
+    before execution starts."""
+
+    def __init__(self, operator_id: int, exchange: StreamingExchange,
+                 types: List[Type]):
+        super().__init__(operator_id, "ExchangeSink")
+        self.exchange = exchange
+        self.types = types
+        self.created = 0
+
+    def create_operator(self, worker: int = 0) -> Operator:
+        self.created += 1
+        return ExchangeSinkOperator(self.context(worker), self.exchange,
+                                    self.types)

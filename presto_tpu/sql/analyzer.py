@@ -39,7 +39,7 @@ EXTERNAL_FUNCTIONS: Dict[str, "Callable"] = {}
 
 
 def register_scalar_function(name: str, typer) -> None:
-    EXTERNAL_FUNCTIONS[name.lower()] = typer
+    EXTERNAL_FUNCTIONS[name.lower()] = typer  # prestocheck: ignore[unbounded-cache] - plugin registry: one entry per registered function, not per request
 
 
 def register_aggregate_name(name: str, output_typer=None) -> None:
@@ -48,7 +48,7 @@ def register_aggregate_name(name: str, output_typer=None) -> None:
     `output_typer(arg_types) -> Type` feeds aggregate_output_type."""
     AGGREGATE_NAMES.add(name.lower())
     if output_typer is not None:
-        EXTERNAL_AGGREGATE_TYPES[name.lower()] = output_typer
+        EXTERNAL_AGGREGATE_TYPES[name.lower()] = output_typer  # prestocheck: ignore[unbounded-cache] - plugin registry, bounded by plugin count
 
 
 _ARITH_NAMES = {"+": "add", "-": "subtract", "*": "multiply", "/": "divide",

@@ -24,6 +24,13 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: compile-heavy tests excluded from the tier-1 '-m not slow' "
+        "budget (full distributed TPC-H ladder, exhaustive exchange shapes)")
+
+
 @pytest.fixture(scope="session")
 def eight_devices():
     import jax

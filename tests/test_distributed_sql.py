@@ -10,6 +10,12 @@ Covers the BASELINE north-star queries (Q1/Q3/Q5/Q9) plus exchange-shape
 coverage: global agg (GATHER), distinct agg (input repartition), semi join
 (repartition both sides), NOT IN (broadcast of the filtering side), cross-join
 scalar subquery (BROADCAST), and UNION.
+
+Every distinct query shape compiles its own 8-way shard_map collectives
+(minutes of XLA time per fresh process), so tier-1 keeps one representative
+test per exchange kind and the exhaustive ladder runs under `-m slow`
+(tests/test_streaming_exchange.py adds the streaming-vs-barrier differentials
+on a cheaper 2-device mesh).
 """
 import pytest
 
@@ -42,11 +48,13 @@ def test_dist_group_by(dist, local):
           "from nation group by n_regionkey order by n_regionkey")
 
 
+@pytest.mark.slow
 def test_dist_global_agg(dist, local):
     check(dist, local,
           "select count(*), sum(o_totalprice), avg(o_totalprice) from orders")
 
 
+@pytest.mark.slow
 def test_dist_distinct_agg(dist, local):
     check(dist, local,
           "select count(distinct o_custkey) from orders")
@@ -58,6 +66,7 @@ def test_dist_join(dist, local):
           "on n_regionkey = r_regionkey order by n_name")
 
 
+@pytest.mark.slow
 def test_dist_semijoin(dist, local):
     check(dist, local,
           "select c_name from customer where c_nationkey in "
@@ -65,6 +74,7 @@ def test_dist_semijoin(dist, local):
           "order by c_name limit 20")
 
 
+@pytest.mark.slow
 def test_dist_not_in(dist, local):
     check(dist, local,
           "select n_name from nation where n_regionkey not in "
@@ -72,6 +82,7 @@ def test_dist_not_in(dist, local):
           "order by n_name")
 
 
+@pytest.mark.slow
 def test_dist_scalar_subquery(dist, local):
     check(dist, local,
           "select o_orderkey from orders "
@@ -79,6 +90,7 @@ def test_dist_scalar_subquery(dist, local):
           "order by o_orderkey limit 10")
 
 
+@pytest.mark.slow
 def test_dist_union(dist, local):
     check(dist, local,
           "select n_name from nation where n_regionkey = 0 union all "
@@ -95,6 +107,7 @@ def test_dist_union_with_values(dist, local):
           "select count(*) from (select 1 as x union all select 2) t")
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("q", [1, 3, 5, 9])
 def test_dist_tpch(dist, local, q):
     check(dist, local, QUERIES[q])
@@ -111,6 +124,7 @@ def test_cbo_broadcasts_small_builds(dist):
     assert "RemoteSource" in lineitem_frag  # joins happen at the probe
 
 
+@pytest.mark.slow
 def test_forced_partitioned_matches_broadcast(local):
     from presto_tpu.metadata import Session
     from presto_tpu.parallel.runner import DistributedQueryRunner
@@ -123,6 +137,7 @@ def test_forced_partitioned_matches_broadcast(local):
     check(part, local, QUERIES[5])
 
 
+@pytest.mark.slow
 def test_dist_full_join(dist, local):
     # FULL joins repartition both sides (broadcast would duplicate unmatched
     # build rows); per-worker unmatched emission composes to the global result
@@ -133,6 +148,7 @@ def test_dist_full_join(dist, local):
           "on c_custkey = o_custkey order by 1, 2")
 
 
+@pytest.mark.slow
 def test_skewed_join_key(dist, local):
     # hot-key stress: ~90% of orders land on one custkey partition via the
     # modulo classes; exchange capacity scales to the live rows, no drops
@@ -151,12 +167,14 @@ def test_dist_order_by_no_limit(dist, local):
           "order by c_acctbal, c_custkey")
 
 
+@pytest.mark.slow
 def test_dist_order_by_desc_varchar(dist, local):
     check(dist, local,
           "select c_name, c_custkey from customer "
           "order by c_name desc, c_custkey")
 
 
+@pytest.mark.slow
 def test_dist_order_by_multi_key(dist, local):
     check(dist, local,
           "select o_orderkey, o_orderdate, o_totalprice from orders "

@@ -21,7 +21,8 @@ from tools.prestocheck import (all_pass_ids, load_baseline, run,  # noqa: E402
 
 EXPECTED_PASSES = {"undefined-name", "tracer-safety", "lock-discipline",
                    "exception-hygiene", "retry-discipline",
-                   "mutable-default-args", "sleep-poll", "host-sync"}
+                   "mutable-default-args", "sleep-poll", "host-sync",
+                   "unbounded-cache"}
 
 
 def _scan(tmp_path, source, select=None, name="mod.py"):
@@ -613,6 +614,78 @@ def test_check_imports_shim_honors_suppressions(tmp_path):
         "y = loud_name\n")
     problems = check_imports.check_file(str(path))
     assert len(problems) == 1 and "loud_name" in problems[0]
+
+
+# ------------------------------------------------------------ unbounded-cache
+
+def test_unbounded_cache_flags_growing_module_dict(tmp_path):
+    findings = _scan(tmp_path, """
+        _CACHE = {}
+        _LOG = []
+
+        def get(key):
+            v = _CACHE.get(key)
+            if v is None:
+                v = _CACHE[key] = expensive(key)
+            _LOG.append(key)
+            return v
+        """, select=["unbounded-cache"])
+    msgs = "\n".join(_messages(findings))
+    assert "`_CACHE`" in msgs and "never" in msgs
+    assert "`_LOG`" in msgs
+    assert len(findings) == 2
+
+
+def test_unbounded_cache_accepts_bounds_and_eviction(tmp_path):
+    findings = _scan(tmp_path, """
+        _SIZE_GUARDED = {}
+        _EVICTED = {}
+        _CLEARED = []
+        _REBOUND = {}
+
+        def put(key, v):
+            if len(_SIZE_GUARDED) > 256:
+                _SIZE_GUARDED.clear()
+            _SIZE_GUARDED[key] = v
+            _EVICTED[key] = v
+            _EVICTED.pop(next(iter(_EVICTED)))
+            _CLEARED.append(v)
+
+        def reset():
+            global _REBOUND
+            _CLEARED.clear()
+            _REBOUND = {}
+
+        def grow_rebound(key, v):
+            _REBOUND[key] = v
+        """, select=["unbounded-cache"])
+    assert findings == [], _messages(findings)
+
+
+def test_unbounded_cache_ignores_import_time_fills_and_locals(tmp_path):
+    findings = _scan(tmp_path, """
+        TABLES = {}
+        TABLES["nation"] = 25      # module-body fill: a constant, not a cache
+        for _name in ("region", "part"):
+            TABLES[_name] = 5
+
+        def lookup(key):
+            local = {}
+            local[key] = 1          # function-local: dies with the frame
+            return TABLES.get(key), local
+        """, select=["unbounded-cache"])
+    assert findings == [], _messages(findings)
+
+
+def test_unbounded_cache_suppression_honored(tmp_path):
+    findings = _scan(tmp_path, """
+        _REGISTRY = {}
+
+        def register(cls):
+            _REGISTRY[cls.__name__] = cls  # prestocheck: ignore[unbounded-cache] - one per class
+            return cls
+        """, select=["unbounded-cache"])
+    assert findings == [], _messages(findings)
 
 
 # ------------------------------------------------------------- tier-1 gate

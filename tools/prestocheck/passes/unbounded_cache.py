@@ -1,0 +1,164 @@
+"""unbounded-cache: module-level dict/list caches that only ever grow.
+
+The streaming-exchange PR bounded ``_ZEROS_CACHE`` in the mesh exchange —
+a module-global keyed by (device, dtype, length) that pinned one resident
+device allocation per distinct key forever. Any module-level container that
+code paths append/insert into but never evict is the same bug waiting for a
+long-lived server: memory grows monotonically with key diversity (query
+shapes, schemas, sessions) and the process eventually dies under exactly the
+heavy sustained traffic the north star calls for.
+
+Detection: a module-scope name bound to an empty ``dict``/``list`` (literal
+or ``dict()``/``list()``/``defaultdict()``/``OrderedDict()`` call) that some
+function in the module GROWS — ``NAME[key] = ...``, ``NAME.setdefault``,
+``NAME.append`` / ``extend`` / ``insert`` / ``add`` — with no eviction or
+bound anywhere in the module. Accepted as eviction/bound evidence:
+``NAME.clear()``, ``NAME.pop(...)`` / ``popitem`` / ``remove``,
+``del NAME[...]``, re-assignment of ``NAME``, any comparison involving
+``len(NAME)`` (the size-guard idiom), or an ``lru_cache``-style decorator on
+the accessor. ``deque(maxlen=...)`` is bounded by construction. Registries
+that are structurally bounded (one entry per module/class, not per request)
+should carry a justified ``# prestocheck: ignore[unbounded-cache]``.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Optional
+
+from ..core import Finding, Module, Pass, dotted_name, register
+
+_DICT_FACTORIES = {"dict", "collections.defaultdict", "defaultdict",
+                   "collections.OrderedDict", "OrderedDict"}
+_LIST_FACTORIES = {"list"}
+_GROW_METHODS = {"append", "extend", "insert", "add", "setdefault",
+                 "appendleft"}
+_SHRINK_METHODS = {"clear", "pop", "popitem", "remove", "popleft"}
+
+
+def _empty_container_kind(node: ast.AST) -> Optional[str]:
+    """'dict' / 'list' when `node` is an empty container initializer."""
+    if isinstance(node, ast.Dict) and not node.keys:
+        return "dict"
+    if isinstance(node, ast.List) and not node.elts:
+        return "list"
+    if isinstance(node, ast.Call):
+        name = dotted_name(node.func) or ""
+        if any(kw.arg == "maxlen" for kw in node.keywords):
+            return None  # deque(maxlen=...) and friends: bounded by birth
+        if name in _DICT_FACTORIES:
+            return "dict"
+        if name in _LIST_FACTORIES:
+            return "list"
+    return None
+
+
+def _module_level_containers(tree: ast.Module) -> Dict[str, ast.AST]:
+    """Name -> init node for module-scope empty dict/list bindings (direct
+    module body plus module-level if/try arms)."""
+    out: Dict[str, ast.AST] = {}
+
+    def scan(body):
+        for stmt in body:
+            if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 and \
+                    isinstance(stmt.targets[0], ast.Name):
+                if _empty_container_kind(stmt.value):
+                    out[stmt.targets[0].id] = stmt
+            elif isinstance(stmt, ast.AnnAssign) and \
+                    isinstance(stmt.target, ast.Name) and \
+                    stmt.value is not None:
+                if _empty_container_kind(stmt.value):
+                    out[stmt.target.id] = stmt
+            elif isinstance(stmt, ast.If):
+                scan(stmt.body)
+                scan(stmt.orelse)
+            elif isinstance(stmt, ast.Try):
+                scan(stmt.body)
+                for h in stmt.handlers:
+                    scan(h.body)
+                scan(stmt.orelse)
+                scan(stmt.finalbody)
+    scan(tree.body)
+    return out
+
+
+def _base_name(node: ast.AST) -> Optional[str]:
+    return node.id if isinstance(node, ast.Name) else None
+
+
+@register
+class UnboundedCachePass(Pass):
+    id = "unbounded-cache"
+    description = ("module-level dict/list cache grows without any "
+                   "eviction or bound")
+
+    def check_module(self, module: Module):
+        if module.tree is None:
+            return
+        containers = _module_level_containers(module.tree)
+        if not containers:
+            return
+        grows: Dict[str, ast.AST] = {}
+        bounded: set = set()
+        # growth only counts INSIDE function bodies: module-body fills
+        # (lookup tables, query texts) run once at import and are constants,
+        # not caches — they cannot grow with traffic
+        funcs = [n for n in ast.walk(module.tree)
+                 if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                   ast.Lambda))]
+        in_function = set()
+        for f in funcs:
+            for sub in ast.walk(f):
+                in_function.add(id(sub))
+        for node in ast.walk(module.tree):
+            # NAME[key] = ... / NAME[key] += ...  (growth by subscript)
+            if isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                for tgt in targets:
+                    if isinstance(tgt, ast.Subscript):
+                        name = _base_name(tgt.value)
+                        if name in containers and name not in grows and \
+                                id(node) in in_function:
+                            # NAME[:] = ... is a rewrite, not growth
+                            if not isinstance(tgt.slice, ast.Slice):
+                                grows[name] = node
+                    elif isinstance(tgt, ast.Name) and \
+                            tgt.id in containers and node is not \
+                            containers.get(tgt.id):
+                        bounded.add(tgt.id)  # re-assignment resets the cache
+            # NAME.append(...) / NAME.clear() / ...
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute):
+                name = _base_name(node.func.value)
+                if name in containers:
+                    if node.func.attr in _GROW_METHODS and \
+                            name not in grows and id(node) in in_function:
+                        grows[name] = node
+                    elif node.func.attr in _SHRINK_METHODS:
+                        bounded.add(name)
+            # del NAME[...]
+            if isinstance(node, ast.Delete):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Subscript):
+                        name = _base_name(tgt.value)
+                        if name is not None:
+                            bounded.add(name)
+            # len(NAME) in a comparison: the size-guard idiom
+            if isinstance(node, ast.Compare):
+                for sub in ast.walk(node):
+                    if isinstance(sub, ast.Call) and \
+                            dotted_name(sub.func) == "len" and sub.args and \
+                            _base_name(sub.args[0]) in containers:
+                        bounded.add(_base_name(sub.args[0]))
+        for name, site in grows.items():
+            if name in bounded:
+                continue
+            init = containers[name]
+            kind = "dict" if (isinstance(init, (ast.Assign, ast.AnnAssign))
+                              and _empty_container_kind(
+                                  init.value) == "dict") else "list"
+            yield Finding(
+                module.path, site.lineno, site.col_offset, self.id,
+                f"module-level {kind} `{name}` grows here but is never "
+                "evicted, cleared or size-guarded — bound it (len check + "
+                "clear/evict, lru, maxlen) or suppress with a justification")
