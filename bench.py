@@ -218,10 +218,42 @@ class _CompileCounter:
         return False
 
 
+def _traced_overlap(sql: str, schema: str) -> dict:
+    """One flight-recorded run: exports the Chrome trace and derives the
+    scan-vs-compute overlap ratio (how much of the scan pipeline's stage
+    work ran WHILE driver quanta were executing — the overlap the streaming
+    scan exists to create). Never fails the rung."""
+    import json as _json
+
+    from presto_tpu.metadata import Session
+    from presto_tpu.runner import LocalQueryRunner
+    from presto_tpu.utils import trace as _trace
+
+    try:
+        from presto_tpu.ops.scan import RESIDENT_CACHE
+
+        # a warm scan replays resident device pages and skips the scan
+        # pipeline — trace a COLD run so the ratio measures real ingest
+        # overlapping compute
+        RESIDENT_CACHE.clear()
+        runner = LocalQueryRunner(session=Session(
+            catalog="tpch", schema=schema,
+            properties={"query_trace": True}))
+        res = runner.execute(sql)
+        with open(res.trace_path) as f:
+            doc = _json.load(f)
+        return {"trace_scan_compute_overlap": round(
+                    _trace.overlap_ratio(doc, "scan", "driver"), 3),
+                "trace_spans": _trace.span_categories(doc)}
+    except Exception as e:  # noqa: BLE001 - observability must not kill rungs
+        return {"trace_error": repr(e)[:200]}
+
+
 def bench_sql_query(query_id: int, schema: str, seconds_budget: float,
                     escalate_to: str = None, escalate_budget_s: float = 30.0,
                     escalate_ratio: float = 100.0,
-                    compare_unfused: bool = False):
+                    compare_unfused: bool = False,
+                    record_trace: bool = False):
     """One rung of the SQL ladder: the FULL engine path (parse -> plan ->
     optimize -> drivers), the presto-benchmark BenchmarkSuite pattern run
     through LocalQueryRunner rather than hand-built pipelines — rung numbers
@@ -261,6 +293,17 @@ def bench_sql_query(query_id: int, schema: str, seconds_budget: float,
                "first_run_s": round(compile_wall, 3),
                "kernel_compiles": cc.n,
                "output_rows": rows0}
+        # percentile observability (process-cumulative histograms from the
+        # MetricsRegistry — the same numbers /v1/metrics serves)
+        from presto_tpu.utils.metrics import METRICS
+        wall_hist = METRICS.histogram_summary("query.wall_s")
+        if wall_hist:
+            out["query_wall_p50_s"] = wall_hist["p50"]
+            out["query_wall_p99_s"] = wall_hist["p99"]
+        disp_hist = METRICS.histogram_summary("segments.page_dispatch_s")
+        if disp_hist:
+            out["page_dispatch_p50_s"] = disp_hist["p50"]
+            out["page_dispatch_p99_s"] = disp_hist["p99"]
         # fused-segment observability: per-segment dispatch/compile counts
         # of the LAST timed run (exec/local_planner segment compiler)
         seg = (last.stats or {}).get("segments") if last is not None else None
@@ -312,6 +355,8 @@ def bench_sql_query(query_id: int, schema: str, seconds_budget: float,
             out["escalate_error"] = repr(e)[:200]
     if compare_unfused:
         out.update(unfused_wall(out["schema"]))
+    if record_trace:
+        out.update(_traced_overlap(sql, out["schema"]))
     return out
 
 
@@ -410,10 +455,12 @@ def bench_multichip_exchange(n_devices: int = 2,
         "from presto_tpu.metadata import Session\n"
         "from presto_tpu.parallel.mesh import MeshContext\n"
         "from presto_tpu.parallel.runner import DistributedQueryRunner\n"
+        "from presto_tpu.utils import trace as _tr\n"
+        "from presto_tpu.utils.metrics import METRICS\n"
         f"mesh = MeshContext(jax.devices()[:{n_devices}])\n"
         "r = DistributedQueryRunner(mesh, session=Session(\n"
         "    catalog='tpch', schema='tiny',\n"
-        "    properties={'exchange_chunk_rows': 256}))\n"
+        "    properties={'exchange_chunk_rows': 256, 'query_trace': True}))\n"
         "out = {}\n"
         "for name, sql in (\n"
         "    ('group_by', 'select o_custkey % 11, count(*), "
@@ -424,7 +471,13 @@ def bench_multichip_exchange(n_devices: int = 2,
         "    res = r.execute(sql)\n"
         "    ex = dict((res.stats or {}).get('exchange', {}))\n"
         "    ex.pop('per_exchange', None)\n"
+        "    if res.trace_path:\n"
+        "        doc = json.load(open(res.trace_path))\n"
+        "        ex['trace_overlap_ratio'] = round(\n"
+        "            _tr.overlap_ratio(doc, 'exchange', 'driver'), 3)\n"
         "    out[name] = ex\n"
+        "out['chunk_latency'] = "
+        "METRICS.histogram_summary('exchange.chunk_latency_s')\n"
         "print('EXCH=' + json.dumps(out))\n")
     try:
         proc = subprocess.run(
@@ -528,15 +581,19 @@ def main():
         # fused-vs-unfused warm wall (the segment compiler's win, measured)
         compare = rung in ("q1", "q3") and not args.quick
         try:
+            # the q3 rung additionally records a flight-recorded run: the
+            # Chrome-trace-derived scan-vs-compute overlap ratio
+            record_trace = rung == "q3" and not args.quick
             if platform != "cpu" and not args.quick:
                 detail[rung] = bench_sql_query(
                     qid, schema="sf1", seconds_budget=rung_budget,
-                    compare_unfused=compare)
+                    compare_unfused=compare, record_trace=record_trace)
             else:
                 detail[rung] = bench_sql_query(
                     qid, schema="tiny", seconds_budget=rung_budget,
                     escalate_to=None if args.quick else "sf1",
-                    escalate_budget_s=60.0, compare_unfused=compare)
+                    escalate_budget_s=60.0, compare_unfused=compare,
+                    record_trace=record_trace)
         except Exception as e:
             detail[rung] = {"error": repr(e)[:300]}
 

@@ -36,6 +36,7 @@ from ..sql.planner.add_exchanges import add_exchanges
 from ..sql.planner.fragmenter import SubPlan, fragment_plan
 from ..sql.planner.optimizer import optimize
 from ..sql.planner.planner import LogicalPlanner
+from ..utils import trace
 from ..utils.metrics import METRICS
 from . import faults, retry
 from .discovery import DiscoveryNodeManager, HeartbeatFailureDetector, NodeInfo
@@ -90,6 +91,9 @@ class ClusterQueryRunner:
         stmt = self.local.parser.parse(sql)
         if not isinstance(stmt, t.Query):
             raise ValueError(f"cannot cluster-plan {type(stmt).__name__}")
+        return self.plan_statement(stmt)
+
+    def plan_statement(self, stmt: t.Query) -> SubPlan:
         planner = LogicalPlanner(self.metadata, self.session)
         plan = planner.plan(stmt)
         plan = optimize(plan, self.metadata, self.session)
@@ -128,7 +132,9 @@ class ClusterQueryRunner:
         # access control is enforced at the coordinator for EVERY statement
         # (the local engine re-checks the ones it executes itself)
         self.local._check_access(stmt, user)
-        if not isinstance(stmt, t.Query):
+        explain_analyze = isinstance(stmt, t.Explain) and stmt.analyze and \
+            isinstance(stmt.statement, t.Query)
+        if not explain_analyze and not isinstance(stmt, t.Query):
             # DDL/DML/EXPLAIN/SHOW run on the coordinator's local engine
             return self.local.execute(sql, user=user)
         session = self.local.session
@@ -143,12 +149,47 @@ class ClusterQueryRunner:
                 str(spec), seed=int(session.get("fault_seed") or 0)))
             installed_here = True
         try:
+            if explain_analyze:
+                # distributed EXPLAIN ANALYZE: run on the workers and roll
+                # their TaskInfo operator stats up per fragment (before
+                # this, ANALYZE profiled the coordinator's local engine)
+                return self._instrumented(
+                    session, lambda: self._explain_analyze(stmt.statement))
             return self._execute_query(sql, session)
         finally:
             if installed_here:
                 faults.clear()
 
+    def _instrumented(self, session: Session, run) -> QueryResult:
+        """Trace + wall-histogram wrapper: the coordinator's flight recorder
+        captures lifecycle spans plus every task-create/poll and result-pull
+        HTTP call (the `http` category). The lifecycle span only opens when
+        THIS query's recorder actually installed — an untraced query running
+        concurrently with a traced one must not write into its timeline."""
+        import time as _time
+
+        rec = trace.maybe_recorder(session)
+        installed = rec is not None and trace.install(rec)
+        t0 = _time.perf_counter()
+        try:
+            if installed:
+                with rec.span(trace.LIFECYCLE, "query"):
+                    result = run()
+            else:
+                result = run()
+        finally:
+            if installed:
+                trace.uninstall(rec)
+        METRICS.histogram("query.wall_s", _time.perf_counter() - t0)
+        if installed:
+            result.trace_path = trace.export(rec, session)
+        return result
+
     def _execute_query(self, sql: str, session: Session) -> QueryResult:
+        return self._instrumented(
+            session, lambda: self._execute_with_retries(sql, session))
+
+    def _execute_with_retries(self, sql: str, session: Session) -> QueryResult:
         def prop(name, default):
             # Session.DEFAULTS (metadata.py) is the canonical source; the
             # fallback here only guards a property explicitly set to None.
@@ -225,6 +266,67 @@ class ClusterQueryRunner:
             stats["backoff_s"] += scheduler.backoff_s
             self._schedulers.pop(query_id, None)
             # free finished tasks' buffers/state on the workers
+            for task in scheduler.all_tasks():
+                task.cancel(abort=False)
+
+    def _explain_analyze(self, stmt: t.Query) -> QueryResult:
+        """Distributed EXPLAIN ANALYZE: schedule the inner query on the
+        workers, pull its results, then render per-fragment per-operator
+        stats (rows / wall / blocked / peak-mem) rolled up from every
+        task's TaskInfo.operator_stats — the same table the local runner's
+        _explain_analyze prints, via the shared exec/explain renderer.
+
+        Deliberately single-attempt (no query-level retry): ANALYZE's whole
+        point is the profile of the run that happened — transparently
+        re-running after a mid-query failure would report a retry's stats
+        as if they were the query's. A retryable failure surfaces to the
+        caller, who re-issues for a fresh profile."""
+        import time as _time
+
+        from ..exec.explain import rollup, table
+
+        session = self.local.session
+        nodes = self._wait_for_workers()
+        sub = self.plan_statement(stmt)
+        query_id = f"cq{next(self._ids)}_{int(time.time())}"
+        scheduler = SqlQueryScheduler(query_id, sub, nodes, session)
+        self._schedulers[query_id] = scheduler
+        t0 = _time.perf_counter()
+        try:
+            scheduler.schedule()
+            self._pull_results(scheduler, sub)
+            wall = _time.perf_counter() - t0
+            lines = [f"Query: {wall * 1000:.0f}ms wall, "
+                     f"{len(sub.fragments)} fragments, "
+                     f"{len(scheduler.all_tasks())} tasks on "
+                     f"{len(nodes)} workers", ""]
+            for frag in sub.fragments:
+                stage = scheduler.stages.get(frag.id)
+                tasks = stage.tasks if stage is not None else []
+                head = f"Fragment {frag.id} [{frag.partitioning}]"
+                if frag.output_kind:
+                    head += f" output={frag.output_kind}"
+                head += f" tasks={len(tasks)}"
+                lines.append(head)
+                stats = []
+                for task in tasks:
+                    # _pull_results drove every task to completion and cached
+                    # its final TaskInfo; re-poll only the ones without one
+                    # (a lost render-time poll must not erase real stats)
+                    info = task.info or task.poll_info()
+                    if info is not None and info.operator_stats:
+                        stats.extend(info.operator_stats)
+                if stats:
+                    lines += table(rollup(stats), indent="  ")
+                else:
+                    lines.append("  (no operator stats reported)")
+                lines.append("")
+            return QueryResult([[line] for line in lines], ["Query Plan"])
+        except BaseException:
+            scheduler.abort()
+            raise
+        finally:
+            self._schedulers.pop(query_id, None)
             for task in scheduler.all_tasks():
                 task.cancel(abort=False)
 

@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import json
 import threading
+import time
 import urllib.error
 import urllib.request
 from typing import Iterator, List, Optional, Sequence
@@ -26,6 +27,7 @@ import numpy as np
 from ..block import Dictionary, Page
 from ..spi.connector import ConnectorPageSource
 from ..types import Type
+from ..utils import trace
 from . import faults
 from .retry import Backoff
 from .serde import deserialize_pages
@@ -74,6 +76,7 @@ class PageBufferClient:
             url = (f"{location}/results/{self.buffer_id}/{self.token}"
                    f"?wait={timeout_s:.1f}")
         req = urllib.request.Request(url, method="GET")
+        t0 = time.perf_counter_ns()
         try:
             faults.fire("client.results", location=location)
             with urllib.request.urlopen(req, timeout=timeout_s + 15.0) as resp:
@@ -81,6 +84,11 @@ class PageBufferClient:
                 complete = resp.headers.get("X-Complete") == "true"
                 instance = resp.headers.get("X-Task-Instance-Id")
                 frame = resp.read()
+            if trace.active() is not None:
+                trace.record(trace.HTTP, "pull results", t0,
+                             time.perf_counter_ns() - t0,
+                             {"location": location,
+                              "bytes": len(frame) if frame else 0})
         except urllib.error.HTTPError as e:
             if e.code == 404 or e.code >= 500:
                 # 404: producer task not created yet (all-at-once scheduling

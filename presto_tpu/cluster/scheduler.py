@@ -36,6 +36,7 @@ from typing import Dict, List, Optional, Set
 from ..metadata import Session
 from ..sql.planner.fragmenter import Fragment, SINGLE_PART, SubPlan
 from ..sql.planner.plan import RemoteSourceNode
+from ..utils import trace
 from ..utils.metrics import METRICS
 from . import codec, faults, retry
 from .discovery import NodeInfo
@@ -72,10 +73,12 @@ class RemoteTask:
             try:
                 faults.fire("client.task_create", node_id=self.node.node_id,
                             task_id=self.task_id)
-                with urllib.request.urlopen(req, timeout=30.0) as resp:
-                    self.info = codec.loads(resp.read())
-                    backoff.success()
-                    return self.info
+                with trace.span(trace.HTTP, f"POST task {self.task_id}",
+                                node=self.node.node_id):
+                    with urllib.request.urlopen(req, timeout=30.0) as resp:
+                        self.info = codec.loads(resp.read())
+                backoff.success()
+                return self.info
             except urllib.error.HTTPError as e:
                 # 4xx = the worker REJECTED the request (bad body / conflicting
                 # task content) — deterministic, so surface its diagnostic body
@@ -101,9 +104,11 @@ class RemoteTask:
         try:
             faults.fire("client.task_poll", node_id=self.node.node_id,
                         task_id=self.task_id)
-            with urllib.request.urlopen(req, timeout=10.0) as resp:
-                self.info = codec.loads(resp.read())
-                return self.info
+            with trace.span(trace.HTTP, f"GET task {self.task_id}",
+                            node=self.node.node_id):
+                with urllib.request.urlopen(req, timeout=10.0) as resp:
+                    self.info = codec.loads(resp.read())
+            return self.info
         except (urllib.error.URLError, OSError):
             return None  # judged by the failure detector, not one lost poll
 

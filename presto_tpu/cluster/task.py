@@ -96,6 +96,12 @@ class TaskInfo:
     error: Optional[dict] = None
     rows_out: int = 0
     instance_id: str = ""
+    # per-operator stats of this task's drivers (OperatorStats.to_dict
+    # dicts, tagged with their pipeline index) — the worker half of
+    # distributed EXPLAIN ANALYZE: the coordinator rolls every task's list
+    # up per fragment, exactly as the reference ships OperatorStats inside
+    # TaskStatus for its coordinator-side QueryStats roll-up
+    operator_stats: Optional[List[dict]] = None
 
 
 @codec.register
@@ -317,6 +323,9 @@ class SqlTask:
         self._input_locations: Dict[int, List[str]] = {
             fid: list(locs) for fid, locs in request.input_locations.items()}
         self._live_sources: Dict[int, List[object]] = {}
+        # kept after planning so info() can report per-operator stats
+        # (reads of the plain-int stat fields race benignly mid-run)
+        self._drivers: List[object] = []
         kind = self._output_kind()
         self.output = buffers.OutputBuffer(
             buffers.BROADCAST if kind == BROADCAST else
@@ -347,6 +356,7 @@ class SqlTask:
             faults.fire("worker.task_run", task_id=self.task_id,
                         query_id=self.request.query_id)
             drivers = self._plan_drivers()
+            self._drivers = drivers
             if self.cancelled.is_set():
                 raise RuntimeError("task cancelled")
             concurrency = int(self.request.session.get("task_concurrency"))
@@ -466,10 +476,13 @@ class SqlTask:
         self.output.destroy()
 
     def info(self) -> TaskInfo:
+        from ..exec.explain import driver_stats
+
         rows = self._sink.operators[0].rows_out \
             if self._sink and self._sink.operators else 0
+        stats = driver_stats(self._drivers) if self._drivers else None
         return TaskInfo(self.task_id, self.state, self.error, rows,
-                        self.instance_id)
+                        self.instance_id, operator_stats=stats)
 
 
 class WorkerTaskManager:

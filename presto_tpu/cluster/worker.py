@@ -190,7 +190,7 @@ class _WorkerHandler(BaseHTTPRequestHandler):
                 # per-query reserved bytes — the ClusterMemoryManager's feed
                 # (memory/RemoteNodeMemory.java analogue)
                 "queryMemory": query_mem,
-                "uptime": round(time.time() - self.worker.start_time, 1),
+                "uptime": round(time.monotonic() - self.worker.start_mono, 1),
             }).encode(), 200, [("Content-Type", "application/json")])
         self._send(b"not found", 404)
 
@@ -244,7 +244,8 @@ class WorkerServer:
         self.metadata = MetadataManager(catalogs)
         self.tasks = WorkerTaskManager(self.metadata)
         self.state = ACTIVE
-        self.start_time = time.time()
+        self.start_time = time.time()      # wall timestamp (diagnostics)
+        self.start_mono = time.monotonic()  # uptime duration base
         handler = type("BoundWorkerHandler", (_WorkerHandler,), {"worker": self})
         self.httpd = ThreadingHTTPServer((host, port), handler)
         self.port = self.httpd.server_address[1]

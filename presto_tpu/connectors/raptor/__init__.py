@@ -212,7 +212,9 @@ class ShardManager:
         """Unlink files of shards dropped more than `grace_s` ago."""
         import time
 
-        cutoff = time.time() - grace_s
+        # wall-clock on purpose: dropped_at rows persist epoch timestamps
+        # across processes, so the cutoff must be in the same clock
+        cutoff = time.time() - grace_s  # prestocheck: ignore[wallclock-duration]
         with self.lock:
             rows = self._conn.execute(
                 "select shard_uuid from deleted_shards where dropped_at < ?",

@@ -52,6 +52,13 @@ class Driver:
         self.operators = operators
         self.yield_signal = yield_signal or DriverYieldSignal()
         self._closed = False
+        # blocked-time attribution: when process() returns BLOCKED, the
+        # operator that parked the driver and the park timestamp are noted;
+        # the next process() call charges the elapsed wait to that
+        # operator's stats.blocked_ns (what EXPLAIN ANALYZE prints as
+        # Blocked — build waits and backpressure stalls, per operator)
+        self._blocked_op: Optional[Operator] = None
+        self._blocked_since_ns: Optional[int] = None
 
     def is_finished(self) -> bool:
         return self._closed or self.operators[-1].is_finished()
@@ -60,11 +67,32 @@ class Driver:
         for op in self.operators:
             b = op.is_blocked()
             if b is not None and not b():
+                self._blocked_op = op
                 return b
         return None
 
+    @property
+    def trace_label(self) -> str:
+        """Stable display label for driver spans: first->last operator."""
+        lbl = self.__dict__.get("_trace_label")
+        if lbl is None:
+            names = [op.context.stats.name for op in self.operators]
+            lbl = names[0] if len(names) == 1 else \
+                f"{names[0]}->{names[-1]}"
+            self.__dict__["_trace_label"] = lbl
+        return lbl
+
+    def _note_blocked(self) -> ProcessState:
+        self._blocked_since_ns = time.perf_counter_ns()
+        return ProcessState.BLOCKED
+
     def process(self, quantum_ns: int = 200_000_000) -> ProcessState:
         """Run until blocked/finished/yield. Mirrors Driver.processInternal."""
+        if self._blocked_since_ns is not None:
+            waited = time.perf_counter_ns() - self._blocked_since_ns
+            self._blocked_since_ns = None
+            if self._blocked_op is not None:
+                self._blocked_op.context.stats.blocked_ns += waited
         self.yield_signal.arm(quantum_ns)
         try:
             while True:
@@ -72,7 +100,7 @@ class Driver:
                     return ProcessState.FINISHED
                 b = self.blocked_on()
                 if b is not None:
-                    return ProcessState.BLOCKED
+                    return self._note_blocked()
                 if self.yield_signal.should_yield():
                     return ProcessState.YIELDED
                 progressed = self._process_once()
@@ -81,7 +109,7 @@ class Driver:
                     return ProcessState.FINISHED
                 if not progressed:
                     if self.blocked_on() is not None:
-                        return ProcessState.BLOCKED
+                        return self._note_blocked()
                     # no operator moved and none blocked: pipeline is draining finishes
                     self._propagate_finish()
         finally:

@@ -1,0 +1,91 @@
+"""Shared EXPLAIN ANALYZE rendering: one operator-stats table, three tiers.
+
+The reference has a single ExplainAnalyzeOperator whose text every runner
+produces (local test runner, distributed cluster) because OperatorStats roll
+up through the same TaskStatus path everywhere. This module is that shared
+half here: the local runner renders its drivers' stats directly, the mesh
+runner rolls a fragment's per-worker drivers up, and the cluster coordinator
+rolls up the per-operator dicts each worker ships inside TaskInfo
+(ops/operator.OperatorStats.to_dict) — all through the same formatting so
+the three tiers print the same table.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+HEADER = (f"{'Operator':<28}{'In rows':>10}{'Out rows':>10}"
+          f"{'Wall ms':>9}{'Blk ms':>9}{'Peak MB':>9}")
+RULE = "-" * len(HEADER)
+
+_SUM_FIELDS = ("input_rows", "output_rows", "total_ns", "blocked_ns",
+               "input_pages", "output_pages")
+
+
+def driver_stats(drivers, tag_pipeline: bool = True) -> List[dict]:
+    """Flatten live drivers' OperatorStats into JSON-safe dicts. With
+    ``tag_pipeline`` each driver index becomes the stat's pipeline tag —
+    driver ordering is deterministic per plan, so tags agree across the
+    workers/tasks whose stats later roll up together."""
+    out: List[dict] = []
+    for di, d in enumerate(drivers):
+        for op in d.operators:
+            s = op.context.stats.to_dict()
+            if tag_pipeline:
+                s["pipeline"] = di
+            out.append(s)
+    return out
+
+
+def rollup(stat_dicts: List[dict]) -> List[dict]:
+    """Aggregate operator stats across workers/tasks: counters sum, peak
+    memory maxes, keyed by (pipeline, operator_id, name) in first-seen
+    order (every participant plans the same fragment, so the key lines the
+    same physical operator up across the fleet)."""
+    agg: Dict[tuple, dict] = {}
+    order: List[tuple] = []
+    for s in stat_dicts:
+        key = (s.get("pipeline", 0), s.get("operator_id", 0), s.get("name"))
+        cur = agg.get(key)
+        if cur is None:
+            cur = agg[key] = dict(s)
+            cur["instances"] = 1
+            order.append(key)
+        else:
+            for f in _SUM_FIELDS:
+                cur[f] = cur.get(f, 0) + s.get(f, 0)
+            cur["peak_memory_bytes"] = max(cur.get("peak_memory_bytes", 0),
+                                           s.get("peak_memory_bytes", 0))
+            cur["instances"] += 1
+    return [agg[k] for k in order]
+
+
+def format_rows(stat_dicts: List[dict], indent: str = "  ") -> List[str]:
+    """One table line per operator stat dict (rows / wall / blocked / peak)."""
+    lines = []
+    for s in stat_dicts:
+        name = str(s.get("name", "?"))[:26]
+        lines.append(
+            f"{indent}{name:<26}{s.get('input_rows', 0):>10}"
+            f"{s.get('output_rows', 0):>10}"
+            f"{s.get('total_ns', 0) / 1e6:>9.1f}"
+            f"{s.get('blocked_ns', 0) / 1e6:>9.1f}"
+            f"{s.get('peak_memory_bytes', 0) / 1e6:>9.2f}")
+    return lines
+
+
+def table(stat_dicts: List[dict], indent: str = "",
+          pipelines: bool = False) -> List[str]:
+    """Header + rows; with ``pipelines`` the dicts are grouped under their
+    pipeline tag (the local runner's per-pipeline layout)."""
+    lines = [f"{indent}{HEADER}", f"{indent}{RULE}"]
+    if not pipelines:
+        lines += format_rows(stat_dicts, indent + "  ")
+        return lines
+    current: Optional[int] = None
+    for s in stat_dicts:
+        p = s.get("pipeline", 0)
+        if p != current:
+            current = p
+            lines.append(f"{indent}pipeline {p}:")
+        lines += format_rows([s], indent + "  ")
+    return lines

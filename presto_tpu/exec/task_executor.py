@@ -21,6 +21,7 @@ import itertools
 import threading
 from typing import Callable, List, Optional, Sequence
 
+from ..utils import trace
 from .driver import Driver, ProcessState
 
 _DEFAULT_QUANTUM_NS = 200_000_000
@@ -190,6 +191,11 @@ class _Run:
                     self.cv.notify_all()
                 return
             spent = time.perf_counter_ns() - t0
+            if trace.active() is not None:
+                # one span per driver slice: the flight recorder's timeline
+                # of which pipelines ran when (and why they stopped)
+                trace.record(trace.DRIVER, driver.trace_label, t0, spent,
+                             {"state": state.name})
             with self.cv:
                 if state == ProcessState.FINISHED:
                     self.outstanding -= 1

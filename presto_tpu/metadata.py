@@ -96,6 +96,18 @@ class Session:
         # full intermediate result; 0 = engine default
         # (streaming_exchange.DEFAULT_INFLIGHT_BYTES, 256MB)
         "exchange_inflight_bytes": 0,
+        # --- observability: per-query flight recorder (utils/trace.py) ---
+        # record spans across every engine layer (lifecycle, driver quanta,
+        # operators, fused segments, scan stages, exchange chunks, cluster
+        # HTTP) and export Chrome trace-event JSON readable in Perfetto /
+        # chrome://tracing; the path lands in QueryResult.trace_path and is
+        # served at GET /v1/query/{id}/trace. Near-zero cost when False.
+        "query_trace": False,
+        # export directory for trace files; "" = the platform tempdir
+        "query_trace_dir": "",
+        # span ring-buffer capacity: oldest spans overwrite beyond this
+        # (the export reports how many were dropped); 0 = engine default
+        "query_trace_max_events": 0,
         # --- cluster fault tolerance (cluster/retry.py) ---
         # NONE fails fast; QUERY re-plans + re-runs the whole query on
         # retryable failures (failed nodes excluded from placement); TASK

@@ -223,7 +223,7 @@ def q1_stream(sf: float, seconds_budget: float = 60.0,
     total_rows = 0
     gen_stall = 0.0
     first_compile = None
-    t0 = time.time()
+    t0 = time.perf_counter()
 
     def assemble(n_target: int):
         """Take exactly n_target rows from pend (callers ensured enough)."""
@@ -234,18 +234,18 @@ def q1_stream(sf: float, seconds_budget: float = 60.0,
     def dispatch(args, nrows):
         nonlocal acc, first_compile, total_rows
         if first_compile is None:
-            tc = time.time()
+            tc = time.perf_counter()
             acc = step(*args, acc)
             jax.block_until_ready(acc)
-            first_compile = time.time() - tc
+            first_compile = time.perf_counter() - tc
         else:
             acc = step(*args, acc)
         total_rows += nrows
 
     while done_producers < len(threads):
-        ts = time.time()
+        ts = time.perf_counter()
         item = q.get()
-        gen_stall += time.time() - ts
+        gen_stall += time.perf_counter() - ts
         if item is None:
             done_producers += 1
             continue
@@ -253,7 +253,7 @@ def q1_stream(sf: float, seconds_budget: float = 60.0,
         pend_rows += len(item[0])
         while pend_rows >= batch_rows:
             dispatch(assemble(batch_rows), batch_rows)
-        if time.time() - t0 > seconds_budget or \
+        if time.perf_counter() - t0 > seconds_budget or \
                 (max_rows is not None and total_rows >= max_rows):
             stop.set()
             # drain queue so producers can exit
@@ -278,7 +278,7 @@ def q1_stream(sf: float, seconds_budget: float = 60.0,
                     np.concatenate([ls, np.zeros(pad, np.int8)]))
         dispatch(args, n)
     jax.block_until_ready(acc)
-    wall = time.time() - t0
+    wall = time.perf_counter() - t0
     if producer_errors:
         raise RuntimeError("q1_stream producer failed") from producer_errors[0]
     return total_rows, wall, gen_stall, first_compile, q1_lane_finish(np.asarray(acc))
@@ -313,9 +313,9 @@ def q1_resident(sf: float, batch_rows: int = 1 << 22, runs: int = 10):
     acc = step(*args, acc)
     jax.block_until_ready(acc)          # compile + one warm batch
     one_batch = q1_lane_finish(np.asarray(acc))
-    t0 = time.time()
+    t0 = time.perf_counter()
     for _ in range(runs):
         acc = step(*args, acc)
     jax.block_until_ready(acc)
-    dt = (time.time() - t0) / runs
+    dt = (time.perf_counter() - t0) / runs
     return batch_rows / dt, batch_rows, dt * 1000.0, one_batch

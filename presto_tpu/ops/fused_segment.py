@@ -43,12 +43,14 @@ Per-segment dispatch and compile counts surface in
 from __future__ import annotations
 
 import threading
+import time
 from typing import List, Optional
 
 import jax
 
 from ..block import Page
 from ..utils import kernel_cache as kc
+from ..utils import trace
 from ..utils.metrics import METRICS
 from .filter_project import FilterProjectOperatorFactory
 from .hash_agg import (DirectAggregationBuilder, GlobalAggregationBuilder,
@@ -122,6 +124,7 @@ class FusedSegmentOperatorFactory(OperatorFactory):
         with self._lock:
             self.compiles += 1
         METRICS.count("segments.compiles")
+        trace.instant(trace.SEGMENT, f"compile {self.name}")
 
     def describe(self) -> dict:
         with self._lock:
@@ -364,8 +367,12 @@ class FusedSegmentOperator(Operator):
 
         self._fused = kc.get_or_install(key, make)
 
-    @timed("add_input_ns")
     def add_input(self, page: Page) -> None:
+        # timed by hand instead of @timed: ONE clock pair feeds the stats
+        # accumulator, the per-page dispatch histogram AND the trace span
+        # (the decorator would add a second measurement of the same window
+        # and a duplicate `operator` span per page)
+        t0 = time.perf_counter_ns()
         self.context.record_input(page, page.capacity)
         in_key = kc.layout_key([b.type for b in page.blocks],
                                [b.dictionary for b in page.blocks])
@@ -376,18 +383,31 @@ class FusedSegmentOperator(Operator):
         auxes = tuple(st["aux"] for st in self._stages
                       if st["aux"] is not None)
         self._pages += 1
-        if t is None:
-            self._pending = self._fused(page, auxes, None, out_groups=0)
-            return
-        og = t.out_groups(page.capacity)
-        result = self._fused(page, auxes, t.state(), out_groups=og)
-        if not t.absorb(result, page.capacity, og):
-            # the builder's shrunken partial table overflowed on this page
-            # and reset to full size: recompute the page at the new size
+        try:
+            if t is None:
+                self._pending = self._fused(page, auxes, None, out_groups=0)
+                return
             og = t.out_groups(page.capacity)
-            ok = t.absorb(self._fused(page, auxes, t.state(), out_groups=og),
-                          page.capacity, og)
-            assert ok, "full-size partial cannot overflow"
+            result = self._fused(page, auxes, t.state(), out_groups=og)
+            if not t.absorb(result, page.capacity, og):
+                # the builder's shrunken partial table overflowed on this
+                # page and reset to full size: recompute at the new size
+                og = t.out_groups(page.capacity)
+                ok = t.absorb(
+                    self._fused(page, auxes, t.state(), out_groups=og),
+                    page.capacity, og)
+                assert ok, "full-size partial cannot overflow"
+        finally:
+            # per-page dispatch latency: one histogram observation per page
+            # (pages are large, so this is per-dispatch, not per-row) plus a
+            # flight-recorder span when a trace is live
+            dt = time.perf_counter_ns() - t0
+            stats = self.context.stats
+            stats.add_input_ns += dt
+            METRICS.histogram("segments.page_dispatch_s", dt / 1e9)
+            trace.record(trace.SEGMENT, self.f.name, t0, dt,
+                         {"rows": page.capacity}
+                         if trace.active() is not None else None)
 
     @timed("get_output_ns")
     def get_output(self) -> Optional[Page]:

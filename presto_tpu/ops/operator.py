@@ -19,6 +19,7 @@ from typing import Any, Callable, List, Optional, Sequence
 from ..block import Page
 from ..memory import AggregatedMemoryContext, MemoryTrackingContext
 from ..types import Type
+from ..utils import trace
 
 
 @dataclasses.dataclass
@@ -35,10 +36,26 @@ class OperatorStats:
     add_input_ns: int = 0
     get_output_ns: int = 0
     finish_ns: int = 0
+    # time this operator held its driver BLOCKED (build wait, backpressure),
+    # attributed by the Driver when the parked driver next runs
+    blocked_ns: int = 0
     peak_memory_bytes: int = 0
 
     def total_ns(self) -> int:
         return self.add_input_ns + self.get_output_ns + self.finish_ns
+
+    def to_dict(self) -> dict:
+        """JSON-safe form for the cluster control plane: each worker ships
+        its task's operator stats inside TaskInfo so the coordinator's
+        distributed EXPLAIN ANALYZE can roll them up (the reference ships
+        OperatorStats inside TaskStatus the same way)."""
+        return {"operator_id": self.operator_id, "name": self.name,
+                "input_rows": self.input_rows,
+                "output_rows": self.output_rows,
+                "total_ns": self.total_ns(), "blocked_ns": self.blocked_ns,
+                "peak_memory_bytes": self.peak_memory_bytes,
+                "input_pages": self.input_pages,
+                "output_pages": self.output_pages}
 
 
 class OperatorContext:
@@ -167,14 +184,25 @@ class OperatorFactory(abc.ABC):
 
 
 def timed(stats_field: str):
-    """Decorator: accumulate wall-clock ns of an operator method into stats."""
+    """Decorator: accumulate wall-clock ns of an operator method into stats.
+
+    Doubles as the flight recorder's operator tap: when a query trace is
+    active, every call above the noise floor becomes an `operator` span —
+    the stats and the timeline are measured by the same clock read."""
+    method = stats_field.rsplit("_", 1)[0]  # "add_input_ns" -> "add_input"
+
     def deco(fn):
         def wrapper(self, *a, **kw):
             t0 = time.perf_counter_ns()
             try:
                 return fn(self, *a, **kw)
             finally:
-                setattr(self.context.stats, stats_field,
-                        getattr(self.context.stats, stats_field) + time.perf_counter_ns() - t0)
+                dt = time.perf_counter_ns() - t0
+                stats = self.context.stats
+                setattr(stats, stats_field, getattr(stats, stats_field) + dt)
+                if trace.active() is not None and \
+                        dt >= trace.MIN_OPERATOR_SPAN_NS:
+                    trace.record(trace.OPERATOR, f"{stats.name}.{method}",
+                                 t0, dt)
         return wrapper
     return deco

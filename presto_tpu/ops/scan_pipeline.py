@@ -48,6 +48,7 @@ import jax
 import numpy as np
 
 from ..block import Block, Page
+from ..utils import trace
 from ..utils.batching import clamp_capacity, take_rows
 from ..utils.metrics import METRICS
 
@@ -66,6 +67,10 @@ _STAGE_KEYS = ("read_busy_s", "read_stall_s", "decode_busy_s",
                "decode_stall_s", "upload_busy_s", "upload_stall_s",
                "compute_stall_s")
 _COUNT_KEYS = ("chunks", "pages", "rows", "bytes")
+
+# flight-recorder noise floor for STALL spans: sub-100us waits are scheduler
+# jitter, not attribution-worthy events (busy spans always record)
+_TRACE_STALL_NS = 100_000
 
 
 def page_nbytes(page: Page) -> int:
@@ -251,9 +256,12 @@ class ScanPipeline:
         as compute_stall_s — the device had nothing to chew on)."""
         if not self._started:
             self._start()
-        t0 = time.perf_counter()
+        t0 = time.perf_counter_ns()
         item = self._out.get()
-        self._add("compute_stall_s", time.perf_counter() - t0)
+        dt = time.perf_counter_ns() - t0
+        self._add("compute_stall_s", dt / 1e9)
+        if dt >= _TRACE_STALL_NS:
+            trace.record(trace.SCAN, "compute_stall", t0, dt)
         if item is _EOS:
             self._out.put(_EOS)  # keep later next() calls returning None
             self._flush_metrics()
@@ -328,12 +336,16 @@ class ScanPipeline:
                 it = iter(self._readers[ri]())
                 seq = 0
                 while True:
-                    t0 = time.perf_counter()
+                    t0 = time.perf_counter_ns()
                     try:
                         item = next(it)
                     except StopIteration:
                         break
-                    self._add("read_busy_s", time.perf_counter() - t0)
+                    dt = time.perf_counter_ns() - t0
+                    self._add("read_busy_s", dt / 1e9)
+                    trace.record(trace.SCAN, "read", t0, dt,
+                                 {"reader": ri, "seq": seq}
+                                 if trace.active() is not None else None)
                     nbytes = item.nbytes if isinstance(item, HostChunk) \
                         else page_nbytes(item)
                     if not self._stage_put(ri, seq, item, nbytes):
@@ -349,7 +361,7 @@ class ScanPipeline:
         budget. The item the decode stage needs NEXT bypasses a full budget
         (deadlock freedom); returns False when the pipeline stopped."""
         key = (ri, seq)
-        t0 = time.perf_counter()
+        t0 = time.perf_counter_ns()
         with self._cv:
             while (self._staged_bytes > 0
                    and self._staged_bytes + nbytes > self._max_bytes
@@ -361,13 +373,16 @@ class ScanPipeline:
             self._buf[key] = (item, nbytes)
             self._staged_bytes += nbytes
             self._cv.notify_all()
-        self._add("read_stall_s", time.perf_counter() - t0)
+        dt = time.perf_counter_ns() - t0
+        self._add("read_stall_s", dt / 1e9)
+        if dt >= _TRACE_STALL_NS:
+            trace.record(trace.SCAN, "read_stall", t0, dt)
         return True
 
     def _stage_take(self, ri: int, seq: int):
         """Blocking in-order take; None when the pipeline stopped."""
         key = (ri, seq)
-        t0 = time.perf_counter()
+        t0 = time.perf_counter_ns()
         with self._cv:
             self._needed = key
             self._cv.notify_all()
@@ -378,7 +393,10 @@ class ScanPipeline:
             item, nbytes = self._buf.pop(key)
             self._staged_bytes -= nbytes
             self._cv.notify_all()
-        self._add("decode_stall_s", time.perf_counter() - t0)
+        dt = time.perf_counter_ns() - t0
+        self._add("decode_stall_s", dt / 1e9)
+        if dt >= _TRACE_STALL_NS:
+            trace.record(trace.SCAN, "decode_stall", t0, dt)
         return item
 
     def _decode_loop(self) -> None:
@@ -397,9 +415,11 @@ class ScanPipeline:
                         break
                     seq += 1
                     if rb is not None:
-                        t0 = time.perf_counter()
+                        t0 = time.perf_counter_ns()
                         batches = rb.add(item)
-                        self._add("decode_busy_s", time.perf_counter() - t0)
+                        dt = time.perf_counter_ns() - t0
+                        self._add("decode_busy_s", dt / 1e9)
+                        trace.record(trace.SCAN, "rebatch", t0, dt)
                         self._add("chunks", 1)
                         for page, nbytes, rows in batches:
                             if not self._emit(page, nbytes, rows):
@@ -425,7 +445,7 @@ class ScanPipeline:
         """Admit a decoded page to the upload stage under the byte budget
         on uploaded-but-unconsumed pages (the stall here means the CONSUMER
         is the bottleneck — the healthy state)."""
-        t0 = time.perf_counter()
+        t0 = time.perf_counter_ns()
         with self._ocv:
             while (self._out_bytes > 0
                    and self._out_bytes + nbytes > self._max_bytes
@@ -434,7 +454,10 @@ class ScanPipeline:
             if self._stop.is_set():
                 return False
             self._out_bytes += nbytes
-        self._add("upload_stall_s", time.perf_counter() - t0)
+        dt = time.perf_counter_ns() - t0
+        self._add("upload_stall_s", dt / 1e9)
+        if dt >= _TRACE_STALL_NS:
+            trace.record(trace.SCAN, "upload_stall", t0, dt)
         self._upq.put((page, nbytes, rows))
         return True
 
@@ -450,10 +473,14 @@ class ScanPipeline:
                         self._out.put(_EOS)
                     return
                 page, nbytes, rows = item
-                t0 = time.perf_counter()
+                t0 = time.perf_counter_ns()
                 dev = jax.tree.map(
                     lambda a: jax.device_put(a, self._device), page)
-                self._add("upload_busy_s", time.perf_counter() - t0)
+                dt = time.perf_counter_ns() - t0
+                self._add("upload_busy_s", dt / 1e9)
+                trace.record(trace.SCAN, "upload", t0, dt,
+                             {"rows": rows, "bytes": nbytes}
+                             if trace.active() is not None else None)
                 with self._stats_lock:
                     self._stats["pages"] += 1
                     self._stats["rows"] += rows

@@ -50,6 +50,7 @@ from ..sql.planner.plan import (BROADCAST, GATHER, MERGE, OutputNode,
                                 REPARTITION, RemoteSourceNode, plan_to_text)
 from ..sql.planner.planner import LogicalPlanner
 from ..types import Type
+from ..utils import trace
 from ..utils.metrics import METRICS
 from .mesh import MeshContext, WORKER_AXIS
 # shared exchange plumbing (one accounting + device-helper set for both data
@@ -112,6 +113,12 @@ class DistributedQueryRunner:
 
     def execute(self, sql: str) -> QueryResult:
         stmt = self.local.parser.parse(sql)
+        if isinstance(stmt, t.Explain) and stmt.analyze and \
+                isinstance(stmt.statement, t.Query):
+            # distributed EXPLAIN ANALYZE: execute over the mesh and render
+            # per-fragment per-operator stats rolled up across workers —
+            # before this, ANALYZE silently profiled the single-node path
+            return self._explain_analyze(stmt.statement)
         if not isinstance(stmt, t.Query):
             return self.local.execute(sql)  # EXPLAIN/SHOW et al stay local
         sub = self.plan_statement(stmt)
@@ -119,12 +126,36 @@ class DistributedQueryRunner:
 
     # ------------------------------------------------------------ execution
 
-    def _execute_subplan(self, sub: SubPlan) -> QueryResult:
+    def _execute_subplan(self, sub: SubPlan,
+                         frag_drivers: Optional[Dict[int, List[list]]] = None
+                         ) -> QueryResult:
+        """`frag_drivers`, when given, collects each fragment's per-worker
+        driver lists for EXPLAIN ANALYZE's stats roll-up."""
+        import time as _time
+
         book = ExchangeStatsBook()
-        if bool(self.session.get("streaming_exchange", True)):
-            result = self._execute_streaming(sub, book)
-        else:
-            result = self._execute_barrier(sub, book)
+
+        def run() -> QueryResult:
+            if bool(self.session.get("streaming_exchange", True)):
+                return self._execute_streaming(sub, book, frag_drivers)
+            return self._execute_barrier(sub, book, frag_drivers)
+
+        rec = trace.maybe_recorder(self.session)
+        installed = rec is not None and trace.install(rec)
+        t0 = _time.perf_counter()
+        try:
+            # span only on THIS query's recorder: an untraced query running
+            # concurrently with a traced one must not write a full-wall
+            # lifecycle span into the other query's timeline
+            if installed:
+                with rec.span(trace.LIFECYCLE, "query"):
+                    result = run()
+            else:
+                result = run()
+        finally:
+            if installed:
+                trace.uninstall(rec)
+        METRICS.histogram("query.wall_s", _time.perf_counter() - t0)
         snap = book.snapshot()
         if snap:
             snap["mode"] = "streaming" \
@@ -134,6 +165,8 @@ class DistributedQueryRunner:
             METRICS.count_many(
                 {k: v for k, v in snap.items()
                  if isinstance(v, (int, float))}, prefix="exchange.")
+        if installed:
+            result.trace_path = trace.export(rec, self.session)
         return result
 
     def _fragment_root(self, sub: SubPlan, frag: Fragment) -> OutputNode:
@@ -155,7 +188,8 @@ class DistributedQueryRunner:
                 for o in frag.output_orderings)
         return key_idx, orderings
 
-    def _execute_streaming(self, sub: SubPlan, book: ExchangeStatsBook) \
+    def _execute_streaming(self, sub: SubPlan, book: ExchangeStatsBook,
+                           frag_drivers: Optional[dict] = None) \
             -> QueryResult:
         """Plan every fragment, connect them with StreamingExchanges, then
         run ALL fragments' drivers in ONE task-executor pass: producer and
@@ -210,7 +244,14 @@ class DistributedQueryRunner:
                 for fid, slot in ep.remote_slots.items():
                     slot.stream = exchanges[fid]
                 for w in workers:
-                    drivers.extend(ep.create_drivers(w))
+                    worker_drivers = ep.create_drivers(w)
+                    drivers.extend(worker_drivers)
+                    if frag_drivers is not None:
+                        # per-worker lists: driver ordering is deterministic
+                        # per plan, so EXPLAIN ANALYZE's roll-up can line
+                        # operator instances up across workers
+                        frag_drivers.setdefault(frag.id, []).append(
+                            worker_drivers)
                 if is_root:
                     root_ep = ep
             # all drivers exist: producer counts are exact — start the pumps
@@ -231,7 +272,8 @@ class DistributedQueryRunner:
                     except Exception:  # noqa: BLE001 - teardown best effort
                         pass
 
-    def _execute_barrier(self, sub: SubPlan, book: ExchangeStatsBook) \
+    def _execute_barrier(self, sub: SubPlan, book: ExchangeStatsBook,
+                         frag_drivers: Optional[dict] = None) \
             -> QueryResult:
         """The pre-streaming stage-barrier loop, kept as the differential
         oracle: each fragment drains fully, then ONE variable-shape
@@ -243,12 +285,14 @@ class DistributedQueryRunner:
         executor = TaskExecutor(int(self.session.get("task_concurrency")),
                                 persistent=True)
         try:
-            return self._run_barrier_stages(sub, executor, query_memory, book)
+            return self._run_barrier_stages(sub, executor, query_memory,
+                                            book, frag_drivers)
         finally:
             executor.close()
 
     def _run_barrier_stages(self, sub: SubPlan, executor: TaskExecutor,
-                            query_memory, book: ExchangeStatsBook) \
+                            query_memory, book: ExchangeStatsBook,
+                            frag_drivers: Optional[dict] = None) \
             -> QueryResult:
         W = self.mesh.n_workers
         frag_dicts: Dict[int, List[Optional[Dictionary]]] = {}
@@ -269,7 +313,10 @@ class DistributedQueryRunner:
                     slot.set_pages(w, routed[fid][w])
             # all workers' drivers share one executor: worker tasks and their
             # build/probe pipelines time-slice across runner threads
-            drivers = [d for w in workers for d in ep.create_drivers(w)]
+            per_worker_drivers = [ep.create_drivers(w) for w in workers]
+            if frag_drivers is not None:
+                frag_drivers[frag.id] = per_worker_drivers
+            drivers = [d for wd in per_worker_drivers for d in wd]
             executor.execute(drivers)
             if is_root:
                 return QueryResult(ep.sink.rows(), sub.column_names,
@@ -284,6 +331,52 @@ class DistributedQueryRunner:
                 orderings=orderings, book=book)
             frag_dicts[frag.id] = ep.output_dicts
         raise AssertionError("root fragment must terminate execution")
+
+    # ------------------------------------------------- EXPLAIN ANALYZE
+
+    def _explain_analyze(self, stmt: t.Query) -> QueryResult:
+        """Execute over the mesh, then render per-fragment per-operator
+        stats ROLLED UP across workers (rows / wall / blocked / peak-mem,
+        via exec/explain.py — the same table the local runner prints),
+        plus each fragment boundary's exchange chunk/carry counts."""
+        import time as _time
+
+        from ..exec.explain import driver_stats, rollup, table
+
+        sub = self.plan_statement(stmt)
+        frag_drivers: Dict[int, List[list]] = {}
+        t0 = _time.perf_counter()
+        result = self._execute_subplan(sub, frag_drivers)
+        wall = _time.perf_counter() - t0
+        ex = (result.stats or {}).get("exchange", {})
+        per_exchange = {e.get("fragment"): e
+                        for e in ex.get("per_exchange", [])}
+        lines = [f"Query: {wall * 1000:.0f}ms wall, "
+                 f"{len(sub.fragments)} fragments, "
+                 f"{self.mesh.n_workers} workers, "
+                 f"exchange={ex.get('mode', 'none')}", ""]
+        for frag in sub.fragments:
+            head = f"Fragment {frag.id} [{frag.partitioning}]"
+            if frag.output_kind:
+                head += f" output={frag.output_kind}"
+            per_worker = frag_drivers.get(frag.id, [])
+            head += f" workers={len(per_worker)}"
+            lines.append(head)
+            stats = [s for wd in per_worker for s in driver_stats(wd)]
+            lines += table(rollup(stats), indent="  ")
+            exch = per_exchange.get(frag.id)
+            if exch:
+                lines.append(
+                    f"  exchange [{exch.get('kind')}]: "
+                    f"chunks={exch.get('chunks', 0)} "
+                    f"carry_rows={exch.get('carry_rows', 0)} "
+                    f"rows_out={exch.get('rows_out', 0)} "
+                    f"compiles={exch.get('compiles', 0)} "
+                    f"overlap_s={exch.get('overlap_s', 0)}")
+            lines.append("")
+        return QueryResult([[line] for line in lines], ["Query Plan"],
+                           stats=result.stats,
+                           trace_path=result.trace_path)
 
 
 # ---------------------------------------------------------------------------

@@ -49,6 +49,8 @@ from ..ops.operator import Operator, OperatorContext, OperatorFactory, timed
 from ..ops.scan_pipeline import page_nbytes
 from ..sql.planner.plan import BROADCAST, GATHER, MERGE, REPARTITION
 from ..types import Type
+from ..utils import trace
+from ..utils.metrics import METRICS
 from .mesh import MeshContext, WORKER_AXIS
 
 # ---------------------------------------------------------------------------
@@ -567,13 +569,18 @@ class StreamingExchange:
                 self._deliver(pending_delivery)
                 pending_delivery = None
             with self._cv:
-                t0 = time.perf_counter()
+                t0 = time.perf_counter_ns()
                 while not any(self._inbox) and \
                         (self._open_producers is None or
                          self._open_producers > 0) and \
                         self._error is None and not self._closed:
                     self._cv.wait(timeout=0.05)
-                self.stats["stall_s"] += time.perf_counter() - t0
+                stalled = time.perf_counter_ns() - t0
+                self.stats["stall_s"] += stalled / 1e9
+                if stalled >= 1_000_000:  # >= 1ms: a real starvation window
+                    trace.record(trace.EXCHANGE,
+                                 f"pump_stall f{self.fragment_id}",
+                                 t0, stalled)
                 drained = self._inbox
                 self._inbox = [[] for _ in range(W)]
                 producers_done = (self._open_producers is not None and
@@ -735,7 +742,7 @@ class StreamingExchange:
         in-flight collective (double buffering)."""
         W, C = self.W, self.chunk_rows
         ncols = len(self.types)
-        t0 = time.perf_counter()
+        t0 = time.perf_counter_ns()
         range_keys = None
         if self.kind == MERGE:
             range_keys = self._merge_range_keys(state)
@@ -764,12 +771,19 @@ class StreamingExchange:
                 carry_arrays = carry_mask = None
         with self._cv:
             producing = (self._open_producers or 0) > 0
-        dt = time.perf_counter() - t0
+        dt_ns = time.perf_counter_ns() - t0
+        dt = dt_ns / 1e9
         self.stats["chunks"] += 1
+        chunk_no = self.stats["chunks"]
         self.stats["dispatch_s"] += dt
         if producing:
             self.stats["overlap_chunks"] += 1
             self.stats["overlap_s"] += dt
+        trace.record(trace.EXCHANGE, f"chunk_dispatch f{self.fragment_id}",
+                     t0, dt_ns,
+                     {"kind": self.kind, "chunk": chunk_no,
+                      "overlap": producing}
+                     if trace.active() is not None else None)
         if self.book is not None:
             self.book.bump("chunks")
             if producing:
@@ -798,7 +812,10 @@ class StreamingExchange:
         # deliver the PREVIOUS chunk now that this one is in flight
         if pending_delivery is not None:
             self._deliver(pending_delivery)
-        return (out_arrays, out_mask)
+        # the dispatch timestamp + chunk number ride along so delivery can
+        # histogram the FULL chunk latency (collective issue -> pages on
+        # the consumer queues)
+        return (out_arrays, out_mask, t0, chunk_no)
 
     def _merge_range_keys(self, state):
         """Per-worker routing keys for this chunk (eager, on each worker's
@@ -846,7 +863,8 @@ class StreamingExchange:
         import jax
         import jax.numpy as jnp
 
-        out_arrays, out_mask = dispatched
+        out_arrays, out_mask, dispatch_t0, chunk_no = dispatched
+        t0 = time.perf_counter_ns()
         W, ncols = self.W, len(self.types)
         out_len = out_mask.shape[0] // W
         compact = _compact_pad_jit()
@@ -889,6 +907,15 @@ class StreamingExchange:
             self.stats["rows_out"] += live_w
             if self.book is not None:
                 self.book.bump("rows", live_w)
+        end = time.perf_counter_ns()
+        # per-chunk latency = dispatch issue -> pages delivered; the /v1/
+        # metrics percentiles the serving roadmap needs come from here
+        METRICS.histogram("exchange.chunk_latency_s",
+                          (end - dispatch_t0) / 1e9)
+        trace.record(trace.EXCHANGE, f"chunk_deliver f{self.fragment_id}",
+                     t0, end - t0,
+                     {"chunk": chunk_no}
+                     if trace.active() is not None else None)
 
     def _publish_stats(self) -> None:
         if self.book is not None:

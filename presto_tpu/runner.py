@@ -20,6 +20,8 @@ from .sql.planner.optimizer import optimize
 from .sql.planner.plan import OutputNode, plan_to_text
 from .types import BIGINT
 from .sql.planner.planner import LogicalPlanner
+from .utils import trace
+from .utils.metrics import METRICS
 
 
 def _virtual_remap(source_dict, target_dict):
@@ -59,6 +61,10 @@ class QueryResult:
     # pipeline's per-stage busy/stall breakdown under "scan_pipeline".
     # None when there is nothing to report.
     stats: Optional[dict] = None
+    # Chrome trace-event JSON export of the query's flight recorder
+    # (utils/trace.py), set when the `query_trace` session knob is on;
+    # loads directly in Perfetto / chrome://tracing
+    trace_path: Optional[str] = None
 
 
 def _scan_pipeline_stats(drivers) -> Optional[dict]:
@@ -202,8 +208,33 @@ class LocalQueryRunner:
             walk(stmt)
 
     def execute(self, sql: str, user: Optional[str] = None) -> QueryResult:
+        """Public entry: runs the statement under the per-query flight
+        recorder when `query_trace` is on, and histograms the wall either
+        way (`query.wall_s` p50/p95/p99 at /v1/metrics)."""
+        import time as _time
+
+        rec = trace.maybe_recorder(self.session)
+        installed = rec is not None and trace.install(rec)
+        t0 = _time.perf_counter()
+        try:
+            if installed:
+                with rec.span(trace.LIFECYCLE, "query"):
+                    result = self._execute_statement(sql, user)
+            else:
+                result = self._execute_statement(sql, user)
+        finally:
+            if installed:
+                trace.uninstall(rec)
+        METRICS.histogram("query.wall_s", _time.perf_counter() - t0)
+        if installed:
+            result.trace_path = trace.export(rec, self.session)
+        return result
+
+    def _execute_statement(self, sql: str,
+                           user: Optional[str] = None) -> QueryResult:
         self.last_grouped = None  # set again on the grouped query path
-        stmt = self.parser.parse(sql)
+        with trace.span(trace.LIFECYCLE, "parse"):
+            stmt = self.parser.parse(sql)
         self._check_access(stmt, user)
         if isinstance(stmt, t.Explain):
             inner = stmt.statement
@@ -246,7 +277,8 @@ class LocalQueryRunner:
         if not isinstance(stmt, t.Query):
             raise ValueError(f"unsupported statement {type(stmt).__name__}")
 
-        plan = self.plan_statement(stmt)
+        with trace.span(trace.LIFECYCLE, "plan"):
+            plan = self.plan_statement(stmt)
 
         # grouped (lifespan) execution: co-bucketed scans run one bucket at
         # a time so join/agg device state is bounded by a single bucket
@@ -488,37 +520,51 @@ class LocalQueryRunner:
         profile always measures the pipeline the query actually runs."""
         import time as _time
 
-        local = LocalExecutionPlanner(self.metadata, self.session,
-                                      bucket_filter=bucket_filter)
-        local.attach_memory(*self._query_memory())
-        exec_plan = local.plan(plan)
-        drivers = exec_plan.create_drivers()
-        t0 = _time.time()
+        with trace.span(trace.LIFECYCLE, "local_plan"):
+            local = LocalExecutionPlanner(self.metadata, self.session,
+                                          bucket_filter=bucket_filter)
+            local.attach_memory(*self._query_memory())
+            exec_plan = local.plan(plan)
+            drivers = exec_plan.create_drivers()
+        t0 = _time.perf_counter()
         # task executor: build/probe pipelines overlap on runner threads
         # (blocked probes park until their lookup slot resolves)
-        TaskExecutor(int(self.session.get("task_concurrency"))).execute(drivers)
-        return exec_plan, drivers, _time.time() - t0
+        with trace.span(trace.LIFECYCLE, "execute"):
+            TaskExecutor(
+                int(self.session.get("task_concurrency"))).execute(drivers)
+        return exec_plan, drivers, _time.perf_counter() - t0
 
     def _explain_analyze(self, stmt: t.Query) -> str:
         """EXPLAIN ANALYZE: execute, then render the plan with per-operator
-        rows/time/memory (ExplainAnalyzeOperator.java analogue — here the
-        stats roll up from each driver's OperatorContext after the run)."""
+        rows/time/blocked/memory (ExplainAnalyzeOperator.java analogue —
+        the stats roll up from each driver's OperatorContext after the run;
+        the mesh and cluster runners render the same table per fragment via
+        exec/explain.py). Prints the stats the engine tracks but never
+        showed before: per-operator blocked time and the fused-segment
+        compile/dispatch breakdown."""
+        from .exec.explain import driver_stats, table
+
         plan = self.plan_statement(stmt)
-        _exec_plan, drivers, wall = self._run_plan(plan)
+        exec_plan, drivers, wall = self._run_plan(plan)
         lines = [f"Query: {wall * 1000:.0f}ms wall, "
                  f"{len(drivers)} drivers, "
                  f"{sum(len(d.operators) for d in drivers)} operators", ""]
-        lines += [f"{'Operator':<28}{'In rows':>10}{'Out rows':>10}"
-                  f"{'Wall ms':>9}{'Peak MB':>9}"]
-        lines += ["-" * 66]
-        for di, d in enumerate(drivers):
-            lines.append(f"pipeline {di}:")
-            for op in d.operators:
-                s = op.context.stats
+        lines += table(driver_stats(drivers), pipelines=True)
+        seg = _segment_stats(exec_plan)
+        if seg:
+            lines += ["", f"fused segments: {seg['count']} fused, "
+                          f"{seg['dispatches']} dispatches, "
+                          f"{seg['compiles']} compiles"]
+            for s in seg["segments"]:
                 lines.append(
-                    f"  {s.name:<26}{s.input_rows:>10}{s.output_rows:>10}"
-                    f"{s.total_ns() / 1e6:>9.1f}"
-                    f"{s.peak_memory_bytes / 1e6:>9.2f}")
+                    f"  pipeline {s['pipeline']}: "
+                    f"{'+'.join(s['operators'])} "
+                    f"({s['dispatches']} dispatches, "
+                    f"{s['compiles']} compiles)")
+        scan = _scan_pipeline_stats(drivers)
+        if scan:
+            lines += ["", "scan pipeline: " +
+                      ", ".join(f"{k}={scan[k]}" for k in sorted(scan))]
         lines += ["", plan_to_text(plan)]
         return "\n".join(lines)
 

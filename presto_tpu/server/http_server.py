@@ -27,7 +27,7 @@ from typing import Optional
 
 from .protocol import QueryManager
 
-_START_TIME = time.time()
+_START_MONO = time.monotonic()
 _VERSION = "presto-tpu 0.1"
 
 
@@ -137,7 +137,7 @@ class _Handler(BaseHTTPRequestHandler):
             # health probe stays open (load balancers / failure detector)
             return self._send_json({
                 "nodeVersion": {"version": _VERSION},
-                "uptime": round(time.time() - _START_TIME, 1),
+                "uptime": round(time.monotonic() - _START_MONO, 1),
                 "coordinator": True,
             })
         if self._authenticate() is None:
@@ -145,8 +145,6 @@ class _Handler(BaseHTTPRequestHandler):
         if self.path.rstrip("/") in ("", "/ui"):
             # cluster dashboard (the reference's webapp/ React SPA, served as
             # one static page over the same /v1/cluster + /v1/query API)
-            import os
-
             path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                 "webui.html")
             with open(path, "rb") as f:
@@ -187,6 +185,29 @@ class _Handler(BaseHTTPRequestHandler):
         if self.path.rstrip("/") == "/v1/query":
             return self._send_json([self._query_json(q)
                                     for q in self.manager.list_queries()])
+        m = re.fullmatch(r"/v1/query/([^/]+)/trace", self.path)
+        if m:
+            # flight-recorder export (run the query with the `query_trace`
+            # session knob / X-Presto-Session); the body is Chrome
+            # trace-event JSON — save it and load in Perfetto
+            info = self.manager.get(m.group(1))
+            if info is None:
+                return self._not_found()
+            path = getattr(info, "trace_path", None)
+            if not path or not os.path.exists(path):
+                return self._send_json(
+                    {"error": {"message":
+                               f"query {info.query_id} has no trace "
+                               "(set session property query_trace=true)"}},
+                    status=404)
+            with open(path, "rb") as f:
+                body = f.read()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
         m = re.fullmatch(r"/v1/query/([^/]+)", self.path)
         if m:
             info = self.manager.get(m.group(1))
@@ -214,8 +235,8 @@ class _Handler(BaseHTTPRequestHandler):
             "query": info.sql,
             "traceToken": getattr(info, "trace_token", ""),
             "rowCount": info.row_count,
-            "elapsedMillis": int(
-                ((info.end_time or time.time()) - info.create_time) * 1000),
+            "elapsedMillis": info.elapsed_millis(),
+            "hasTrace": bool(getattr(info, "trace_path", None)),
             "error": info.error,
         }
 

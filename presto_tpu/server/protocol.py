@@ -40,17 +40,28 @@ class QueryInfo:
     rows: Optional[List[list]] = None
     columns: Optional[List[Dict[str, str]]] = None
     error: Optional[Dict] = None
+    # wall-clock timestamps (what clients display); duration math uses the
+    # monotonic pair below — wall deltas jump under NTP steps
     create_time: float = dataclasses.field(default_factory=time.time)
     end_time: Optional[float] = None
+    create_mono: float = dataclasses.field(default_factory=time.monotonic)
+    end_mono: Optional[float] = None
     row_count: int = 0
     user: str = ""
     source: str = ""
     catalog: str = ""    # per-query default-catalog override (JDBC/DBAPI)
     schema: str = ""
     trace_token: str = ""   # X-Presto-Trace-Token correlation id
+    # flight-recorder export (query_trace session knob): local path of the
+    # Chrome trace JSON, served at GET /v1/query/{id}/trace
+    trace_path: Optional[str] = None
 
     def done(self) -> bool:
         return self.state in _DONE
+
+    def elapsed_millis(self) -> int:
+        return int(((self.end_mono if self.end_mono is not None
+                     else time.monotonic()) - self.create_mono) * 1000)
 
 
 class QueryManager:
@@ -129,6 +140,7 @@ class QueryManager:
                 # marked canceled and its results are dropped on completion
                 info.state = CANCELED
                 info.end_time = time.time()
+                info.end_mono = time.monotonic()
         return True
 
     def list_queries(self) -> List[QueryInfo]:
@@ -193,11 +205,13 @@ class QueryManager:
                 if info.state == CANCELED:
                     return
                 info.rows = rows
+                info.trace_path = getattr(result, "trace_path", None)
                 info.row_count = len(rows)
                 info.columns = [{"name": n, "type": self._type_name(result, i)}
                                 for i, n in enumerate(result.column_names)]
                 info.state = FINISHED
                 info.end_time = time.time()
+                info.end_mono = time.monotonic()
             from ..utils.metrics import METRICS
             METRICS.count("query_manager.completed")
             METRICS.count("query_manager.output_rows", len(rows))
@@ -210,6 +224,7 @@ class QueryManager:
                 }
                 info.state = FAILED
                 info.end_time = time.time()
+                info.end_mono = time.monotonic()
             from ..utils.metrics import METRICS
             METRICS.count("query_manager.failed")
         finally:
@@ -259,8 +274,7 @@ class QueryManager:
             "infoUri": f"{base_uri}/v1/query/{info.query_id}",
             "stats": {
                 "state": info.state,
-                "elapsedTimeMillis": int(
-                    ((info.end_time or time.time()) - info.create_time) * 1000),
+                "elapsedTimeMillis": info.elapsed_millis(),
                 "processedRows": info.row_count,
             },
         }

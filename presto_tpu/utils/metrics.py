@@ -2,7 +2,7 @@
 
 The reference exposes engine internals as JMX MBeans (queried over
 /v1/jmx/mbean/... and scraped by dashboards); here a flat registry of
-counters and gauges serves the same role, exported as JSON at
+counters, gauges and histograms serves the same role, exported as JSON at
 ``/v1/metrics`` on every server (server/http_server.py).
 
 - ``counter(name)``: monotonically increasing int, incremented by the
@@ -10,15 +10,78 @@ counters and gauges serves the same role, exported as JSON at
   hits, spills).
 - ``gauge(name, fn)``: a callable sampled at snapshot time (memory pool
   reservation, resident-cache bytes).
+- ``histogram(name, value)``: fixed log2-bucket latency distribution
+  (airlift's DistributionStat analogue) — snapshot() derives
+  ``<name>.count/.p50/.p95/.p99`` so dashboards read percentiles, not
+  averages. Recorded for per-query wall, per-chunk exchange latency and
+  per-page fused-segment dispatch time.
 
 Names are dotted ``<component>.<metric>`` strings; everything is
 process-local (each worker serves its own /v1/metrics, exactly like
 per-node JMX)."""
 from __future__ import annotations
 
+import math
+import sys
 import threading
 import time
-from typing import Callable, Dict
+from typing import Callable, Dict, List
+
+# log2 histogram geometry: bucket 0 holds values <= _HIST_MIN seconds (1us);
+# bucket i holds (MIN*2^(i-1), MIN*2^i]. 64 buckets reach ~2.9e5 hours —
+# every engine latency fits, and the fixed layout makes percentile reads O(64)
+_HIST_MIN = 1e-6
+_HIST_BUCKETS = 64
+
+
+class Histogram:
+    """Fixed log-bucket distribution. NOT self-locking: the registry mutates
+    it under its own lock (one lock acquisition per record, same discipline
+    as the counters)."""
+
+    __slots__ = ("counts", "n", "total")
+
+    def __init__(self):
+        self.counts = [0] * _HIST_BUCKETS
+        self.n = 0
+        self.total = 0.0
+
+    def add(self, value: float) -> None:
+        self.counts[self._bucket(value)] += 1
+        self.n += 1
+        self.total += value
+
+    @staticmethod
+    def _bucket(value: float) -> int:
+        if value <= _HIST_MIN:
+            return 0
+        return min(_HIST_BUCKETS - 1,
+                   int(math.ceil(math.log2(value / _HIST_MIN))))
+
+    @staticmethod
+    def bucket_bound(i: int) -> float:
+        """Upper bound (seconds) of bucket i."""
+        return _HIST_MIN * (1 << i)
+
+    def percentile(self, q: float) -> float:
+        """Value at quantile ``q`` in [0, 1]: the upper bound of the bucket
+        holding the q-th observation (within 2x of the true value by the
+        log2 geometry; 0.0 for an empty histogram)."""
+        if self.n == 0:
+            return 0.0
+        rank = max(1, math.ceil(q * self.n))
+        seen = 0
+        for i, c in enumerate(self.counts):
+            seen += c
+            if seen >= rank:
+                return self.bucket_bound(i)
+        return self.bucket_bound(_HIST_BUCKETS - 1)
+
+    def summary(self) -> Dict[str, float]:
+        return {"count": self.n,
+                "p50": round(self.percentile(0.50), 6),
+                "p95": round(self.percentile(0.95), 6),
+                "p99": round(self.percentile(0.99), 6)}
 
 
 class MetricsRegistry:
@@ -26,7 +89,11 @@ class MetricsRegistry:
         self._lock = threading.Lock()
         self._counters: Dict[str, float] = {}
         self._gauges: Dict[str, Callable[[], float]] = {}
-        self._start = time.time()
+        self._hists: Dict[str, Histogram] = {}
+        # gauges whose failure was already logged: the FIRST failure per
+        # gauge goes to stderr, later ones only bump the error counter
+        self._gauge_logged: set = set()
+        self._start = time.monotonic()
 
     def count(self, name: str, delta: float = 1) -> None:
         with self._lock:
@@ -41,6 +108,20 @@ class MetricsRegistry:
                     name = prefix + k
                     self._counters[name] = self._counters.get(name, 0) + v
 
+    def histogram(self, name: str, value: float) -> None:
+        """Record one observation into the named log-bucket histogram."""
+        with self._lock:
+            h = self._hists.get(name)
+            if h is None:
+                h = self._hists[name] = Histogram()
+            h.add(value)
+
+    def histogram_summary(self, name: str) -> Dict[str, float]:
+        """{count, p50, p95, p99} of one histogram ({} when unrecorded)."""
+        with self._lock:
+            h = self._hists.get(name)
+            return h.summary() if h is not None else {}
+
     def set_gauge(self, name: str, fn: Callable[[], float]) -> None:
         with self._lock:
             self._gauges[name] = fn
@@ -50,19 +131,37 @@ class MetricsRegistry:
             return self._counters.get(name, 0)
 
     def snapshot(self, prefix: str = "") -> Dict[str, float]:
-        """-> {name: value}; `prefix` filters (the mbean-name lookup)."""
+        """-> {name: value}; `prefix` filters (the mbean-name lookup).
+        Histograms expand to ``<name>.count/.p50/.p95/.p99`` keys."""
         with self._lock:
             out = {k: v for k, v in self._counters.items()
                    if k.startswith(prefix)}
             gauges = [(k, fn) for k, fn in self._gauges.items()
                       if k.startswith(prefix)]
+            for k, h in self._hists.items():
+                if k.startswith(prefix):
+                    for stat, v in h.summary().items():
+                        out[f"{k}.{stat}"] = v
+        failed: List[tuple] = []
         for k, fn in gauges:
             try:
                 out[k] = fn()
-            except Exception:
+            except Exception as e:  # noqa: BLE001 — counted + logged below
                 out[k] = None
+                failed.append((k, e))
+        for k, e in failed:
+            # a silently-None gauge hides a broken probe forever: count it
+            # (metrics.gauge_errors on this very endpoint) and log the first
+            # failure per gauge to stderr so the breakage has a diagnostic
+            self.count("metrics.gauge_errors")
+            with self._lock:
+                first = k not in self._gauge_logged
+                self._gauge_logged.add(k)
+            if first:
+                print(f"presto-tpu metrics: gauge {k!r} failed: {e!r}",
+                      file=sys.stderr)
         if not prefix or "uptime".startswith(prefix):
-            out["uptime_seconds"] = round(time.time() - self._start, 1)
+            out["uptime_seconds"] = round(time.monotonic() - self._start, 1)
         return out
 
     def reset(self) -> None:
@@ -70,6 +169,8 @@ class MetricsRegistry:
         with self._lock:
             self._counters.clear()
             self._gauges.clear()
+            self._hists.clear()
+            self._gauge_logged.clear()
 
 
 METRICS = MetricsRegistry()

@@ -1,0 +1,286 @@
+"""Per-query flight recorder: engine-wide span tracing with Chrome-trace
+export.
+
+The reference rolls per-operator wall/row stats up to the coordinator
+(operator/OperatorStats.java -> QueryStats) but those are AGGREGATES —
+they say how much time a stage consumed, never WHEN. Everything PRs 3-5
+built (prefetch vs compute, double-buffered exchange chunks, concurrent
+fragments) is valuable precisely for when things happen, so this module
+records the timeline itself:
+
+- :class:`TraceRecorder` is a thread-safe ring buffer of spans stamped with
+  ``time.perf_counter_ns``. Producers on any engine thread (drivers, scan
+  readers, exchange pumps, HTTP clients) append; the ring bound makes the
+  recorder safe to leave on under heavy traffic (oldest spans overwrite,
+  the drop count is exported).
+- One recorder is INSTALLED process-wide while a traced query runs (the
+  ``query_trace`` session knob). Every instrumentation site goes through
+  the module-level :func:`record`/:func:`span` helpers, which are a single
+  ``is None`` check when tracing is off — the hot paths pay nothing.
+- Export is Chrome trace-event JSON (the ``{"traceEvents": [...]}`` shape
+  that loads directly in Perfetto / ``chrome://tracing``), reachable as
+  ``QueryResult.trace_path`` and over ``GET /v1/query/{id}/trace``.
+
+Categories — one per instrumented subsystem:
+  lifecycle  parse / plan / local-plan / execute phases
+  driver     TaskExecutor quanta (one span per driver slice)
+  operator   Operator add_input/get_output (via ops.operator.timed)
+  segment    fused-segment page dispatches + compile markers
+  scan       scan-pipeline read/decode/upload stage work + compute stalls
+  exchange   streaming-exchange chunk dispatch/delivery + pump stalls
+  kernel     kernel-cache misses (jit closure builds)
+  http       cluster task create/poll and exchange pulls
+"""
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+LIFECYCLE = "lifecycle"
+DRIVER = "driver"
+OPERATOR = "operator"
+SEGMENT = "segment"
+SCAN = "scan"
+EXCHANGE = "exchange"
+KERNEL = "kernel"
+HTTP = "http"
+
+DEFAULT_MAX_EVENTS = 1 << 16
+
+# operator add_input/get_output fire constantly (get_output polls return
+# None most slices); spans shorter than this are noise that would churn the
+# ring — they are dropped at the source, not recorded-then-evicted
+MIN_OPERATOR_SPAN_NS = 20_000
+
+_TRACE_SEQ = itertools.count(1)
+
+
+class TraceRecorder:
+    """Ring buffer of (category, name, t0_ns, dur_ns, tid, tname, args)."""
+
+    def __init__(self, query_id: str = "", max_events: int = 0):
+        self.query_id = query_id or f"trace-{next(_TRACE_SEQ)}"
+        self.max_events = max(int(max_events or DEFAULT_MAX_EVENTS), 16)
+        self._lock = threading.Lock()
+        self._events: List[tuple] = []
+        self._next = 0           # overwrite cursor once the ring is full
+        self.dropped = 0
+        self.t0_ns = time.perf_counter_ns()   # trace epoch (ts origin)
+
+    # ------------------------------------------------------------ recording
+
+    def record(self, cat: str, name: str, t0_ns: int, dur_ns: int,
+               args: Optional[dict] = None) -> None:
+        t = threading.current_thread()
+        evt = (cat, name, t0_ns, dur_ns, t.ident, t.name, args)
+        with self._lock:
+            if len(self._events) < self.max_events:
+                self._events.append(evt)
+            else:
+                self._events[self._next] = evt
+                self._next = (self._next + 1) % self.max_events
+                self.dropped += 1
+
+    def instant(self, cat: str, name: str,
+                args: Optional[dict] = None) -> None:
+        self.record(cat, name, time.perf_counter_ns(), 0, args)
+
+    def span(self, cat: str, name: str, **args) -> "_Span":
+        return _Span(self, cat, name, args or None)
+
+    # ------------------------------------------------------------- reading
+
+    def events(self) -> List[tuple]:
+        """Events in recording order (ring rotated so oldest comes first)."""
+        with self._lock:
+            return self._events[self._next:] + self._events[:self._next]
+
+    def count(self, cat: Optional[str] = None) -> int:
+        if cat is None:
+            with self._lock:
+                return len(self._events)
+        return sum(1 for e in self.events() if e[0] == cat)
+
+    # -------------------------------------------------------------- export
+
+    def to_chrome_trace(self) -> dict:
+        """The Chrome trace-event document (ph="X" complete events, ts/dur
+        in MICROseconds — the unit the format specifies)."""
+        pid = os.getpid()
+        spans = []
+        threads: Dict[int, str] = {}
+        for cat, name, t0, dur, tid, tname, args in self.events():
+            e = {"name": name, "cat": cat, "ph": "X",
+                 "ts": (t0 - self.t0_ns) / 1e3, "dur": dur / 1e3,
+                 "pid": pid, "tid": tid}
+            if args:
+                e["args"] = args
+            spans.append(e)
+            threads.setdefault(tid, tname)
+        meta = [{"name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+                 "args": {"name": f"presto-tpu {self.query_id}"}}]
+        meta += [{"name": "thread_name", "ph": "M", "pid": pid, "tid": t,
+                  "args": {"name": n}} for t, n in sorted(threads.items())]
+        return {"traceEvents": meta + spans, "displayTimeUnit": "ms",
+                "otherData": {"query_id": self.query_id,
+                              "dropped_events": self.dropped}}
+
+    def write(self, path: str) -> str:
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(self.to_chrome_trace(), f)
+        return path
+
+
+class _Span:
+    __slots__ = ("rec", "cat", "name", "args", "t0")
+
+    def __init__(self, rec: Optional[TraceRecorder], cat: str, name: str,
+                 args: Optional[dict]):
+        self.rec = rec
+        self.cat = cat
+        self.name = name
+        self.args = args
+
+    def __enter__(self):
+        self.t0 = time.perf_counter_ns()
+        return self
+
+    def __exit__(self, *exc):
+        if self.rec is not None:
+            self.rec.record(self.cat, self.name, self.t0,
+                            time.perf_counter_ns() - self.t0, self.args)
+        return False
+
+
+_NULL_SPAN = _Span(None, "", "", None)
+
+
+# ---------------------------------------------------------------------------
+# the installed recorder: one traced query at a time, process-wide — the
+# background machinery (scan readers, exchange pumps) has no query context,
+# so scoping is by installation window exactly like EXCHANGE_STATS
+# ---------------------------------------------------------------------------
+
+_ACTIVE: Optional[TraceRecorder] = None
+_ACTIVE_LOCK = threading.Lock()
+
+
+def active() -> Optional[TraceRecorder]:
+    return _ACTIVE
+
+
+def install(recorder: TraceRecorder) -> bool:
+    """Make `recorder` the process's active trace sink. False (and no-op)
+    when another query's recorder is already installed — concurrent traced
+    queries would interleave into one timeline, so the second one simply
+    runs untraced rather than corrupting the first's export."""
+    global _ACTIVE
+    with _ACTIVE_LOCK:
+        if _ACTIVE is not None:
+            return False
+        _ACTIVE = recorder
+        return True
+
+
+def uninstall(recorder: TraceRecorder) -> None:
+    global _ACTIVE
+    with _ACTIVE_LOCK:
+        if _ACTIVE is recorder:
+            _ACTIVE = None
+
+
+def record(cat: str, name: str, t0_ns: int, dur_ns: int,
+           args: Optional[dict] = None) -> None:
+    """Hot-path append: one attribute load + None check when tracing is off."""
+    r = _ACTIVE
+    if r is not None:
+        r.record(cat, name, t0_ns, dur_ns, args)
+
+
+def instant(cat: str, name: str, args: Optional[dict] = None) -> None:
+    r = _ACTIVE
+    if r is not None:
+        r.instant(cat, name, args)
+
+
+def span(cat: str, name: str, **args) -> _Span:
+    r = _ACTIVE
+    if r is None:
+        return _NULL_SPAN
+    return _Span(r, cat, name, args or None)
+
+
+# ---------------------------------------------------------------------------
+# session wiring (runner entry points call these two)
+# ---------------------------------------------------------------------------
+
+def maybe_recorder(session, query_id: str = "") -> Optional[TraceRecorder]:
+    """A TraceRecorder when the session's `query_trace` knob is on."""
+    if not session.get("query_trace"):
+        return None
+    return TraceRecorder(query_id,
+                         int(session.get("query_trace_max_events") or 0))
+
+
+def export(recorder: TraceRecorder, session) -> str:
+    """Write the Chrome trace JSON under `query_trace_dir` (tempdir default)
+    and return the path (what QueryResult.trace_path carries)."""
+    import tempfile
+
+    directory = str(session.get("query_trace_dir") or "") or \
+        tempfile.gettempdir()
+    os.makedirs(directory, exist_ok=True)
+    path = os.path.join(
+        directory, f"presto-trace-{os.getpid()}-{recorder.query_id}.json")
+    return recorder.write(path)
+
+
+# ---------------------------------------------------------------------------
+# analysis helpers (bench rungs + tests read exported documents)
+# ---------------------------------------------------------------------------
+
+def _merged_intervals(doc: dict, cat: str) -> List[tuple]:
+    ivals = sorted((e["ts"], e["ts"] + e.get("dur", 0))
+                   for e in doc.get("traceEvents", [])
+                   if e.get("ph") == "X" and e.get("cat") == cat)
+    merged: List[list] = []
+    for lo, hi in ivals:
+        if merged and lo <= merged[-1][1]:
+            merged[-1][1] = max(merged[-1][1], hi)
+        else:
+            merged.append([lo, hi])
+    return [tuple(m) for m in merged]
+
+
+def overlap_ratio(doc: dict, cat_a: str, cat_b: str) -> float:
+    """Fraction of `cat_a` span time that overlaps some `cat_b` span —
+    the proof-of-overlap number (e.g. exchange dispatches vs driver compute)
+    the GPU-Presto paper argues accelerator engines must report."""
+    a = _merged_intervals(doc, cat_a)
+    b = _merged_intervals(doc, cat_b)
+    total = sum(hi - lo for lo, hi in a)
+    if total <= 0:
+        return 0.0
+    inter = 0.0
+    bi = 0
+    for lo, hi in a:
+        while bi < len(b) and b[bi][1] <= lo:
+            bi += 1
+        j = bi
+        while j < len(b) and b[j][0] < hi:
+            inter += max(0.0, min(hi, b[j][1]) - max(lo, b[j][0]))
+            j += 1
+    return inter / total
+
+
+def span_categories(doc: dict) -> Dict[str, int]:
+    """{category: span count} of an exported document (schema validation)."""
+    out: Dict[str, int] = {}
+    for e in doc.get("traceEvents", []):
+        if e.get("ph") == "X":
+            out[e.get("cat", "")] = out.get(e.get("cat", ""), 0) + 1
+    return out

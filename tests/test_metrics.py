@@ -23,10 +23,55 @@ def test_registry_counters_and_gauges():
     assert set(only_a) == {"a.x", "a.g"}
 
 
-def test_gauge_error_is_null_not_crash():
+def test_gauge_error_is_null_counted_and_logged_once(capsys):
     r = MetricsRegistry()
     r.set_gauge("bad", lambda: 1 / 0)
-    assert r.snapshot()["bad"] is None
+    snap = r.snapshot()
+    assert snap["bad"] is None
+    # the failure is COUNTED (metrics.gauge_errors) instead of vanishing...
+    assert r.counter_value("metrics.gauge_errors") == 1
+    r.snapshot()
+    r.snapshot()
+    assert r.counter_value("metrics.gauge_errors") == 3
+    # ...and the first failure per gauge lands on stderr, later ones do not
+    err = capsys.readouterr().err
+    assert err.count("gauge 'bad' failed") == 1
+    assert "ZeroDivisionError" in err
+
+
+def test_histogram_percentile_math():
+    from presto_tpu.utils.metrics import Histogram
+
+    h = Histogram()
+    assert h.percentile(0.5) == 0.0  # empty
+    # 100 observations at 1ms, 10 at 100ms: log2 buckets bound each value v
+    # by b with v <= b < 2v
+    for _ in range(100):
+        h.add(0.001)
+    for _ in range(10):
+        h.add(0.1)
+    p50, p95, p99 = h.percentile(0.5), h.percentile(0.95), h.percentile(0.99)
+    assert 0.001 <= p50 < 0.002, p50
+    assert 0.1 <= p95 < 0.2, p95
+    assert 0.1 <= p99 < 0.2, p99
+    assert h.n == 110 and abs(h.total - 1.1) < 1e-9
+    # monotone across quantiles
+    qs = [h.percentile(q / 100) for q in range(1, 101)]
+    assert qs == sorted(qs)
+
+
+def test_registry_histogram_snapshot_keys():
+    r = MetricsRegistry()
+    for v in (0.002, 0.002, 0.002, 0.5):
+        r.histogram("query.wall_s", v)
+    snap = r.snapshot("query.")
+    assert snap["query.wall_s.count"] == 4
+    assert 0.002 <= snap["query.wall_s.p50"] < 0.004
+    assert 0.5 <= snap["query.wall_s.p99"] < 1.0
+    assert r.histogram_summary("query.wall_s")["count"] == 4
+    assert r.histogram_summary("nope") == {}
+    r.reset()
+    assert r.histogram_summary("query.wall_s") == {}
 
 
 def test_query_lifecycle_counters_and_endpoint():

@@ -22,7 +22,7 @@ from tools.prestocheck import (all_pass_ids, load_baseline, run,  # noqa: E402
 EXPECTED_PASSES = {"undefined-name", "tracer-safety", "lock-discipline",
                    "exception-hygiene", "retry-discipline",
                    "mutable-default-args", "sleep-poll", "host-sync",
-                   "unbounded-cache"}
+                   "unbounded-cache", "wallclock-duration"}
 
 
 def _scan(tmp_path, source, select=None, name="mod.py"):
@@ -685,6 +685,60 @@ def test_unbounded_cache_suppression_honored(tmp_path):
             _REGISTRY[cls.__name__] = cls  # prestocheck: ignore[unbounded-cache] - one per class
             return cls
         """, select=["unbounded-cache"])
+    assert findings == [], _messages(findings)
+
+
+# -------------------------------------------------------- wallclock-duration
+
+def test_wallclock_duration_flags_time_time_deltas(tmp_path):
+    findings = _scan(tmp_path, """
+        import time
+
+        def measure(work):
+            t0 = time.time()
+            work()
+            return time.time() - t0          # the classic duration idiom
+
+        def elapsed(info):
+            end = info.end or time.time()
+            return (info.end or time.time()) - info.create
+
+        def accumulate(stats, t0):
+            stats.stall -= 1
+            stats.stall += time.time() - t0
+        """, select=["wallclock-duration"])
+    assert len(findings) == 3, _messages(findings)
+    assert {f.line for f in findings} == {7, 11, 15}
+
+
+def test_wallclock_duration_clean_uses_not_flagged(tmp_path):
+    findings = _scan(tmp_path, """
+        import time
+
+        def good(work):
+            t0 = time.perf_counter()
+            work()
+            return time.perf_counter() - t0   # monotonic interval: fine
+
+        def uptime(start_mono):
+            return time.monotonic() - start_mono
+
+        def timestamp():
+            created = time.time()             # plain timestamp: fine
+            deadline = time.time() + 30.0     # deadline addition: fine
+            return created, deadline
+        """, select=["wallclock-duration"])
+    assert findings == [], _messages(findings)
+
+
+def test_wallclock_duration_suppression(tmp_path):
+    findings = _scan(tmp_path, """
+        import time
+
+        def purge_cutoff(grace_s):
+            # epoch cutoff vs persisted wall timestamps: wall on purpose
+            return time.time() - grace_s  # prestocheck: ignore[wallclock-duration]
+        """, select=["wallclock-duration"])
     assert findings == [], _messages(findings)
 
 

@@ -30,10 +30,10 @@ def main():
         runner = LocalQueryRunner(
             session=Session(catalog="tpch", schema=schema))
         for qid in qids:
-            t0 = time.time()
+            t0 = time.perf_counter()
             try:
                 out = runner.execute(QUERIES[qid])
-                print(f"{schema} q{qid}: {time.time() - t0:.1f}s, "
+                print(f"{schema} q{qid}: {time.perf_counter() - t0:.1f}s, "
                       f"{len(out.rows)} rows", flush=True)
             except Exception as e:  # noqa: BLE001 - warm what we can
                 print(f"{schema} q{qid}: FAILED {e!r}", file=sys.stderr,

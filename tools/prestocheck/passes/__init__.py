@@ -8,3 +8,4 @@ from . import sleep_poll  # noqa: F401
 from . import mutable_defaults  # noqa: F401
 from . import host_sync  # noqa: F401
 from . import unbounded_cache  # noqa: F401
+from . import wallclock_duration  # noqa: F401

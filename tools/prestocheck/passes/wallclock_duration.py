@@ -1,0 +1,60 @@
+"""wallclock-duration: ``time.time()`` subtraction used as a duration.
+
+The observability PR swept the engine's duration math onto the monotonic
+clocks (``time.perf_counter`` / ``time.monotonic``): ``time.time()`` is the
+WALL clock, and NTP steps/slews make its deltas jump — a latency histogram,
+a bench wall, or an uptime computed from it silently lies. This pass keeps
+the pattern from reappearing.
+
+Detection: any subtraction (``a - b``, ``a -= b``) where a ``time.time()``
+call appears inside either operand — the canonical idioms are
+``time.time() - t0``, ``(end or time.time()) - start`` and
+``cutoff = time.time() - grace``. The heuristic is call-site-local on
+purpose: ``t1 = time.time(); dt = t1 - t0`` two statements later is not
+caught, but that spelling does not occur in this tree and a name-flow
+analysis would chase false positives across modules.
+
+Legitimate wall-clock arithmetic (a cutoff compared against PERSISTED epoch
+timestamps, e.g. the raptor shard purger) carries a justified
+``# prestocheck: ignore[wallclock-duration]``. Plain timestamp uses —
+``created = time.time()``, ``deadline = time.time() + n`` — never subtract
+and are not flagged.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..core import Finding, Module, Pass, dotted_name, register
+
+
+def _contains_time_time(node: ast.AST) -> bool:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call) and \
+                dotted_name(sub.func) == "time.time":
+            return True
+    return False
+
+
+@register
+class WallclockDurationPass(Pass):
+    id = "wallclock-duration"
+    description = ("time.time() subtraction used as a duration — wall-clock "
+                   "deltas jump under NTP; use time.perf_counter() or "
+                   "time.monotonic()")
+
+    def check_module(self, module: Module):
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Sub):
+                operands = (node.left, node.right)
+            elif isinstance(node, ast.AugAssign) and \
+                    isinstance(node.op, ast.Sub):
+                operands = (node.value,)
+            else:
+                continue
+            if not any(_contains_time_time(op) for op in operands):
+                continue
+            yield Finding(
+                module.path, node.lineno, node.col_offset, self.id,
+                "time.time() in a subtraction measures a duration on the "
+                "wall clock — use time.perf_counter() (intervals) or "
+                "time.monotonic() (uptime/deadlines)")

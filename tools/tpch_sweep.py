@@ -44,7 +44,7 @@ def main():
     qs = [int(x) for x in args.queries.split(",") if x] or sorted(QUERIES)
     npass = 0
     for q in qs:
-        t0 = time.time()
+        t0 = time.perf_counter()
         try:
             res = runner.execute(QUERIES[q])
             exp = oracle.query(to_sqlite(QUERIES[q]))
@@ -55,10 +55,10 @@ def main():
             assert_rows_equal([norm(r) for r in res.rows], exp, ordered=True,
                               rel_tol=1e-6)
             npass += 1
-            print(f"Q{q:02d} PASS  {time.time()-t0:6.2f}s  {len(res.rows)} rows")
+            print(f"Q{q:02d} PASS  {time.perf_counter()-t0:6.2f}s  {len(res.rows)} rows")
         except Exception as e:
             msg = traceback.format_exception_only(type(e), e)[-1].strip()
-            print(f"Q{q:02d} FAIL  {time.time()-t0:6.2f}s  {msg[:160]}")
+            print(f"Q{q:02d} FAIL  {time.perf_counter()-t0:6.2f}s  {msg[:160]}")
     print(f"\n{npass}/{len(qs)} passed")
     return 0 if npass == len(qs) else 1
 
