@@ -556,9 +556,22 @@ def main():
     args = ap.parse_args()
     sf = 1.0 if args.quick else args.sf
 
-    if args.platform:
-        import os
+    # never benchmark instrumented locks: a stray PRESTO_TPU_LOCKSAN from a
+    # sanitizer run would silently tax every lock acquisition in the numbers.
+    # Strip the env (subprocess rungs inherit it), uninstall if the import
+    # hook already fired, and RECORD the off state in the result blob.
+    if os.environ.pop("PRESTO_TPU_LOCKSAN", None):
+        print("bench: PRESTO_TPU_LOCKSAN was set — sanitizer disabled for "
+              "benchmarking (instrumented locks would skew every number)",
+              file=sys.stderr)
+        try:
+            from presto_tpu.utils import locksan
+            locksan.uninstall()
+        except Exception:  # noqa: BLE001 - presto_tpu not imported yet: env strip suffices
+            pass
+    DETAIL["locksan"] = False
 
+    if args.platform:
         os.environ["JAX_PLATFORMS"] = args.platform
         import jax
 
@@ -678,6 +691,9 @@ def main():
                     "live_cpu_fallback": live,
                 },
             }
+    # stamp AFTER the TPU-record fallback merge: whatever detail dict wins,
+    # the emitted record must say the numbers came from uninstrumented locks
+    result["detail"]["locksan"] = False
     print(json.dumps(result))
 
 

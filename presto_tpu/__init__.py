@@ -50,6 +50,14 @@ if not _os.environ.get("PRESTO_TPU_NO_COMPILE_CACHE"):
     except Exception:  # unwritable home: run without the cache
         pass
 
+# Runtime lock sanitizer: PRESTO_TPU_LOCKSAN=1 swaps threading.Lock/RLock/
+# Condition for instrumented wrappers (acquisition-order graph, deadlock +
+# wait-while-held findings, locksan.* hold/wait histograms). Installed
+# BEFORE any engine module allocates a lock so the whole tree is covered.
+from .utils import locksan as _locksan  # noqa: E402
+
+_locksan.install_from_env()
+
 # CPU-backend compiles are serialized process-wide: concurrent LLVM codegen
 # from executor threads intermittently segfaults (see utils/compile_lock.py)
 from .utils import compile_lock as _compile_lock  # noqa: E402

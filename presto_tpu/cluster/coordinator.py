@@ -367,9 +367,15 @@ class ClusterQueryRunner:
             finally:
                 done.set()
 
-        threading.Thread(target=pull, name="result-pull", daemon=True).start()
+        puller = threading.Thread(target=pull, name="result-pull",
+                                  daemon=True)
+        puller.start()
         while not done.wait(timeout=0.5):
             scheduler.check_failures(active_nodes=self.nodes.active_nodes())
+        # `done` is set in pull()'s finally, so the thread is exiting: the
+        # bounded join keeps it from outliving the query (and from racing a
+        # teardown of `rows`/`error`, which it captured by closure)
+        puller.join(timeout=5.0)
         if error:
             # surface the task/node failure that CAUSED the stream error if
             # there is one — it names the node, which retry placement and

@@ -310,6 +310,11 @@ class SqlTask:
         # consumer detect that (reference: PRESTO_TASK_INSTANCE_ID header)
         self.instance_id = uuid.uuid4().hex
         self.state = PLANNED
+        # guards `state`: _run (the task thread) and cancel (an HTTP handler
+        # thread) both transition it; unguarded, a cancel landing between
+        # _run's cancelled-check and its final assignment could resurrect a
+        # CANCELED task as FINISHED (found by prestocheck shared-state-race)
+        self._state_lock = threading.Lock()
         self.error: Optional[dict] = None
         self.created = time.time()
         self.cancelled = threading.Event()
@@ -350,9 +355,18 @@ class SqlTask:
 
     # ------------------------------------------------------------ lifecycle
 
+    def _transition(self, state: str) -> bool:
+        """Move to `state` unless already terminal (a cancel/abort that beat
+        this transition wins — it already poisoned the output buffer)."""
+        with self._state_lock:
+            if self.state in DONE_STATES:
+                return False
+            self.state = state
+            return True
+
     def _run(self) -> None:
         try:
-            self.state = RUNNING
+            self._transition(RUNNING)
             faults.fire("worker.task_run", task_id=self.task_id,
                         query_id=self.request.query_id)
             drivers = self._plan_drivers()
@@ -365,11 +379,12 @@ class SqlTask:
                 # fragment produced no sink operator (shouldn't happen) —
                 # still close the buffer so consumers terminate
                 self.output.set_no_more_pages()
-            self.state = FINISHED if not self.cancelled.is_set() else CANCELED
+            self._transition(FINISHED if not self.cancelled.is_set()
+                             else CANCELED)
         except Exception as e:  # noqa: BLE001 — reported via TaskInfo
             self.error = {"message": str(e), "type": type(e).__name__,
                           "stack": traceback.format_exc()[-2000:]}
-            self.state = FAILED
+            self._transition(FAILED)
             self.output.fail(str(e))
 
     def _plan_drivers(self):
@@ -465,8 +480,7 @@ class SqlTask:
 
     def cancel(self, abort: bool = False) -> None:
         self.cancelled.set()
-        if self.state not in DONE_STATES:
-            self.state = ABORTED if abort else CANCELED
+        self._transition(ABORTED if abort else CANCELED)
         if abort:
             # poison BEFORE freeing: an aborted stream must read as a
             # failure, never as a clean end-of-stream — consumers that saw

@@ -272,8 +272,10 @@ class WorkerServer:
             self._announcer = Announcer(coordinator_uri, self.node_id, self.uri)
 
     def start(self) -> "WorkerServer":
-        threading.Thread(target=self.httpd.serve_forever,
-                         name=f"worker-{self.port}", daemon=True).start()
+        self._serve_thread = threading.Thread(
+            target=self.httpd.serve_forever,
+            name=f"worker-{self.port}", daemon=True)
+        self._serve_thread.start()
         if self._announcer:
             self._announcer.start()
         return self
@@ -296,6 +298,9 @@ class WorkerServer:
             t.cancel(abort=True)
         self.httpd.shutdown()
         self.httpd.server_close()
+        serve = getattr(self, "_serve_thread", None)
+        if serve is not None:
+            serve.join(timeout=5.0)
 
 
 def main(argv=None) -> None:

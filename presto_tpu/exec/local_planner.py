@@ -453,7 +453,7 @@ class LocalExecutionPlanner:
                     entry["fused"] = False
                     entry["reason"] = "single-operator run"
                 decisions.append(entry)
-            self.pipelines[pi] = out
+            self.pipelines[pi] = out  # prestocheck: ignore[shared-state-race] - planner instance is per-task: built and read on the one thread planning that task, never shared
         return decisions
 
     @staticmethod

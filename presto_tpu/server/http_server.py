@@ -285,6 +285,8 @@ class PrestoTpuServer:
     def stop(self) -> None:
         self.httpd.shutdown()
         self.httpd.server_close()
+        # after the listener is down: no new submissions can race the join
+        self.manager.close()
 
 
 def main(argv=None) -> None:

@@ -88,6 +88,11 @@ class QueryManager:
         self._queries: Dict[str, QueryInfo] = {}
         self._ids = itertools.count(1)
         self._lock = threading.Lock()
+        # live execution threads by query id (removed by _run on exit):
+        # close() joins them so shutdown never abandons a query mid-write
+        # and tests never leak engine threads across cases
+        self._run_threads: Dict[str, threading.Thread] = {}
+        self._closed = False
         import inspect
 
         try:
@@ -116,7 +121,22 @@ class QueryManager:
                                   trace_token=trace_token))
         from ..utils.metrics import METRICS
         METRICS.count("query_manager.submitted")
-        threading.Thread(target=self._run, args=(info,), daemon=True).start()
+        # daemon (a wedged kernel must not block interpreter exit) but
+        # REGISTERED: close() joins every live one, bounded
+        t = threading.Thread(target=self._run, args=(info,),
+                             name=f"query-{qid}", daemon=True)
+        with self._lock:
+            if self._closed:
+                info.state = FAILED
+                info.error = {"message": "server is shutting down",
+                              "errorType": "ServerShuttingDown"}
+                info.end_time = time.time()
+                info.end_mono = time.monotonic()
+                return info
+            self._run_threads[qid] = t
+            # start INSIDE the lock: a concurrent close() must never snapshot
+            # (and try to join) a registered-but-unstarted thread
+            t.start()
         return info
 
     def _expire_locked(self) -> None:
@@ -145,6 +165,18 @@ class QueryManager:
 
     def list_queries(self) -> List[QueryInfo]:
         return list(self._queries.values())
+
+    def close(self, timeout_s: float = 10.0) -> None:
+        """Join every live query thread (bounded on the WHOLE close): new
+        submissions are refused, running queries get `timeout_s` to finish.
+        A thread still alive after the deadline is abandoned (daemon) rather
+        than hanging shutdown."""
+        with self._lock:
+            self._closed = True
+            live = list(self._run_threads.values())
+        deadline = time.monotonic() + timeout_s
+        for t in live:
+            t.join(timeout=max(0.0, deadline - time.monotonic()))
 
     def _scoped_runner(self, info: QueryInfo):
         """Shallow-copy the engine with the query's catalog/schema defaults
@@ -228,6 +260,8 @@ class QueryManager:
             from ..utils.metrics import METRICS
             METRICS.count("query_manager.failed")
         finally:
+            with self._lock:
+                self._run_threads.pop(info.query_id, None)
             if tx is not None:
                 self.transactions.abort(tx)
             if ticket is not None:

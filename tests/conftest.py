@@ -38,3 +38,19 @@ def eight_devices():
     devs = jax.devices()
     assert len(devs) >= 8, f"expected 8 virtual devices, got {devs}"
     return devs
+
+
+def pytest_sessionfinish(session, exitstatus):
+    """Tier-1 under PRESTO_TPU_LOCKSAN=1 is the dynamic concurrency gate:
+    the whole suite must produce ZERO runtime order-cycle /
+    wait-while-held findings. (test_locksan's own fixtures reset the
+    sanitizer around each deliberate-violation case, so anything left here
+    came from real engine code.)"""
+    if os.environ.get("PRESTO_TPU_LOCKSAN") not in ("1", "true", "on"):
+        return
+    from presto_tpu.utils import locksan
+
+    report = locksan.SANITIZER.report()
+    print("\n" + report)
+    if locksan.SANITIZER.findings():
+        session.exitstatus = 1

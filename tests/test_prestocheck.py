@@ -22,7 +22,8 @@ from tools.prestocheck import (all_pass_ids, load_baseline, run,  # noqa: E402
 EXPECTED_PASSES = {"undefined-name", "tracer-safety", "lock-discipline",
                    "exception-hygiene", "retry-discipline",
                    "mutable-default-args", "sleep-poll", "host-sync",
-                   "unbounded-cache", "wallclock-duration"}
+                   "unbounded-cache", "wallclock-duration",
+                   "shared-state-race", "thread-lifecycle"}
 
 
 def _scan(tmp_path, source, select=None, name="mod.py"):
@@ -688,6 +689,324 @@ def test_unbounded_cache_suppression_honored(tmp_path):
     assert findings == [], _messages(findings)
 
 
+# -------------------------------------------------------- shared-state-race
+
+def test_shared_state_race_thread_vs_main_unguarded(tmp_path):
+    findings = _scan(tmp_path, """
+        import threading
+
+        class Pump:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.total = 0        # __init__ write: construction, exempt
+
+            def start(self):
+                t = threading.Thread(target=self._loop, daemon=True)
+                t.start()
+                self._t = t
+
+            def _loop(self):
+                self.total += 1       # thread side, no lock
+
+            def bump(self):
+                self.total += 1       # main side, no lock -> race
+        """, select=["shared-state-race"])
+    msgs = _messages(findings)
+    assert len(msgs) == 1, msgs
+    assert "Pump.total" in msgs[0] and "no common lock" in msgs[0]
+
+
+def test_shared_state_race_common_lock_is_clean(tmp_path):
+    findings = _scan(tmp_path, """
+        import threading
+
+        class Pump:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.total = 0
+
+            def start(self):
+                threading.Thread(target=self._loop, daemon=True).start()  # prestocheck: ignore[thread-lifecycle]
+
+            def _loop(self):
+                with self._lock:
+                    self.total += 1
+
+            def bump(self):
+                with self._lock:
+                    self.total += 1
+        """, select=["shared-state-race"])
+    assert findings == [], _messages(findings)
+
+
+def test_shared_state_race_guarded_by_inference(tmp_path):
+    """All writes are thread-side (no main/thread pair exists), but two of
+    three hold the same lock: the third is flagged against the inferred
+    guard — the author knew the state was shared."""
+    findings = _scan(tmp_path, """
+        import threading
+
+        class Book:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.n = 0
+                self.ts = []
+
+            def start(self):
+                a = threading.Thread(target=self._loop, daemon=True)
+                b = threading.Thread(target=self._drain, daemon=True)
+                c = threading.Thread(target=self._tick, daemon=True)
+                for t in (a, b, c):
+                    t.start()
+                    self.ts.append(t)
+
+            def _loop(self):
+                with self._lock:
+                    self.n += 1
+
+            def _drain(self):
+                with self._lock:
+                    self.n = 0
+
+            def _tick(self):
+                self.n += 1       # outside the guard the others respect
+        """, select=["shared-state-race"])
+    msgs = _messages(findings)
+    assert len(msgs) == 1, msgs
+    assert "inferred guard" in msgs[0] and "Book._lock" in msgs[0]
+    assert "held at 2 of 3" in msgs[0]
+
+
+def test_shared_state_race_cross_module_thread_target(tmp_path):
+    """Thread target resolved across modules: wa spawns wb.work; wb's
+    global is written by the thread AND by a main-side setter, unguarded."""
+    (tmp_path / "wa.py").write_text(textwrap.dedent("""
+        import threading
+        from wb import work
+
+        def boot():
+            t = threading.Thread(target=work, daemon=True)
+            t.start()
+            return t
+        """))
+    (tmp_path / "wb.py").write_text(textwrap.dedent("""
+        TOTAL = 0
+
+        def work():
+            global TOTAL
+            TOTAL = TOTAL + 1
+
+        def set_total(v):
+            global TOTAL
+            TOTAL = v
+        """))
+    result = run([str(tmp_path)], select=["shared-state-race"],
+                 baseline_path=None)
+    msgs = _messages(result.new_findings)
+    assert len(msgs) == 1, msgs
+    assert "TOTAL" in msgs[0] and "no common lock" in msgs[0]
+    assert result.new_findings[0].file.endswith("wb.py")
+
+
+def test_shared_state_race_module_list_mutation_without_global(tmp_path):
+    """Mutation-method calls on a module-level container need no `global`
+    declaration — ITEMS.append from thread and main is still the race."""
+    findings = _scan(tmp_path, """
+        import threading
+
+        ITEMS = []
+
+        def work():
+            ITEMS.append(1)         # thread side
+
+        def flush(v):
+            ITEMS.append(v)         # main side, no lock -> race
+
+        def boot():
+            t = threading.Thread(target=work, daemon=True)
+            t.start()
+            return t
+        """, select=["shared-state-race"])
+    msgs = _messages(findings)
+    assert len(msgs) == 1, msgs
+    assert "ITEMS" in msgs[0] and "no common lock" in msgs[0]
+
+
+def test_shared_state_race_aliased_import_target_resolved(tmp_path):
+    """`from wc import work as pump` must resolve to wc.work — the alias is
+    local, the function's identity is not."""
+    (tmp_path / "wal.py").write_text(textwrap.dedent("""
+        import threading
+        from wc import work as pump
+
+        def boot():
+            t = threading.Thread(target=pump, daemon=True)
+            t.start()
+            return t
+        """))
+    (tmp_path / "wc.py").write_text(textwrap.dedent("""
+        TOTAL = 0
+
+        def work():
+            global TOTAL
+            TOTAL = TOTAL + 1
+
+        def set_total(v):
+            global TOTAL
+            TOTAL = v
+        """))
+    result = run([str(tmp_path)], select=["shared-state-race"],
+                 baseline_path=None)
+    msgs = _messages(result.new_findings)
+    assert len(msgs) == 1 and "TOTAL" in msgs[0], msgs
+
+
+def test_shared_state_race_annotation_only_is_not_a_write(tmp_path):
+    findings = _scan(tmp_path, """
+        import threading
+        from typing import Optional
+
+        class Box:
+            def start(self):
+                self._t = threading.Thread(target=self._loop, daemon=True)
+                self._t.start()
+
+            def _loop(self):
+                self.buf: Optional[list] = None  # real write: counted
+                self.tag: str                    # annotation only: not one
+
+            def untag(self):
+                self.tag: str                    # would pair with _loop's
+        """, select=["shared-state-race"])
+    assert findings == [], _messages(findings)
+
+
+def test_shared_state_race_suppression(tmp_path):
+    findings = _scan(tmp_path, """
+        import threading
+
+        class Flag:
+            def start(self):
+                self._t = threading.Thread(target=self._loop, daemon=True)
+                self._t.start()
+
+            def _loop(self):
+                self.done = True  # prestocheck: ignore[shared-state-race] - monotonic one-way flag
+
+            def reset(self):
+                self.done = False  # prestocheck: ignore[shared-state-race] - test-only reset
+        """, select=["shared-state-race"])
+    assert findings == [], _messages(findings)
+
+
+# --------------------------------------------------------- thread-lifecycle
+
+def test_thread_lifecycle_fire_and_forget(tmp_path):
+    findings = _scan(tmp_path, """
+        import threading
+
+        def handle(req):
+            threading.Thread(target=req.run, daemon=True).start()
+        """, select=["thread-lifecycle"])
+    msgs = _messages(findings)
+    assert len(msgs) == 1, msgs
+    assert "without retaining a reference" in msgs[0]
+
+
+def test_thread_lifecycle_non_daemon_never_joined(tmp_path):
+    findings = _scan(tmp_path, """
+        import threading
+
+        class Server:
+            def start(self):
+                self._t = threading.Thread(target=self._loop)
+                self._t.start()
+
+            def _loop(self):
+                pass
+        """, select=["thread-lifecycle"])
+    msgs = _messages(findings)
+    assert len(msgs) == 1, msgs
+    assert "never joined" in msgs[0]
+
+
+def test_thread_lifecycle_joined_in_close_is_clean(tmp_path):
+    findings = _scan(tmp_path, """
+        import threading
+
+        class Server:
+            def start(self):
+                self._t = threading.Thread(target=self._loop)
+                self._t.start()
+
+            def close(self):
+                self._t.join(timeout=5.0)
+
+            def _loop(self):
+                pass
+        """, select=["thread-lifecycle"])
+    assert findings == [], _messages(findings)
+
+
+def test_thread_lifecycle_join_on_one_thread_does_not_clear_another(tmp_path):
+    """A .join() on an unrelated thread must not suppress the finding for
+    a second non-daemon thread that is never joined."""
+    findings = _scan(tmp_path, """
+        import threading
+
+        class Server:
+            def start(self):
+                self._serve = threading.Thread(target=self._loop)
+                self._serve.start()
+                self._pump = threading.Thread(target=self._loop)
+                self._pump.start()
+
+            def stop(self):
+                self._serve.join(timeout=5.0)   # _pump is never joined
+
+            def _loop(self):
+                pass
+        """, select=["thread-lifecycle"])
+    msgs = _messages(findings)
+    assert len(msgs) == 1, msgs
+    assert findings[0].line == 8  # the _pump creation
+
+
+def test_thread_lifecycle_daemon_file_writer(tmp_path):
+    findings = _scan(tmp_path, """
+        import threading
+
+        def writer():
+            with open("out.json", "w") as f:
+                f.write("{}")
+
+        def boot():
+            t = threading.Thread(target=writer, daemon=True)
+            t.start()
+            return t
+        """, select=["thread-lifecycle"])
+    msgs = _messages(findings)
+    assert len(msgs) == 1, msgs
+    assert "mutates files" in msgs[0] and "`writer`" in msgs[0]
+
+
+def test_thread_lifecycle_daemon_reader_is_clean_and_suppression(tmp_path):
+    findings = _scan(tmp_path, """
+        import threading
+
+        def reader():
+            with open("in.json") as f:
+                return f.read()
+
+        def boot(req):
+            t = threading.Thread(target=reader, daemon=True)
+            t.start()
+            threading.Thread(target=req.run, daemon=True).start()  # prestocheck: ignore[thread-lifecycle] - request-scoped, bounded by the pool
+            return t
+        """, select=["thread-lifecycle"])
+    assert findings == [], _messages(findings)
+
+
 # -------------------------------------------------------- wallclock-duration
 
 def test_wallclock_duration_flags_time_time_deltas(tmp_path):
@@ -809,6 +1128,80 @@ def test_cli_list_passes_json_and_exit_codes(tmp_path):
         capture_output=True, text=True, cwd=str(tmp_path), env=env)
     assert from_elsewhere.returncode == 0, from_elsewhere.stderr
     assert "0 files" not in from_elsewhere.stderr
+
+
+def test_module_cache_shared_across_select_invocations(tmp_path):
+    """load_modules parses once per (path, mtime, size): a second run —
+    e.g. another --select over the same tree — reuses the Module object;
+    an edit invalidates it."""
+    from tools.prestocheck.core import load_modules
+
+    mod = tmp_path / "cached.py"
+    mod.write_text("X = 1\n")
+    first = load_modules([str(mod)])
+    second = load_modules([str(mod)])
+    assert first[0] is second[0]
+
+    os.utime(str(mod), ns=(1, 1))  # force a different mtime signature
+    third = load_modules([str(mod)])
+    assert third[0] is not first[0]
+
+
+def test_run_reports_per_pass_wall_times(tmp_path):
+    mod = tmp_path / "m.py"
+    mod.write_text("def f(xs=[]):\n    return xs\n")
+    result = run([str(mod)], select=["mutable-default-args"],
+                 baseline_path=None)
+    assert "parse" in result.pass_wall_s
+    assert "mutable-default-args" in result.pass_wall_s
+    assert all(v >= 0 for v in result.pass_wall_s.values())
+
+
+def test_git_changed_files_lists_dirty_and_untracked(tmp_path):
+    from tools.prestocheck.core import git_changed_files
+
+    repo = tmp_path / "r"
+    repo.mkdir()
+    sp = lambda *args: subprocess.run(  # noqa: E731
+        ["git", "-C", str(repo)] + list(args), check=True,
+        capture_output=True)
+    sp("init", "-q")
+    sp("config", "user.email", "t@example.com")
+    sp("config", "user.name", "t")
+    (repo / "clean.py").write_text("A = 1\n")
+    (repo / "stale.py").write_text("B = 1\n")
+    sp("add", ".")
+    sp("commit", "-qm", "init")
+    (repo / "clean.py").write_text("A = 2\n")      # modified vs HEAD
+    (repo / "fresh.py").write_text("C = 1\n")      # untracked
+    names = {os.path.basename(p)
+             for p in git_changed_files(str(repo))}
+    assert names == {"clean.py", "fresh.py"}
+
+
+def test_cli_changed_only_scopes_to_git_diff(tmp_path):
+    """--changed-only with the real repo: the scan set is the dirty files
+    (a strict subset of the tree), and a path covering none of them scans
+    nothing and still exits 0."""
+    env = dict(os.environ, PYTHONPATH=REPO)
+    out = subprocess.run(
+        [sys.executable, "-m", "tools.prestocheck", "--changed-only",
+         "--json", "--select", "mutable-default-args",
+         os.path.join(REPO, "presto_tpu"), os.path.join(REPO, "tools")],
+        capture_output=True, text=True, cwd=REPO, env=env)
+    assert out.returncode in (0, 1), out.stderr
+    doc = json.loads(out.stdout)
+    assert "pass_wall_s" in doc
+
+    # scoping: a path that excludes every changed file scans nothing
+    empty_dir = tmp_path / "empty"
+    empty_dir.mkdir()
+    none = subprocess.run(
+        [sys.executable, "-m", "tools.prestocheck", "--changed-only",
+         str(empty_dir)],
+        capture_output=True, text=True, cwd=REPO, env=env)
+    assert none.returncode == 0
+    assert "no changed .py files" in none.stderr
 
 
 def test_cli_update_baseline_roundtrip(tmp_path):

@@ -31,6 +31,8 @@ class RunResult:
     findings: List[Finding] = field(default_factory=list)       # all kept
     new_findings: List[Finding] = field(default_factory=list)   # fail the run
     baselined: List[Finding] = field(default_factory=list)
+    # per-pass wall seconds ("parse" = module load incl. cache hits)
+    pass_wall_s: Dict[str, float] = field(default_factory=dict)
 
     @property
     def exit_code(self) -> int:
@@ -43,11 +45,18 @@ def run(paths: Sequence[str],
     """Run the selected passes (default: all) over `paths`.
 
     ``baseline_path=None`` disables baselining (every finding is "new")."""
+    import time as _time
+
+    timings: Dict[str, float] = {}
+    t0 = _time.perf_counter()
     modules = load_modules(paths)
+    timings["parse"] = _time.perf_counter() - t0
     passes = make_passes(select)
-    findings = run_passes(modules, passes)
+    findings = run_passes(modules, passes, timings=timings)
     baseline: Dict[str, int] = (load_baseline(baseline_path)
                                 if baseline_path else {})
     new, old = split_new(findings, baseline)
     return RunResult(n_files=len(modules), findings=findings,
-                     new_findings=new, baselined=old)
+                     new_findings=new, baselined=old,
+                     pass_wall_s={k: round(v, 6)
+                                  for k, v in timings.items()})

@@ -12,8 +12,8 @@ import sys
 from typing import List, Optional
 
 from . import DEFAULT_BASELINE, all_pass_ids, run
-from .core import (REPO_ROOT, load_modules, make_passes, run_passes,
-                   save_baseline)
+from .core import (REPO_ROOT, git_changed_files, load_modules, make_passes,
+                   run_passes, save_baseline)
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -36,6 +36,13 @@ def main(argv: Optional[List[str]] = None) -> int:
                         help="rewrite the baseline from this run's findings")
     parser.add_argument("--show-baselined", action="store_true",
                         help="also print grandfathered findings")
+    parser.add_argument("--changed-only", action="store_true",
+                        help="scan only files changed vs git HEAD "
+                             "(+ untracked) under the given paths — the "
+                             "pre-commit fast path. Note: cross-module "
+                             "passes (lock-order, shared-state-race) see "
+                             "only the changed files; the tier-1 gate "
+                             "still runs the full tree")
     args = parser.parse_args(argv)
 
     if args.list_passes:
@@ -58,6 +65,31 @@ def main(argv: Optional[List[str]] = None) -> int:
         print(f"prestocheck: no such path(s): {', '.join(missing)}",
               file=sys.stderr)
         return 2
+    if args.changed_only and args.update_baseline:
+        # the update would rewrite the baseline from only the changed files,
+        # silently dropping every unchanged file's grandfathered entries
+        print("prestocheck: --changed-only cannot be combined with "
+              "--update-baseline (a partial scan would discard baseline "
+              "entries for unchanged files)", file=sys.stderr)
+        return 2
+    if args.changed_only:
+        try:
+            changed = git_changed_files()
+        except Exception as e:  # noqa: BLE001 - fail loud, not open
+            print(f"prestocheck: --changed-only needs git: {e}",
+                  file=sys.stderr)
+            return 2
+        roots = [os.path.abspath(p) for p in paths]
+        paths = [f for f in changed
+                 if f.endswith(".py") and os.path.exists(f)
+                 and any(f == r or f.startswith(r + os.sep) for r in roots)]
+        if not paths:
+            print("prestocheck: no changed .py files under the given paths",
+                  file=sys.stderr)
+            if args.as_json:
+                print(json.dumps({"files": 0, "new": [], "baselined": [],
+                                  "pass_wall_s": {}}, indent=1))
+            return 0
     select = ([s.strip() for s in args.select.split(",") if s.strip()]
               if args.select else None)
     try:
@@ -96,6 +128,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             "files": result.n_files,
             "new": [f.to_json() for f in result.new_findings],
             "baselined": [f.to_json() for f in result.baselined],
+            "pass_wall_s": result.pass_wall_s,
         }, indent=1))
     else:
         for f in result.new_findings:

@@ -195,19 +195,56 @@ def iter_py_files(paths: Sequence[str]) -> Iterable[str]:
                     yield os.path.join(dirpath, name)
 
 
+# parse cache shared across run()/--select invocations in one process (the
+# tier-1 gate and the test suite call run() once per pass selection; each
+# parse + tokenize of the ~140-module tree dominated those runs). Keyed by
+# (mtime_ns, size) so an edited file re-parses; derived per-pass state
+# cached ON the Module object rides along for free.
+_MODULE_CACHE: Dict[str, Tuple[Tuple[int, int], Module]] = {}
+_MODULE_CACHE_MAX = 4096
+
+
 def load_modules(paths: Sequence[str]) -> List[Module]:
     modules = []
     for path in iter_py_files(paths):
+        apath = os.path.abspath(path)
+        try:
+            st = os.stat(apath)
+            sig = (st.st_mtime_ns, st.st_size)
+        except OSError:
+            sig = None
+        cached = _MODULE_CACHE.get(apath)
+        if cached is not None and sig is not None and cached[0] == sig:
+            modules.append(cached[1])
+            continue
         with open(path, "rb") as f:
-            modules.append(Module(path, f.read()))
+            module = Module(path, f.read())
+        if sig is not None:
+            if len(_MODULE_CACHE) >= _MODULE_CACHE_MAX:
+                _MODULE_CACHE.clear()
+            _MODULE_CACHE[apath] = (sig, module)
+        modules.append(module)
     return modules
 
 
 def run_passes(modules: Sequence[Module],
-               passes: Sequence[Pass]) -> List[Finding]:
-    """All non-suppressed findings (baseline NOT applied here)."""
+               passes: Sequence[Pass],
+               timings: Optional[Dict[str, float]] = None) -> List[Finding]:
+    """All non-suppressed findings (baseline NOT applied here). When given,
+    `timings` is filled with per-pass wall seconds (check + finish)."""
+    import time as _time
+
     by_path = {m.path: m for m in modules}
     findings: List[Finding] = []
+
+    def _timed(p: Pass, fn) -> List[Finding]:
+        t0 = _time.perf_counter()
+        out = list(fn())
+        if timings is not None:
+            timings[p.id] = timings.get(p.id, 0.0) + \
+                (_time.perf_counter() - t0)
+        return out
+
     for module in modules:
         if module.syntax_error is not None:
             e = module.syntax_error
@@ -215,9 +252,9 @@ def run_passes(modules: Sequence[Module],
                                     f"syntax error: {e.msg}"))
             continue
         for p in passes:
-            findings.extend(p.check_module(module))
+            findings.extend(_timed(p, lambda: p.check_module(module)))
     for p in passes:
-        findings.extend(p.finish(modules))
+        findings.extend(_timed(p, lambda: p.finish(modules)))
     kept = []
     for f in sorted(set(findings),
                     key=lambda f: (f.file, f.line, f.col, f.pass_id)):
@@ -226,6 +263,24 @@ def run_passes(modules: Sequence[Module],
             continue
         kept.append(f)
     return kept
+
+
+def git_changed_files(root: str = REPO_ROOT) -> List[str]:
+    """Absolute paths of files changed vs HEAD (staged + unstaged) plus
+    untracked files — the --changed-only scan set for pre-commit use."""
+    import subprocess
+
+    paths: set = set()
+    for args in (["diff", "--name-only", "HEAD", "--"],
+                 ["ls-files", "--others", "--exclude-standard"]):
+        proc = subprocess.run(["git", "-C", root] + args,
+                              capture_output=True, text=True, timeout=30)
+        if proc.returncode != 0:
+            raise OSError(f"git {' '.join(args[:2])} failed: "
+                          f"{proc.stderr.strip() or proc.returncode}")
+        paths.update(line.strip() for line in proc.stdout.splitlines()
+                     if line.strip())
+    return sorted(os.path.join(root, p) for p in paths)
 
 
 # ------------------------------------------------------------------ baseline

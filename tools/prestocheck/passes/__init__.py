@@ -9,3 +9,5 @@ from . import mutable_defaults  # noqa: F401
 from . import host_sync  # noqa: F401
 from . import unbounded_cache  # noqa: F401
 from . import wallclock_duration  # noqa: F401
+from . import shared_state_race  # noqa: F401
+from . import thread_lifecycle  # noqa: F401

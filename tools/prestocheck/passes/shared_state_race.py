@@ -1,0 +1,618 @@
+"""shared-state-race: interprocedural races on state shared with threads.
+
+The engine's ~35 lock-holding / thread-spawning modules go from
+one-query-at-a-time to contended-by-millions once the multi-tenant pools
+land (ROADMAP: shared scan-reader pool, shared exchange pump pool). This
+pass proves the substrate's write discipline statically, TSan-style:
+
+1. **Thread-entry roots**: every ``threading.Thread(target=...)`` (bound
+   methods, bare/nested functions, imported functions, lambdas),
+   ``<executor>.submit(fn, ...)`` and ``threading.Timer(t, fn)`` callback.
+2. **Thread-reachable set**: functions reachable from any root through the
+   global call graph — module-local reachability as in ``tracer-safety``,
+   extended with ``lock-discipline``'s cross-module resolution (self
+   methods, bare + imported functions, module-level singletons, module
+   aliases) plus function-local ``name = ClassName(...)`` instances.
+3. **Write sites**: ``self.attr`` assignments/mutations, declared-global
+   writes and subscript/method mutations of module-level names, and
+   ``nonlocal`` closure-cell writes — each recorded with the set of locks
+   lexically held (``lock-discipline``'s lock identities).
+4. **Findings**:
+   - a variable written both inside and outside thread-reachable code where
+     some thread-side/main-side pair shares **no common lock**;
+   - **guarded-by inference**: a variable consistently written under one
+     lock (>= 2 guarded sites, strict majority) has that lock as its
+     inferred guard — any write outside it is flagged even when the race
+     pair is not provable (the guard exists because the author knew the
+     state is shared).
+
+``__init__``-time writes are construction, not sharing, and are excluded.
+Lock-named attributes (``_lock``/``_cv``/...) are skipped — replacing a
+lock is its own kind of bug but not this pass's.
+
+Suppress intentional sites with ``# prestocheck: ignore[shared-state-race]``
+plus a one-line justification (e.g. a monotonic flag only ever set to one
+value, or a field the GIL makes atomic AND whose readers tolerate staleness).
+"""
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, FrozenSet, Iterable, List, Optional, Sequence, Set, \
+    Tuple
+
+from ..core import (Finding, Module, Pass, dotted_name, register,
+                    terminal_attr)
+from .lock_discipline import _LOCKISH, _module_name
+
+# constructors: writes there happen before the object is shared
+_INIT_FNS = {"__init__", "__new__", "__post_init__", "__set_name__"}
+
+# receiver-method calls that mutate the receiver in place. Deliberately
+# excludes queue put/get (thread-safe by contract) and Event set/clear.
+_MUTATORS = {"append", "extend", "insert", "remove", "add", "discard",
+             "update", "setdefault", "popitem", "appendleft", "popleft",
+             "sort", "reverse"}
+# `pop`/`clear` mutate too but are shared with Event.clear / deque.pop
+# noise; they count only with an argument (dict pop) / on dict-ish names
+_ARG_MUTATORS = {"pop"}
+
+
+@dataclass
+class WriteSite:
+    gid: Tuple          # ("attr", modname, cls, name) | ("global", modname,
+    #                     name) | ("cell", modname, name)
+    display: str
+    path: str
+    lineno: int
+    col: int
+    locks: FrozenSet[str]
+    fn_key: Tuple       # resolver key of the enclosing function
+    fn_name: str
+    is_init: bool
+
+
+@dataclass
+class CallRef:
+    kind: str           # "self" | "bare" | "recv"
+    receiver: Optional[str]
+    callee: str
+    lineno: int
+
+
+@dataclass
+class SpawnSite:
+    api: str            # "Thread" | "submit" | "Timer"
+    target: Optional[CallRef]       # None when the target is opaque
+    lambda_calls: List[CallRef]     # targets referenced from a lambda body
+    daemon: Optional[bool]          # None = not specified (Thread default F)
+    chained_start: bool             # Thread(...).start() with no reference
+    bound_names: List[str]          # names/attrs the thread object reaches
+    lineno: int
+    col: int
+    fn_key: Optional[Tuple]
+
+
+@dataclass
+class FnFacts:
+    key: Tuple          # ("c", cls, name) for methods, ("m", mod, name) else
+    name: str
+    cls: Optional[str]
+    node: ast.AST
+    calls: List[CallRef] = field(default_factory=list)
+    local_instances: Dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class ModuleFacts:
+    modname: str
+    path: str
+    imports: Dict[str, str] = field(default_factory=dict)
+    # alias -> original name for `from m import work as pump` (resolution
+    # must look up `work` in m, not the local alias)
+    import_real: Dict[str, str] = field(default_factory=dict)
+    instances: Dict[str, str] = field(default_factory=dict)
+    module_names: Set[str] = field(default_factory=set)
+    fns: List[FnFacts] = field(default_factory=list)
+    writes: List[WriteSite] = field(default_factory=list)
+    spawns: List[SpawnSite] = field(default_factory=list)
+    join_names: Set[str] = field(default_factory=set)
+
+
+# ---------------------------------------------------------------------------
+# fact extraction (shared with the thread-lifecycle pass; cached per Module)
+# ---------------------------------------------------------------------------
+
+def module_facts(module: Module) -> ModuleFacts:
+    cached = getattr(module, "_concurrency_facts", None)
+    if cached is not None:
+        return cached
+    facts = _build_facts(module)
+    module._concurrency_facts = facts
+    return facts
+
+
+def _collect_imports(tree: ast.Module, modname: str
+                     ) -> Tuple[Dict[str, str], Dict[str, str]]:
+    """(alias -> fully dotted source module, alias -> original name) —
+    lock-discipline's resolution plus the real name for aliased froms."""
+    imports: Dict[str, str] = {}
+    real: Dict[str, str] = {}
+    mod_parts = modname.split(".")
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                imports[alias.asname or alias.name.split(".")[0]] = alias.name
+        elif isinstance(node, ast.ImportFrom):
+            if node.level:
+                if node.level > len(mod_parts):
+                    continue
+                base = mod_parts[:len(mod_parts) - node.level]
+                src = ".".join(base + (node.module.split(".")
+                                       if node.module else []))
+            else:
+                src = node.module or ""
+            if not src:
+                continue
+            for alias in node.names:
+                full = (f"{src}.{alias.name}"
+                        if node.module is None else src)
+                bound = alias.asname or alias.name
+                imports[bound] = full
+                if alias.asname and alias.asname != alias.name:
+                    real[alias.asname] = alias.name
+    return imports, real
+
+
+def _callable_ref(expr: ast.AST) -> Optional[CallRef]:
+    """A reference to a callable (Thread target / submit fn / Timer cb)."""
+    if isinstance(expr, ast.Name):
+        return CallRef("bare", None, expr.id, expr.lineno)
+    if isinstance(expr, ast.Attribute) and isinstance(expr.value, ast.Name):
+        kind = "self" if expr.value.id in ("self", "cls") else "recv"
+        return CallRef(kind, expr.value.id, expr.attr, expr.lineno)
+    return None
+
+
+def _lambda_calls(lam: ast.Lambda) -> List[CallRef]:
+    out = []
+    for node in ast.walk(lam):
+        if isinstance(node, ast.Call):
+            ref = _callable_ref(node.func)
+            if ref is not None:
+                out.append(ref)
+    return out
+
+
+def _spawn_of(node: ast.Call, imports: Dict[str, str]) -> Optional[str]:
+    """'Thread' / 'Timer' / 'submit' when `node` creates thread work."""
+    callee = dotted_name(node.func)
+    if callee in ("threading.Thread", "Thread"):
+        return "Thread"
+    if callee in ("threading.Timer", "Timer"):
+        return "Timer"
+    if isinstance(node.func, ast.Attribute) and node.func.attr == "submit":
+        return "submit"
+    return None
+
+
+def _base_chain(expr: ast.AST) -> ast.AST:
+    """Strip subscripts: `self._inbox[w]` -> `self._inbox`."""
+    while isinstance(expr, ast.Subscript):
+        expr = expr.value
+    return expr
+
+
+def _build_facts(module: Module) -> ModuleFacts:
+    modname = _module_name(module.path)
+    facts = ModuleFacts(modname, module.path)
+    tree = module.tree
+    facts.imports, facts.import_real = _collect_imports(tree, modname)
+
+    for stmt in tree.body:
+        targets: List[ast.AST] = []
+        if isinstance(stmt, ast.Assign):
+            targets = list(stmt.targets)
+            if isinstance(stmt.value, ast.Call):
+                cls = terminal_attr(stmt.value.func)
+                if cls and cls.lstrip("_")[:1].isupper():
+                    for t in stmt.targets:
+                        if isinstance(t, ast.Name):
+                            facts.instances[t.id] = cls
+        elif isinstance(stmt, ast.AnnAssign):
+            targets = [stmt.target]
+        for t in targets:
+            if isinstance(t, ast.Name):
+                facts.module_names.add(t.id)
+            elif isinstance(t, ast.Tuple):
+                facts.module_names.update(
+                    e.id for e in t.elts if isinstance(e, ast.Name))
+
+    def fn_key(cls: Optional[str], name: str) -> Tuple:
+        return ("c", cls, name) if cls else ("m", modname, name)
+
+    def lock_id(expr: ast.AST, cls: Optional[str]) -> str:
+        term = terminal_attr(expr) or "?"
+        if isinstance(expr, ast.Name) and expr.id in facts.imports:
+            return f"{facts.imports[expr.id]}.{term}"
+        if isinstance(expr, ast.Attribute) and \
+                isinstance(expr.value, ast.Name) and \
+                expr.value.id in ("self", "cls") and cls:
+            return f"{modname}.{cls}.{term}"
+        return f"{modname}.{term}"
+
+    def record_write(kind_target: ast.AST, cls: Optional[str],
+                     fn: Optional[FnFacts], held: Tuple[str, ...],
+                     globals_in_fn: Set[str], nonlocals_in_fn: Set[str],
+                     lineno: int, col: int,
+                     mutation: bool = False) -> None:
+        t = _base_chain(kind_target)
+        # a subscript store or mutation-method call on a module-level name
+        # mutates the SHARED object (no `global` declaration needed); a bare
+        # `NAME = x` without one only rebinds a local
+        subscripted = (t is not kind_target) or mutation
+        if isinstance(t, ast.Attribute) and isinstance(t.value, ast.Name) \
+                and t.value.id in ("self", "cls") and cls:
+            name = t.attr
+            if _LOCKISH.search(name):
+                return
+            gid = ("attr", modname, cls, name)
+            display = f"{modname}.{cls}.{name}"
+        elif isinstance(t, ast.Name):
+            name = t.id
+            if _LOCKISH.search(name):
+                return
+            if name in nonlocals_in_fn:
+                gid = ("cell", modname, name)
+                display = f"{modname}.<cell {name}>"
+            elif name in globals_in_fn or \
+                    (subscripted and name in facts.module_names):
+                gid = ("global", modname, name)
+                display = f"{modname}.{name}"
+            else:
+                return  # plain local
+        else:
+            return
+        if fn is None:
+            return  # module-body fills are import-time, single-threaded
+        facts.writes.append(WriteSite(
+            gid, display, module.path, lineno, col, frozenset(held),
+            fn.key, fn.name, fn.name in _INIT_FNS))
+
+    def record_mutation(call: ast.Call, cls, fn, held, globals_in_fn,
+                        nonlocals_in_fn) -> None:
+        if not isinstance(call.func, ast.Attribute):
+            return
+        meth = call.func.attr
+        if meth in _ARG_MUTATORS:
+            if not call.args:
+                return
+        elif meth not in _MUTATORS:
+            return
+        record_write(call.func.value, cls, fn, held,
+                     globals_in_fn, nonlocals_in_fn,
+                     call.lineno, call.col_offset, mutation=True)
+
+    def scan_decls(fn_node) -> Tuple[Set[str], Set[str]]:
+        g: Set[str] = set()
+        n: Set[str] = set()
+        for sub in ast.walk(fn_node):
+            if isinstance(sub, ast.Global):
+                g.update(sub.names)
+            elif isinstance(sub, ast.Nonlocal):
+                n.update(sub.names)
+        return g, n
+
+    def visit(node: ast.AST, cls: Optional[str], fn: Optional[FnFacts],
+              held: List[str], gdecl: Set[str], ndecl: Set[str]) -> None:
+        if isinstance(node, ast.ClassDef):
+            for child in node.body:
+                visit(child, node.name, fn, held, gdecl, ndecl)
+            return
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            sub = FnFacts(fn_key(cls, node.name), node.name, cls, node)
+            facts.fns.append(sub)
+            g, n = scan_decls(node)
+            for child in node.body:
+                visit(child, cls, sub, [], g, n)
+            return
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            acquired = [lock_id(item.context_expr, cls)
+                        for item in node.items
+                        if _is_lockish_expr(item.context_expr)]
+            for child in node.body:
+                visit(child, cls, fn, held + acquired, gdecl, ndecl)
+            for item in node.items:
+                visit(item.context_expr, cls, fn, held, gdecl, ndecl)
+            return
+        if isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            if isinstance(node, ast.AnnAssign) and node.value is None:
+                return  # annotation only (`self.x: T`): declares, stores nothing
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            for t in targets:
+                elts = t.elts if isinstance(t, ast.Tuple) else [t]
+                for e in elts:
+                    record_write(e, cls, fn, tuple(held), gdecl, ndecl,
+                                 node.lineno, node.col_offset)
+            # local `name = ClassName(...)` instances (spawn/call targets)
+            if fn is not None and isinstance(node, ast.Assign) and \
+                    isinstance(node.value, ast.Call):
+                cname = terminal_attr(node.value.func)
+                if cname and cname.lstrip("_")[:1].isupper():
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            fn.local_instances[t.id] = cname
+        if isinstance(node, ast.Call):
+            _note_call(node, cls, fn, held, gdecl, ndecl)
+        for child in ast.iter_child_nodes(node):
+            visit(child, cls, fn, held, gdecl, ndecl)
+
+    def _note_call(node: ast.Call, cls, fn, held, gdecl, ndecl) -> None:
+        api = _spawn_of(node, facts.imports)
+        if api is not None:
+            target_expr = None
+            if api == "Thread":
+                for kw in node.keywords:
+                    if kw.arg == "target":
+                        target_expr = kw.value
+            elif api == "Timer" and len(node.args) >= 2:
+                target_expr = node.args[1]
+            elif api == "submit" and node.args:
+                target_expr = node.args[0]
+            if api != "submit" or target_expr is not None:
+                daemon = None
+                for kw in node.keywords:
+                    if kw.arg == "daemon" and \
+                            isinstance(kw.value, ast.Constant):
+                        daemon = bool(kw.value.value)
+                lam_calls: List[CallRef] = []
+                ref = None
+                if isinstance(target_expr, ast.Lambda):
+                    lam_calls = _lambda_calls(target_expr)
+                elif target_expr is not None:
+                    ref = _callable_ref(target_expr)
+                facts.spawns.append(SpawnSite(
+                    api, ref, lam_calls, daemon, False, [],
+                    node.lineno, node.col_offset,
+                    fn.key if fn is not None else None))
+        if fn is not None:
+            ref = _callable_ref(node.func)
+            if ref is not None:
+                fn.calls.append(ref)
+        record_mutation(node, cls, fn, tuple(held), gdecl, ndecl)
+        if isinstance(node.func, ast.Attribute) and node.func.attr == "join":
+            recv = terminal_attr(node.func.value)
+            if recv:
+                facts.join_names.add(recv)
+
+    for stmt in tree.body:
+        visit(stmt, None, None, [], set(), set())
+
+    _mark_chained_and_bound(facts, tree)
+    return facts
+
+
+def _is_lockish_expr(expr: ast.AST) -> bool:
+    term = terminal_attr(expr)
+    return bool(term and _LOCKISH.search(term))
+
+
+def _mark_chained_and_bound(facts: ModuleFacts, tree: ast.Module) -> None:
+    """Annotate Thread spawns with how the thread object is retained:
+    chained `.start()` (unretained), or the name/attr it is bound to."""
+    spawn_at = {(s.lineno, s.col): s for s in facts.spawns
+                if s.api == "Thread"}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr == "start" and \
+                isinstance(node.func.value, ast.Call):
+            inner = node.func.value
+            s = spawn_at.get((inner.lineno, inner.col_offset))
+            if s is not None:
+                s.chained_start = True
+        elif isinstance(node, ast.Assign) and isinstance(node.value,
+                                                         ast.Call):
+            s = spawn_at.get((node.value.lineno, node.value.col_offset))
+            if s is not None:
+                for t in node.targets:
+                    name = terminal_attr(t)
+                    if name:
+                        s.bound_names.append(name)
+        elif isinstance(node, ast.Call) and node.args and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr == "append" and \
+                isinstance(node.args[0], ast.Call):
+            s = spawn_at.get((node.args[0].lineno,
+                              node.args[0].col_offset))
+            if s is not None:
+                name = terminal_attr(node.func.value)
+                if name:
+                    s.bound_names.append(name)
+
+
+# ---------------------------------------------------------------------------
+# global resolution + the race check
+# ---------------------------------------------------------------------------
+
+class _Resolver:
+    def __init__(self, all_facts: Sequence[ModuleFacts]):
+        self.methods: Dict[Tuple[str, str], List[Tuple]] = {}
+        self.modfns: Dict[Tuple[str, str], List[Tuple]] = {}
+        self.instances: Dict[str, str] = {}
+        self.by_key: Dict[Tuple, FnFacts] = {}
+        for facts in all_facts:
+            self.instances.update(facts.instances)
+            for fn in facts.fns:
+                self.by_key[fn.key] = fn
+                if fn.cls:
+                    self.methods.setdefault((fn.cls, fn.name),
+                                            []).append(fn.key)
+                else:
+                    self.modfns.setdefault((facts.modname, fn.name),
+                                           []).append(fn.key)
+
+    def _fns_of_module(self, src: str, callee: str) -> List[Tuple]:
+        exact = self.modfns.get((src, callee))
+        if exact:
+            return exact
+        out: List[Tuple] = []
+        for (mod, fname), keys in self.modfns.items():
+            if fname == callee and mod.endswith("." + src):
+                out.extend(keys)
+        return out
+
+    def resolve(self, ref: CallRef, facts: ModuleFacts,
+                enclosing: Optional[FnFacts],
+                enclosing_cls: Optional[str]) -> List[Tuple]:
+        if ref.kind == "self" and enclosing_cls:
+            return self.methods.get((enclosing_cls, ref.callee), [])
+        if ref.kind == "bare":
+            keys = self.modfns.get((facts.modname, ref.callee), [])
+            if keys:
+                return keys
+            if ref.callee in facts.imports:
+                return self._fns_of_module(
+                    facts.imports[ref.callee],
+                    facts.import_real.get(ref.callee, ref.callee))
+            return []
+        if ref.kind == "recv":
+            recv = ref.receiver
+            cls_name = None
+            if enclosing is not None:
+                cls_name = enclosing.local_instances.get(recv)
+            if cls_name is None:
+                cls_name = facts.instances.get(recv,
+                                               self.instances.get(recv))
+            if cls_name:
+                return self.methods.get((cls_name, ref.callee), [])
+            if recv in facts.imports:
+                return self._fns_of_module(facts.imports[recv], ref.callee)
+        return []
+
+
+def thread_reachable_keys(all_facts: Sequence[ModuleFacts],
+                          resolver: _Resolver) -> Set[Tuple]:
+    """Function keys reachable from any thread-entry root."""
+    roots: Set[Tuple] = set()
+    for facts in all_facts:
+        for spawn in facts.spawns:
+            enclosing = resolver.by_key.get(spawn.fn_key) \
+                if spawn.fn_key else None
+            cls = spawn.fn_key[1] if spawn.fn_key and \
+                spawn.fn_key[0] == "c" else None
+            refs = ([spawn.target] if spawn.target else []) + \
+                spawn.lambda_calls
+            for ref in refs:
+                roots.update(resolver.resolve(ref, facts, enclosing, cls))
+    facts_by_mod = {f.modname: f for f in all_facts}
+    reachable = set(roots)
+    work = list(roots)
+    while work:
+        key = work.pop()
+        fn = resolver.by_key.get(key)
+        if fn is None:
+            continue
+        facts = facts_by_mod.get(key[1] if key[0] == "m" else
+                                 _mod_of_method(fn, all_facts))
+        if facts is None:
+            continue
+        cls = fn.cls
+        for ref in fn.calls:
+            for nxt in resolver.resolve(ref, facts, fn, cls):
+                if nxt not in reachable:
+                    reachable.add(nxt)
+                    work.append(nxt)
+    return reachable
+
+
+def _mod_of_method(fn: FnFacts, all_facts: Sequence[ModuleFacts]) -> str:
+    for facts in all_facts:
+        if fn in facts.fns:
+            return facts.modname
+    return ""
+
+
+@register
+class SharedStateRacePass(Pass):
+    id = "shared-state-race"
+    description = ("shared-state write reachable from a thread entry with "
+                   "no common lock / outside its inferred guard")
+
+    def __init__(self):
+        self._facts: List[ModuleFacts] = []
+        # fn key -> modname for method keys (built as facts stream in)
+        self._method_mod: Dict[Tuple, str] = {}
+
+    def check_module(self, module: Module) -> Iterable[Finding]:
+        facts = module_facts(module)
+        self._facts.append(facts)
+        return ()
+
+    def finish(self, modules: Sequence[Module]) -> Iterable[Finding]:
+        all_facts = self._facts
+        resolver = _Resolver(all_facts)
+        reachable = thread_reachable_keys(all_facts, resolver)
+
+        by_var: Dict[Tuple, List[WriteSite]] = {}
+        for facts in all_facts:
+            for w in facts.writes:
+                by_var.setdefault(w.gid, []).append(w)
+
+        findings: List[Finding] = []
+        for gid, sites in sorted(by_var.items(), key=lambda kv: str(kv[0])):
+            live = [s for s in sites if not s.is_init]
+            if not live:
+                continue
+            tsides = [s for s in live if s.fn_key in reachable]
+            msides = [s for s in live if s.fn_key not in reachable]
+            if not tsides:
+                continue
+            flagged_lines: Set[Tuple[str, int]] = set()
+
+            # ---- both-sides, no common lock --------------------------------
+            pair = None
+            for t in tsides:
+                for m in msides:
+                    if not (t.locks & m.locks):
+                        pair = (t, m)
+                        break
+                if pair:
+                    break
+            if pair:
+                t, m = pair
+                anchor = t if len(t.locks) <= len(m.locks) else m
+                other = m if anchor is t else t
+                side = ("thread-reachable" if anchor is t
+                        else "non-thread")
+                other_side = ("non-thread" if anchor is t
+                              else "thread-reachable")
+                findings.append(Finding(
+                    anchor.path, anchor.lineno, anchor.col, self.id,
+                    f"`{anchor.display}` written in {side} "
+                    f"`{anchor.fn_name}` and in {other_side} "
+                    f"`{other.fn_name}` (line {other.lineno}) with no "
+                    "common lock — guard both sides with one lock"))
+                flagged_lines.add((anchor.path, anchor.lineno))
+
+            # ---- guarded-by inference --------------------------------------
+            guard_count: Dict[str, int] = {}
+            for s in live:
+                for lk in s.locks:
+                    guard_count[lk] = guard_count.get(lk, 0) + 1
+            if not guard_count:
+                continue
+            guard = max(sorted(guard_count), key=lambda k: guard_count[k])
+            covered = guard_count[guard]
+            unguarded = [s for s in live if guard not in s.locks]
+            if covered >= 2 and unguarded and covered > len(unguarded):
+                for s in unguarded:
+                    if (s.path, s.lineno) in flagged_lines:
+                        continue
+                    findings.append(Finding(
+                        s.path, s.lineno, s.col, self.id,
+                        f"write to `{s.display}` in `{s.fn_name}` outside "
+                        f"its inferred guard `{guard}` (held at {covered} "
+                        f"of {len(live)} write sites)"))
+        return findings

@@ -1,0 +1,150 @@
+"""thread-lifecycle: threads that can never be joined, and daemon threads
+doing work an abrupt interpreter exit corrupts.
+
+Three checks, all anchored at the ``threading.Thread(...)`` creation:
+
+1. **fire-and-forget**: ``threading.Thread(...).start()`` with no reference
+   retained. The thread can never be joined, counted, or bounded — under
+   traffic this is an unbounded thread spawn per request (the
+   ``server/protocol.py`` per-query pattern this pass was built to catch).
+   Keep the object (a registry keyed by task/query id works) and join it
+   from ``close()``/``shutdown()``.
+2. **non-daemon never joined**: a non-daemon thread keeps the interpreter
+   alive until it exits; one that is started but never ``.join()``-ed
+   anywhere in its module leaks shutdown latency (or a hang) into every
+   process exit. Join it in ``close()``/``shutdown()``.
+3. **daemon mutating files**: a daemon thread is killed mid-instruction at
+   interpreter exit; a target that (module-locally) reaches ``open(...,
+   "w")`` / ``os.replace`` / ``shutil`` file mutation can leave a
+   half-written file behind. Make it non-daemon and join it, or hand the
+   final write to the closer.
+
+Suppress deliberate lifecycles with a justified
+``# prestocheck: ignore[thread-lifecycle]`` on the creation line.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional, Sequence, Set, Tuple
+
+from ..core import Finding, Module, Pass, dotted_name, register
+from .shared_state_race import CallRef, ModuleFacts, module_facts
+
+_FILE_MUTATORS = {"os.replace", "os.rename", "os.remove", "os.unlink",
+                  "os.truncate", "os.makedirs", "os.rmdir",
+                  "shutil.rmtree", "shutil.move", "shutil.copyfile",
+                  "shutil.copy", "shutil.copy2", "shutil.copytree"}
+_WRITE_MODES = set("wax+")
+
+
+def _open_writes(call: ast.Call) -> bool:
+    if dotted_name(call.func) not in ("open", "io.open", "os.open",
+                                      "gzip.open"):
+        return False
+    mode = None
+    if len(call.args) >= 2 and isinstance(call.args[1], ast.Constant):
+        mode = call.args[1].value
+    for kw in call.keywords:
+        if kw.arg == "mode" and isinstance(kw.value, ast.Constant):
+            mode = kw.value.value
+    return isinstance(mode, str) and bool(set(mode) & _WRITE_MODES)
+
+
+def _fn_mutates_files(fn_node: ast.AST) -> Optional[int]:
+    """Line of the first file-mutating call in `fn_node`, else None."""
+    for node in ast.walk(fn_node):
+        if not isinstance(node, ast.Call):
+            continue
+        if _open_writes(node):
+            return node.lineno
+        if dotted_name(node.func) in _FILE_MUTATORS:
+            return node.lineno
+    return None
+
+
+@register
+class ThreadLifecyclePass(Pass):
+    id = "thread-lifecycle"
+    description = ("fire-and-forget / never-joined non-daemon threads; "
+                   "daemon threads mutating files")
+
+    def check_module(self, module: Module) -> Iterable[Finding]:
+        facts = module_facts(module)
+        findings: List[Finding] = []
+        local_fns = {}
+        for fn in facts.fns:
+            local_fns.setdefault((fn.cls, fn.name), fn)
+            local_fns.setdefault((None, fn.name), fn)  # bare-name fallback
+
+        for spawn in facts.spawns:
+            if spawn.api != "Thread":
+                continue
+            if spawn.chained_start:
+                findings.append(Finding(
+                    module.path, spawn.lineno, spawn.col, self.id,
+                    "thread started without retaining a reference — it can "
+                    "never be joined or counted; keep it and join in "
+                    "close()/shutdown()"))
+                continue
+            if spawn.daemon is not True:
+                # non-daemon (explicit False or unspecified default): the
+                # finding needs a retained name (factories returning a
+                # thread are the caller's lifecycle) with no .join() on ANY
+                # name the object reaches — a join on an unrelated thread
+                # elsewhere in the module does not clear this one
+                if spawn.bound_names and \
+                        not (set(spawn.bound_names) & facts.join_names):
+                    findings.append(Finding(
+                        module.path, spawn.lineno, spawn.col, self.id,
+                        "non-daemon thread started but never joined in "
+                        "this module — join it from close()/shutdown() or "
+                        "it outlives every query that spawned it"))
+            else:
+                target = self._resolve_target(spawn, facts, local_fns)
+                if target is not None:
+                    line = self._mutates_files_transitively(target,
+                                                           local_fns)
+                    if line is not None:
+                        findings.append(Finding(
+                            module.path, spawn.lineno, spawn.col, self.id,
+                            f"daemon thread target `{target.name}` mutates "
+                            f"files (line {line}) — abrupt interpreter "
+                            "exit can leave a half-written file; make it "
+                            "non-daemon and join it in close()"))
+        return findings
+
+    @staticmethod
+    def _resolve_target(spawn, facts: ModuleFacts, local_fns):
+        ref = spawn.target
+        if ref is None:
+            return None
+        if ref.kind == "self" and spawn.fn_key and spawn.fn_key[0] == "c":
+            return local_fns.get((spawn.fn_key[1], ref.callee))
+        if ref.kind in ("bare", "self"):
+            return local_fns.get((None, ref.callee))
+        return None
+
+    @staticmethod
+    def _mutates_files_transitively(fn, local_fns,
+                                    depth: int = 3) -> Optional[int]:
+        seen: Set[Tuple] = set()
+        work = [(fn, 0)]
+        while work:
+            cur, d = work.pop()
+            if cur.key in seen:
+                continue
+            seen.add(cur.key)
+            line = _fn_mutates_files(cur.node)
+            if line is not None:
+                return line
+            if d >= depth:
+                continue
+            for ref in cur.calls:
+                nxt = None
+                if ref.kind == "self" and cur.cls:
+                    nxt = local_fns.get((cur.cls, ref.callee))
+                if nxt is None and ref.kind in ("self", "bare"):
+                    nxt = local_fns.get((None, ref.callee))
+                if nxt is not None:
+                    work.append((nxt, d + 1))
+        return None
