@@ -174,6 +174,9 @@ class StreamingRemoteSource(ConnectorPageSource):
     round-robins the locations, yielding pages as frames arrive; exhausts when
     every location reports complete."""
 
+    # reads long-poll upstream tasks: must never step on the shared pool
+    external_wait = True
+
     def __init__(self, locations: Sequence[str], buffer_id: int,
                  types: Sequence[Type],
                  dicts: Sequence[Optional[Dictionary]],
@@ -259,6 +262,9 @@ class MergingRemoteSource(ConnectorPageSource):
     `orderings`: [(channel, descending, nulls_first)]; varchar channels
     compare by dictionary rank (Dictionary.sort_keys), exactly like the
     engine's sort operators."""
+
+    # reads long-poll upstream tasks: must never step on the shared pool
+    external_wait = True
 
     def __init__(self, locations: Sequence[str], buffer_id: int,
                  types: Sequence[Type],

@@ -117,7 +117,7 @@ class SourceUpdateRequest:
 
 def plan_subplan(subplan: SubPlan, metadata: MetadataManager, session: Session,
                  task_counts: Dict[int, int], target_fragment_id=None,
-                 sink_factory=None):
+                 sink_factory=None, memory=None, pool_key=None):
     """Locally plan every fragment bottom-up, threading producer output
     dictionaries into consumers (the mesh runner's pattern). Returns
     {fragment_id: (LocalExecutionPlanner, LocalExecutionPlan)}.
@@ -151,7 +151,14 @@ def plan_subplan(subplan: SubPlan, metadata: MetadataManager, session: Session,
             root = OutputNode(body, [s.name for s in syms], syms)
         lp = LocalExecutionPlanner(metadata, session,
                                    n_workers=task_counts.get(frag.id, 1),
-                                   remote_dicts=frag_dicts)
+                                   remote_dicts=frag_dicts,
+                                   pool_key=pool_key)
+        if memory is not None:
+            # worker-side unified accounting: operator state AND scan
+            # prefetch of this task reserve in the worker's shared pool
+            # under the query id, which /v1/status ships to the cluster
+            # memory manager's OOM policy
+            lp.attach_memory(*memory)
         sf = sink_factory if frag.id == target_fragment_id else None
         ep = lp.plan(root, sink_factory=sf)
         for fid, orderings in merge_slots.items():
@@ -344,6 +351,30 @@ class SqlTask:
         frag = self._fragment()
         return frag.output_kind or GATHER
 
+    def _query_memory(self):
+        """This task's memory root in the worker's process-shared pool,
+        keyed by QUERY id — every task of one query aggregates into one
+        reservation the OOM killer can weigh (runner._query_memory's shape,
+        worker-side)."""
+        from ..memory import QueryContextMemory, shared_general_pool
+
+        req = self.request
+        session_bytes = int(req.session.get("memory_pool_bytes"))
+        pool = shared_general_pool(session_bytes)
+        qmem = QueryContextMemory(
+            req.query_id, pool,
+            int(req.session.get("query_max_memory_bytes")))
+        target = float(req.session.get("revoke_target_fraction"))
+
+        def over_target() -> bool:
+            # pool-wide pressure, or this query alone over its session's
+            # budget (the shared pool is grow-only — a small session budget
+            # must still trigger revocation while the pool has room)
+            return (pool.reserved_bytes() > pool.max_bytes * target
+                    or pool.query_bytes(req.query_id)
+                    > session_bytes * target)
+        return qmem.memory, over_target
+
     def _fragment(self):
         for f in self.request.subplan.fragments:
             if f.id == self.request.fragment_id:
@@ -385,6 +416,13 @@ class SqlTask:
             self.error = {"message": str(e), "type": type(e).__name__,
                           "stack": traceback.format_exc()[-2000:]}
             self._transition(FAILED)
+            # abandoned drivers must release their pipelines + memory
+            # reservations (the pool is process-shared across queries now)
+            for d in self._drivers:
+                try:
+                    d.close()
+                except Exception:  # noqa: BLE001 - teardown best effort
+                    pass
             self.output.fail(str(e))
 
     def _plan_drivers(self):
@@ -392,7 +430,12 @@ class SqlTask:
         frag = self._fragment()
         plans = plan_subplan(req.subplan, self.metadata, req.session,
                              req.task_counts, target_fragment_id=req.fragment_id,
-                             sink_factory=self._make_sink(frag))
+                             sink_factory=self._make_sink(frag),
+                             memory=self._query_memory(),
+                             # one fairness slot per QUERY on this worker:
+                             # every fragment of every task of one query
+                             # shares it (keys are refcounted per pool)
+                             pool_key=f"cluster-{req.query_id}")
         own_lp, own_plan = plans[req.fragment_id]
         self.output_types = own_plan.output_types
         self.output_dicts = own_plan.output_dicts

@@ -176,13 +176,30 @@ class _WorkerHandler(BaseHTTPRequestHandler):
             import json
             active = 0
             query_mem = {}
+            live_queries = set()
             for t in self.worker.tasks.tasks.values():
                 if t.state in DONE_STATES:
                     continue
                 active += 1
                 qid = t.request.query_id
+                live_queries.add(qid)
                 query_mem[qid] = query_mem.get(qid, 0) + \
                     t.output.retained_bytes()
+            # unified footprint: operator state + scan prefetch reserved in
+            # the worker's shared pool (cluster/task._query_memory) — the
+            # OOM killer must see the WHOLE per-query byte count, not just
+            # output buffers. Done queries' residue is excluded.
+            from ..memory import shared_general_pool
+            pool = shared_general_pool()
+            for qid, b in pool.by_query().items():
+                if qid in live_queries:
+                    query_mem[qid] = query_mem.get(qid, 0) + int(b)
+                else:
+                    # no live task of this query remains on the worker: any
+                    # leftover reservation is a failed-teardown leak — clear
+                    # it here (the memory manager polls status every second,
+                    # so this doubles as the worker's pool GC)
+                    pool.clear_query(qid)
             return self._send(json.dumps({
                 "nodeId": self.worker.node_id,
                 "state": self.worker.state,

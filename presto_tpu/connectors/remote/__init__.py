@@ -183,6 +183,9 @@ class RemotePageSource(ConnectorPageSource):
     """Pulls row batches by continuation token, builds fixed-capacity masked
     pages, re-encoding varchar through the plan-time dictionaries."""
 
+    # polls a remote coordinator until IT finishes: never on the shared pool
+    external_wait = True
+
     def __init__(self, client: RemoteClient, split: Split,
                  columns: Sequence[ColumnHandle], page_capacity: int,
                  dicts: Dict[str, Dictionary]):

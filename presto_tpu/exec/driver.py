@@ -97,6 +97,11 @@ class Driver:
         try:
             while True:
                 if self.is_finished():
+                    # finished OUTSIDE our own processing (a downstream
+                    # consumer abandoned, a limit was satisfied elsewhere):
+                    # resources must still release — an unclosed scan would
+                    # leak its shared-pool client ref (idempotent)
+                    self._close_operators()
                     return ProcessState.FINISHED
                 b = self.blocked_on()
                 if b is not None:

@@ -168,6 +168,12 @@ class _ConcatPageSource(ConnectorPageSource):
     def __init__(self, sources):
         self.sources = list(sources)
 
+    @property
+    def external_wait(self):
+        """One externally-blocking child (a remote-connector source) makes
+        the whole concat ineligible for the shared scan pool."""
+        return any(getattr(s, "external_wait", False) for s in self.sources)
+
     def __iter__(self):
         for s in self.sources:
             yield from s
@@ -322,7 +328,8 @@ class LocalExecutionPlanner:
     def __init__(self, metadata: MetadataManager, session: Session,
                  n_workers: int = 1,
                  remote_dicts: Optional[Dict[int, List[Optional[Dictionary]]]] = None,
-                 devices=None, bucket_filter: Optional[int] = None):
+                 devices=None, bucket_filter: Optional[int] = None,
+                 pool_key: Optional[str] = None):
         self.metadata = metadata
         self.session = session
         from ..metadata import default_page_capacity
@@ -334,12 +341,23 @@ class LocalExecutionPlanner:
         # ScanPipeline's engine defaults (single source of truth)
         threads = session.get("scan_reader_threads")
         rows = session.get("scan_target_page_rows")
+        # shared_pools: scan stages run on the process-wide SCAN_POOL under
+        # ONE fairness slot per query (callers planning several fragments of
+        # one query pass the same pool_key); False = per-query stage threads,
+        # the differential oracle
+        if bool(session.get("shared_pools", True)):
+            from .shared_pools import next_query_key
+            pool_key = pool_key or next_query_key()
+        else:
+            pool_key = None
+        self.pool_key = pool_key
         self.scan_options = {
             "rebatch": bool(session.get("scan_pipeline", True)),
             "reader_threads": int(threads) if threads else None,
             "target_rows": int(rows) if rows else self.page_capacity,
             "prefetch_bytes": int(session.get("scan_prefetch_bytes") or 0)
             or None,
+            "pool_key": pool_key,
         }
         self.n_workers = n_workers
         # grouped (lifespan) execution: restrict every scan to this bucket's

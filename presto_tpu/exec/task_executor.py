@@ -127,6 +127,10 @@ class _Run:
         self.error: Optional[BaseException] = None
         self._seq = itertools.count()
         self._exited_workers = 0         # pool threads done with this run
+        # the submitting (query) thread's flight recorder rides with the run
+        # so runner threads attribute driver spans to the right query even
+        # when several traced queries share the process
+        self.recorder = trace.active()
         for d in drivers:
             heapq.heappush(self.ready, (0, next(self._seq), d))
 
@@ -173,6 +177,10 @@ class _Run:
                 self.cv.wait(timeout=0.001)
 
     def runner_loop(self) -> None:
+        with trace.bound(self.recorder):
+            self._runner_loop()
+
+    def _runner_loop(self) -> None:
         import time
         while True:
             nxt = self._next_driver()

@@ -115,6 +115,24 @@ class MemoryPool:
             if d[query_id] <= 0:
                 d.pop(query_id)
 
+    def clear_query(self, query_id: str) -> None:
+        """Drop every reservation of one query — the end-of-query backstop
+        for the SHARED pool: an operator path that failed to release (error
+        teardown, abandoned drivers) must not leak phantom pressure into
+        every later tenant's admission and revocation decisions."""
+        with self._lock:
+            self._reserved.pop(query_id, None)
+            self._revocable.pop(query_id, None)
+
+    def by_query(self) -> Dict[str, int]:
+        """{query_id: total bytes} — what /v1/status ships to the cluster
+        memory manager's OOM policy."""
+        with self._lock:
+            totals: Dict[str, int] = dict(self._reserved)
+            for q, b in self._revocable.items():
+                totals[q] = totals.get(q, 0) + b
+            return totals
+
     def reserved_bytes(self) -> int:
         return sum(self._reserved.values()) + sum(self._revocable.values())
 
@@ -138,6 +156,33 @@ class MemoryPool:
 
 GENERAL_POOL = "general"
 RESERVED_POOL = "reserved"
+
+# ---------------------------------------------------------------------------
+# the process-shared GENERAL pool: one accounting surface for every
+# concurrent query on this engine instance (multi-tenant serving). Before
+# this, each query made itself a private pool — N tenants never competed,
+# the revoker and the OOM killer each saw one query's world.
+# ---------------------------------------------------------------------------
+
+_SHARED_LOCK = threading.Lock()
+_SHARED_POOL: Optional[MemoryPool] = None
+
+
+def shared_general_pool(max_bytes: Optional[int] = None) -> MemoryPool:
+    """The process-wide GENERAL pool. Sized at first use; later callers can
+    only GROW it (a tenant's session knob must not shrink the budget under
+    every other live query). Scan prefetch, exchange in-flight bytes and
+    operator state all reserve here per query, so admission control
+    (server/resource_groups), the revoker and the cluster OOM killer see
+    one unified footprint."""
+    global _SHARED_POOL
+    with _SHARED_LOCK:
+        if _SHARED_POOL is None:
+            _SHARED_POOL = MemoryPool(GENERAL_POOL, int(max_bytes or 8 << 30))
+        elif max_bytes:
+            _SHARED_POOL.max_bytes = max(_SHARED_POOL.max_bytes,
+                                         int(max_bytes))
+        return _SHARED_POOL
 
 
 class QueryContextMemory:

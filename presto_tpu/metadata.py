@@ -96,6 +96,14 @@ class Session:
         # full intermediate result; 0 = engine default
         # (streaming_exchange.DEFAULT_INFLIGHT_BYTES, 256MB)
         "exchange_inflight_bytes": 0,
+        # --- multi-tenant serving (exec/shared_pools.py) ---
+        # run scan-pipeline stages and exchange pumps on the process-wide
+        # shared worker pools with per-query round-robin fairness, so N
+        # concurrent queries cost O(pool) threads instead of O(N * stages).
+        # Pool sizes are fixed once per process (PRESTO_TPU_SCAN_POOL_THREADS
+        # / PRESTO_TPU_EXCHANGE_POOL_THREADS env knobs). False = per-query
+        # dedicated stage threads — the differential-testing oracle
+        "shared_pools": True,
         # --- observability: per-query flight recorder (utils/trace.py) ---
         # record spans across every engine layer (lifecycle, driver quanta,
         # operators, fused segments, scan stages, exchange chunks, cluster

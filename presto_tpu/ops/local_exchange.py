@@ -86,17 +86,44 @@ class LocalExchangeBuffer:
                     from self._poison
             if self._abandoned:
                 return  # consumer is gone: accept and discard
-            if self.deal_slots:
-                self._dealt[self._deal_next].append(page)
-                self._deal_next = (self._deal_next + 1) % self.deal_slots
-            else:
-                self._pages.append(page)
-            if self.max_bytes > 0:
-                # byte accounting only for byte-bounded buffers: the
-                # page-bounded local exchanges on the driver hot path must
-                # not pay a per-page nbytes walk for a counter nobody reads
-                self._bytes += self._page_bytes(page)
-            self._cv.notify_all()
+            self._enqueue_locked(page)
+
+    def try_put(self, page: Page, wait_s: float = 0.0) -> bool:
+        """Bounded-blocking put: enqueue if there is room (waiting at most
+        `wait_s` for some), else return False. The streaming exchange's
+        shared-pool pump delivers through this so a full consumer queue
+        parks the pump STEP, never a pool worker — poison still raises."""
+        with self._cv:
+            if not self._abandoned and not self._has_room_locked():
+                if self._poison is not None:
+                    raise RuntimeError("local exchange buffer poisoned") \
+                        from self._poison
+                if wait_s > 0:
+                    self._cv.wait(timeout=wait_s)
+            if self._poison is not None:
+                raise RuntimeError("local exchange buffer poisoned") \
+                    from self._poison
+            if self._abandoned:
+                return True  # consumer is gone: accept and discard
+            if not self._has_room_locked():
+                return False
+            self._enqueue_locked(page)
+            return True
+
+    def _enqueue_locked(self, page: Page) -> None:
+        """Shared enqueue tail (caller holds self._cv and has settled the
+        poison/abandon/room policy): deal or append, account, wake."""
+        if self.deal_slots:
+            self._dealt[self._deal_next].append(page)
+            self._deal_next = (self._deal_next + 1) % self.deal_slots
+        else:
+            self._pages.append(page)
+        if self.max_bytes > 0:
+            # byte accounting only for byte-bounded buffers: the
+            # page-bounded local exchanges on the driver hot path must
+            # not pay a per-page nbytes walk for a counter nobody reads
+            self._bytes += self._page_bytes(page)
+        self._cv.notify_all()
 
     def _buffered(self) -> int:
         return len(self._pages) + sum(len(d) for d in self._dealt)

@@ -182,7 +182,15 @@ class TableScanOperator(Operator):
                     reader_threads=opts.get("reader_threads"),
                     target_rows=opts.get("target_rows"),
                     prefetch_bytes=opts.get("prefetch_bytes"),
-                    rebatch=bool(opts.get("rebatch", True)))
+                    rebatch=bool(opts.get("rebatch", True)),
+                    # per-query fairness slot on the shared scan pool (None
+                    # = dedicated threads, the shared_pools=False oracle)
+                    pool_key=opts.get("pool_key"),
+                    # prefetch bytes are USER memory of the owning query:
+                    # staged + uploaded-unconsumed pages compete with
+                    # operator state in the query's pool
+                    memory=self.context.memory.user
+                    .new_local_memory_context("scan_prefetch"))
             page = self._pipeline.next()
         else:
             if self._iter is None:

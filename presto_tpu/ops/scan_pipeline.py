@@ -7,11 +7,11 @@ chip, was the bottleneck; `hostgen_stall_s` dominated the wall):
 
     split readers (pool) -> ordered staging -> re-batch -> upload -> compute
     mmap + slice + remap    bytes-bounded      take_rows    async     driver
-    N threads               reorder buffer     pow2 pages   device_put
+    N workers               reorder buffer     pow2 pages   device_put
 
 - READ: a source that can decompose itself into row-range splits
   (``ConnectorPageSource.split_readers``) is read by a pool of reader
-  threads concurrently — pcol chunk slicing is embarrassingly parallel
+  workers concurrently — pcol chunk slicing is embarrassingly parallel
   (the header carries per-chunk offsets). Sources without split support
   run as ONE reader streaming their pages through the same machinery;
   either way this replaces the old one-thread-per-source ``_Prefetcher``.
@@ -29,6 +29,22 @@ chip, was the bottleneck; `hostgen_stall_s` dominated the wall):
 - UPLOAD: a dedicated stage issues the (async) ``jax.device_put`` ahead of
   the consumer, bounded by the same byte budget applied to uploaded pages
   the driver has not consumed yet.
+
+Scheduling: every stage is written as a GENERATOR whose each step performs
+one bounded unit of work (one chunk read / one re-batch / one upload) and
+whose blocking points wait at most ``shared_pools.STEP_WAIT_S`` before
+yielding. Under the default ``shared_pools`` session knob the generators run
+on the process-wide :data:`~presto_tpu.exec.shared_pools.SCAN_POOL` —
+N concurrent queries share O(pool) threads with per-query round-robin
+fairness; with ``shared_pools=False`` the same generators run on per-query
+dedicated threads (the differential-testing oracle, and the pre-serving
+behavior bit-for-bit).
+
+Memory: when the planner hands the pipeline a per-query memory context, the
+staged + uploaded-unconsumed bytes are accounted as user memory — prefetch
+competes with operator state in the query's pool, the cluster OOM killer
+sees the whole footprint, and a query whose prefetch blows its budget FAILS
+(the limit exception propagates to the consumer) instead of wedging.
 
 Every stage accounts busy/stall seconds into ``utils/metrics.METRICS``
 (``scan.pipeline.*``) and into a per-pipeline ``stats()`` dict that the
@@ -48,6 +64,7 @@ import jax
 import numpy as np
 
 from ..block import Block, Page
+from ..exec.shared_pools import AGAIN, SCAN_POOL, STEP_WAIT_S, WAIT
 from ..utils import trace
 from ..utils.batching import clamp_capacity, take_rows
 from ..utils.metrics import METRICS
@@ -60,7 +77,7 @@ _ERR = object()    # error marker on the output queue: (_ERR, exception)
 # (session properties 0/None mean "use these")
 DEFAULT_PREFETCH_BYTES = 256 << 20
 DEFAULT_READER_THREADS = min(8, os.cpu_count() or 4)
-# producers/consumers re-check the stop flag at this cadence while parked
+# close()/stat waiters re-check at this cadence while parked
 _WAIT_S = 0.1
 
 _STAGE_KEYS = ("read_busy_s", "read_stall_s", "decode_busy_s",
@@ -202,19 +219,39 @@ class Rebatcher:
 class ScanPipeline:
     """One page source driven through the staged read->re-batch->upload
     pipeline. ``next()`` is the consumer API (None = exhausted); ``close()``
-    stops the stages and JOINS their threads (bounded) so a producer mid
-    ``jax.device_put`` can never race interpreter teardown."""
+    stops the stages and waits for every stage step to retire (bounded) so a
+    producer mid ``jax.device_put`` can never race interpreter teardown."""
 
     def __init__(self, source, device=None, *,
                  reader_threads: Optional[int] = None,
                  target_rows: Optional[int] = None,
                  prefetch_bytes: Optional[int] = None,
-                 rebatch: bool = True):
+                 rebatch: bool = True,
+                 pool_key: Optional[str] = None,
+                 memory=None):
         self._source = source
         self._device = device
         self._target = int(target_rows) if target_rows else 0
         self._max_bytes = max(int(prefetch_bytes or DEFAULT_PREFETCH_BYTES),
                               1)
+        # pool_key set: stages run on the process-wide SCAN_POOL under the
+        # query's fairness slot; None: per-query dedicated threads (oracle).
+        # Sources whose reads block indefinitely on EXTERNAL progress
+        # (remote exchange streams, another coordinator) cannot honor the
+        # pool's bounded-step contract — one would wedge a pool worker and
+        # starve every other query's stages, circularly including the very
+        # upstream producers the read waits for — so they always run on
+        # dedicated threads regardless of the session knob.
+        if getattr(source, "external_wait", False):
+            pool_key = None
+        self._pool = SCAN_POOL.client(pool_key) if pool_key else None
+        # per-query memory context (LocalMemoryContext): staged + uploaded
+        # bytes are accounted as user memory so prefetch competes with
+        # operator state and the OOM killer sees it; None = unaccounted
+        self._memory = memory
+        # owning query's flight recorder: dedicated stage threads re-bind it
+        # (pool steps re-bind the recorder captured at submit)
+        self._recorder = trace.active()
         readers = None
         if rebatch and self._target > 0:
             split = getattr(source, "split_readers", None)
@@ -247,6 +284,7 @@ class ScanPipeline:
         self._stats.update({k: 0 for k in _COUNT_KEYS})
         self._flushed = False
         self._started = False
+        self._live_gens = 0
         self._threads: List[threading.Thread] = []
 
     # ------------------------------------------------------------- consumer
@@ -274,13 +312,15 @@ class ScanPipeline:
         with self._ocv:
             self._out_bytes -= nbytes
             self._ocv.notify_all()
+        self._account()  # releasing bytes never trips the limit
         return page
 
     def close(self, timeout_s: float = 2.0) -> None:
-        """Stop all stages, drain, and join the threads (bounded wait): a
-        producer blocked on a budget or mid device_put observes the stop
-        flag within _WAIT_S and exits; anything wedged in a backend call
-        is left as a daemon thread rather than hanging teardown."""
+        """Stop all stages, drain, and wait for every stage generator to
+        retire (bounded wait): a stage blocked on a budget observes the stop
+        flag within STEP_WAIT_S and exits; anything wedged in a backend call
+        is abandoned (daemon threads / dropped pool steps) rather than
+        hanging teardown."""
         self._stop.set()
         with self._cv:
             self._cv.notify_all()
@@ -292,10 +332,21 @@ class ScanPipeline:
                 self._out.get_nowait()
         except queue.Empty:
             pass
-        deadline = time.perf_counter() + timeout_s  # bound on the WHOLE join
+        deadline = time.perf_counter() + timeout_s  # bound on the WHOLE wait
+        with self._cv:
+            while self._live_gens > 0:
+                left = deadline - time.perf_counter()
+                if left <= 0:
+                    break
+                self._cv.wait(min(left, _WAIT_S))
         for t in self._threads:
             t.join(timeout=max(0.0, deadline - time.perf_counter()))
         self._threads = [t for t in self._threads if t.is_alive()]
+        if self._pool is not None:
+            self._pool.release()
+            self._pool = None
+        if self._memory is not None:
+            self._memory.close()  # reservation drops with the prefetch
         self._flush_metrics()
 
     def stats(self) -> dict:
@@ -310,150 +361,204 @@ class ScanPipeline:
         if not self._readers:
             self._out.put(_EOS)
             return
-        for i in range(self._n_threads):
-            t = threading.Thread(target=self._reader_loop,
-                                 name=f"scan-read-{i}", daemon=True)
+        gens = [self._reader_gen() for _ in range(self._n_threads)]
+        gens.append(self._decode_gen())
+        gens.append(self._upload_gen())
+        with self._cv:
+            self._live_gens = len(gens)
+        if self._pool is not None:
+            for g in gens:
+                self._pool.submit(self._guard(g))
+            return
+        names = [f"scan-read-{i}" for i in range(self._n_threads)]
+        names += ["scan-decode", "scan-upload"]
+        for g, name in zip(gens, names):
+            t = threading.Thread(target=self._drive,
+                                 args=(self._guard(g),), name=name,
+                                 daemon=True)
             t.start()
             self._threads.append(t)
-        for target, name in ((self._decode_loop, "scan-decode"),
-                             (self._upload_loop, "scan-upload")):
-            t = threading.Thread(target=target, name=name, daemon=True)
-            t.start()
-            self._threads.append(t)
+
+    def _drive(self, gen) -> None:
+        """Dedicated-thread scheduler: the generator's internal bounded
+        waits provide the blocking cadence, so draining it step-by-step is
+        behaviorally the old thread loop."""
+        with trace.bound(self._recorder):
+            for _ in gen:
+                pass
+
+    def _guard(self, gen):
+        """Wrap a stage generator: surface its failure to the consumer and
+        retire it from the live count (what close() waits on)."""
+        try:
+            yield from gen
+        except BaseException as e:  # noqa: BLE001 - surfaced to the consumer
+            self._fail(e)
+        finally:
+            with self._cv:
+                self._live_gens -= 1
+                self._cv.notify_all()
 
     def _add(self, key: str, value) -> None:
         with self._stats_lock:
             self._stats[key] += value
 
-    def _reader_loop(self) -> None:
-        try:
-            while not self._stop.is_set():
-                with self._cv:
-                    ri = self._next_reader
-                    if ri >= len(self._readers):
-                        return
-                    self._next_reader = ri + 1
-                it = iter(self._readers[ri]())
-                seq = 0
-                while True:
-                    t0 = time.perf_counter_ns()
-                    try:
-                        item = next(it)
-                    except StopIteration:
-                        break
-                    dt = time.perf_counter_ns() - t0
-                    self._add("read_busy_s", dt / 1e9)
-                    trace.record(trace.SCAN, "read", t0, dt,
-                                 {"reader": ri, "seq": seq}
-                                 if trace.active() is not None else None)
-                    nbytes = item.nbytes if isinstance(item, HostChunk) \
-                        else page_nbytes(item)
-                    if not self._stage_put(ri, seq, item, nbytes):
-                        return
-                    seq += 1
-                if not self._stage_put(ri, seq, _DONE, 0):
-                    return
-        except BaseException as e:  # noqa: BLE001 - surfaced to the consumer
-            self._fail(e)
+    def _account(self) -> None:
+        """Publish staged + uploaded-unconsumed bytes into the query memory
+        context. Raises the pool's limit exception when over budget — the
+        stage guard routes it to the consumer, so an over-prefetching query
+        dies loudly instead of wedging."""
+        m = self._memory
+        if m is None:
+            return
+        with self._stats_lock:
+            m.set_bytes(self._staged_bytes + self._out_bytes)
 
-    def _stage_put(self, ri: int, seq: int, item, nbytes: int) -> bool:
+    def _reader_gen(self):
+        """Reader stage: claim split readers one at a time, decode their
+        chunks, admit them to the reorder buffer under the byte budget."""
+        while not self._stop.is_set():
+            with self._cv:
+                ri = self._next_reader
+                if ri >= len(self._readers):
+                    return
+                self._next_reader = ri + 1
+            it = iter(self._readers[ri]())
+            seq = 0
+            while True:
+                t0 = time.perf_counter_ns()
+                try:
+                    item = next(it)
+                except StopIteration:
+                    break
+                dt = time.perf_counter_ns() - t0
+                self._add("read_busy_s", dt / 1e9)
+                trace.record(trace.SCAN, "read", t0, dt,
+                             {"reader": ri, "seq": seq}
+                             if trace.active() is not None else None)
+                nbytes = item.nbytes if isinstance(item, HostChunk) \
+                    else page_nbytes(item)
+                ok = yield from self._stage_put_gen(ri, seq, item, nbytes)
+                if not ok:
+                    return
+                seq += 1
+                yield AGAIN  # fairness checkpoint between chunks
+            ok = yield from self._stage_put_gen(ri, seq, _DONE, 0)
+            if not ok:
+                return
+
+    def _stage_put_gen(self, ri: int, seq: int, item, nbytes: int):
         """Admit one decoded item into the reorder buffer under the byte
         budget. The item the decode stage needs NEXT bypasses a full budget
         (deadlock freedom); returns False when the pipeline stopped."""
         key = (ri, seq)
         t0 = time.perf_counter_ns()
-        with self._cv:
-            while (self._staged_bytes > 0
-                   and self._staged_bytes + nbytes > self._max_bytes
-                   and key != self._needed
-                   and not self._stop.is_set()):
-                self._cv.wait(_WAIT_S)
-            if self._stop.is_set():
-                return False
-            self._buf[key] = (item, nbytes)
-            self._staged_bytes += nbytes
-            self._cv.notify_all()
+        while True:
+            with self._cv:
+                if self._stop.is_set():
+                    return False
+                if not (self._staged_bytes > 0
+                        and self._staged_bytes + nbytes > self._max_bytes
+                        and key != self._needed):
+                    self._buf[key] = (item, nbytes)
+                    self._staged_bytes += nbytes
+                    self._cv.notify_all()
+                    break
+                self._cv.wait(STEP_WAIT_S)
+            yield WAIT
+        self._account()
         dt = time.perf_counter_ns() - t0
         self._add("read_stall_s", dt / 1e9)
         if dt >= _TRACE_STALL_NS:
             trace.record(trace.SCAN, "read_stall", t0, dt)
         return True
 
-    def _stage_take(self, ri: int, seq: int):
-        """Blocking in-order take; None when the pipeline stopped."""
+    def _stage_take_gen(self, ri: int, seq: int):
+        """In-order take from the reorder buffer; returns None when the
+        pipeline stopped."""
         key = (ri, seq)
         t0 = time.perf_counter_ns()
-        with self._cv:
-            self._needed = key
-            self._cv.notify_all()
-            while key not in self._buf and not self._stop.is_set():
-                self._cv.wait(_WAIT_S)
-            if key not in self._buf:
-                return None
-            item, nbytes = self._buf.pop(key)
-            self._staged_bytes -= nbytes
-            self._cv.notify_all()
+        while True:
+            with self._cv:
+                self._needed = key
+                self._cv.notify_all()
+                if key in self._buf:
+                    item, nbytes = self._buf.pop(key)
+                    self._staged_bytes -= nbytes
+                    self._cv.notify_all()
+                    break
+                if self._stop.is_set():
+                    return None
+                self._cv.wait(STEP_WAIT_S)
+            yield WAIT
+        self._account()
         dt = time.perf_counter_ns() - t0
         self._add("decode_stall_s", dt / 1e9)
         if dt >= _TRACE_STALL_NS:
             trace.record(trace.SCAN, "decode_stall", t0, dt)
         return item
 
-    def _decode_loop(self) -> None:
+    def _decode_gen(self):
         """Decode stage: consume the reorder buffer in split order and
         re-batch into device-shaped host pages, handing them to the
-        (separate) upload thread so device_put overlaps re-batching."""
-        try:
-            rb = Rebatcher(self._target) if self._rebatch else None
-            for ri in range(len(self._readers)):
-                seq = 0
-                while True:
-                    item = self._stage_take(ri, seq)
-                    if item is None:
-                        return  # stopped
-                    if item is _DONE:
-                        break
-                    seq += 1
-                    if rb is not None:
-                        t0 = time.perf_counter_ns()
-                        batches = rb.add(item)
-                        dt = time.perf_counter_ns() - t0
-                        self._add("decode_busy_s", dt / 1e9)
-                        trace.record(trace.SCAN, "rebatch", t0, dt)
-                        self._add("chunks", 1)
-                        for page, nbytes, rows in batches:
-                            if not self._emit(page, nbytes, rows):
-                                return
-                    else:
-                        # live rows from the mask when it is host-side; a
-                        # replayed device page would cost a sync to count,
-                        # so its capacity stands in
-                        rows = int(item.mask.sum()) \
-                            if isinstance(item.mask, np.ndarray) \
-                            else item.capacity
-                        if not self._emit(item, page_nbytes(item), rows):
+        (separate) upload stage so device_put overlaps re-batching."""
+        rb = Rebatcher(self._target) if self._rebatch else None
+        for ri in range(len(self._readers)):
+            seq = 0
+            while True:
+                item = yield from self._stage_take_gen(ri, seq)
+                if item is None:
+                    return  # stopped
+                if item is _DONE:
+                    break
+                seq += 1
+                if rb is not None:
+                    t0 = time.perf_counter_ns()
+                    batches = rb.add(item)
+                    dt = time.perf_counter_ns() - t0
+                    self._add("decode_busy_s", dt / 1e9)
+                    trace.record(trace.SCAN, "rebatch", t0, dt)
+                    self._add("chunks", 1)
+                    for page, nbytes, rows in batches:
+                        ok = yield from self._emit_gen(page, nbytes, rows)
+                        if not ok:
                             return
-            if rb is not None:
-                tail = rb.flush()
-                if tail is not None and not self._emit(*tail):
+                else:
+                    # live rows from the mask when it is host-side; a
+                    # replayed device page would cost a sync to count,
+                    # so its capacity stands in
+                    rows = int(item.mask.sum()) \
+                        if isinstance(item.mask, np.ndarray) \
+                        else item.capacity
+                    ok = yield from self._emit_gen(item, page_nbytes(item),
+                                                   rows)
+                    if not ok:
+                        return
+                yield AGAIN  # fairness checkpoint between chunks
+        if rb is not None:
+            tail = rb.flush()
+            if tail is not None:
+                ok = yield from self._emit_gen(*tail)
+                if not ok:
                     return
-            self._upq.put(_EOS)
-        except BaseException as e:  # noqa: BLE001 - surfaced to the consumer
-            self._fail(e)
+        self._upq.put(_EOS)
 
-    def _emit(self, page: Page, nbytes: int, rows: int) -> bool:
+    def _emit_gen(self, page: Page, nbytes: int, rows: int):
         """Admit a decoded page to the upload stage under the byte budget
         on uploaded-but-unconsumed pages (the stall here means the CONSUMER
         is the bottleneck — the healthy state)."""
         t0 = time.perf_counter_ns()
-        with self._ocv:
-            while (self._out_bytes > 0
-                   and self._out_bytes + nbytes > self._max_bytes
-                   and not self._stop.is_set()):
-                self._ocv.wait(_WAIT_S)
-            if self._stop.is_set():
-                return False
-            self._out_bytes += nbytes
+        while True:
+            with self._ocv:
+                if self._stop.is_set():
+                    return False
+                if not (self._out_bytes > 0
+                        and self._out_bytes + nbytes > self._max_bytes):
+                    self._out_bytes += nbytes
+                    break
+                self._ocv.wait(STEP_WAIT_S)
+            yield WAIT
+        self._account()
         dt = time.perf_counter_ns() - t0
         self._add("upload_stall_s", dt / 1e9)
         if dt >= _TRACE_STALL_NS:
@@ -461,33 +566,37 @@ class ScanPipeline:
         self._upq.put((page, nbytes, rows))
         return True
 
-    def _upload_loop(self) -> None:
-        """Dedicated upload stage: issue the (async) device_puts, decoupled
-        from re-batching so host concatenation and host->device transfer
+    def _upload_gen(self):
+        """Upload stage: issue the (async) device_puts, decoupled from
+        re-batching so host concatenation and host->device transfer
         overlap."""
-        try:
-            while True:
-                item = self._upq.get()
-                if item is _EOS or self._stop.is_set():
-                    if self._error is None:  # a _fail already queued _ERR
-                        self._out.put(_EOS)
+        while True:
+            try:
+                item = self._upq.get(timeout=STEP_WAIT_S)
+            except queue.Empty:
+                if self._stop.is_set():
                     return
-                page, nbytes, rows = item
-                t0 = time.perf_counter_ns()
-                dev = jax.tree.map(
-                    lambda a: jax.device_put(a, self._device), page)
-                dt = time.perf_counter_ns() - t0
-                self._add("upload_busy_s", dt / 1e9)
-                trace.record(trace.SCAN, "upload", t0, dt,
-                             {"rows": rows, "bytes": nbytes}
-                             if trace.active() is not None else None)
-                with self._stats_lock:
-                    self._stats["pages"] += 1
-                    self._stats["rows"] += rows
-                    self._stats["bytes"] += nbytes
-                self._out.put((dev, nbytes))
-        except BaseException as e:  # noqa: BLE001 - surfaced to the consumer
-            self._fail(e)
+                yield WAIT
+                continue
+            if item is _EOS or self._stop.is_set():
+                if self._error is None:  # a _fail already queued _ERR
+                    self._out.put(_EOS)
+                return
+            page, nbytes, rows = item
+            t0 = time.perf_counter_ns()
+            dev = jax.tree.map(
+                lambda a: jax.device_put(a, self._device), page)
+            dt = time.perf_counter_ns() - t0
+            self._add("upload_busy_s", dt / 1e9)
+            trace.record(trace.SCAN, "upload", t0, dt,
+                         {"rows": rows, "bytes": nbytes}
+                         if trace.active() is not None else None)
+            with self._stats_lock:
+                self._stats["pages"] += 1
+                self._stats["rows"] += rows
+                self._stats["bytes"] += nbytes
+            self._out.put((dev, nbytes))
+            yield AGAIN  # fairness checkpoint between uploads
 
     def _fail(self, e: BaseException) -> None:
         self._error = e

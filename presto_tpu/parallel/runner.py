@@ -38,6 +38,7 @@ import numpy as np
 
 from ..block import Block, Dictionary, Page
 from ..exec.local_planner import LocalExecutionPlanner
+from ..exec.shared_pools import next_query_key
 from ..exec.task_executor import TaskExecutor
 from ..metadata import CatalogManager, Session
 from ..runner import LocalQueryRunner, QueryResult
@@ -199,10 +200,14 @@ class DistributedQueryRunner:
         frag_dicts: Dict[int, List[Optional[Dictionary]]] = {}
         exchanges: Dict[int, StreamingExchange] = {}
         sink_facs: Dict[int, ExchangeSinkOperatorFactory] = {}
-        query_memory = self.local._query_memory()
+        mem_ctx, over_target, mem_release = self.local._query_memory()
         chunk_rows = int(self.session.get("exchange_chunk_rows") or 0)
         inflight = int(self.session.get("exchange_inflight_bytes") or 0)
         page_cap = int(self.session.get("page_capacity") or (1 << 14))
+        # ONE shared-pool fairness slot per query: every fragment's scan
+        # stages and every exchange pump of this query share it
+        pool_key = next_query_key("mesh-q") \
+            if bool(self.session.get("shared_pools", True)) else None
         drivers = []
         root_ep = None
         try:
@@ -214,8 +219,9 @@ class DistributedQueryRunner:
                 lp = LocalExecutionPlanner(self.metadata, self.session,
                                            n_workers=W,
                                            remote_dicts=frag_dicts,
-                                           devices=self.mesh.devices)
-                lp.attach_memory(*query_memory)
+                                           devices=self.mesh.devices,
+                                           pool_key=pool_key)
+                lp.attach_memory(mem_ctx, over_target)
                 if is_root:
                     ep = lp.plan(root)
                 else:
@@ -228,7 +234,12 @@ class DistributedQueryRunner:
                             self.mesh, _frag.id, _frag.output_kind, _key,
                             types, dicts, orderings=_ord,
                             chunk_rows=chunk_rows, inflight_bytes=inflight,
-                            page_capacity=page_cap, book=book)
+                            page_capacity=page_cap, book=book,
+                            pool_key=pool_key,
+                            # in-flight exchange bytes reserve as the
+                            # query's user memory (unified accounting)
+                            memory=mem_ctx.user.new_local_memory_context(
+                                f"exchange_inflight_f{_frag.id}"))
                         fac = ExchangeSinkOperatorFactory(
                             next(_lp._ids), ex, types)
                         _holder["exchange"] = ex
@@ -271,6 +282,9 @@ class DistributedQueryRunner:
                         d.close()
                     except Exception:  # noqa: BLE001 - teardown best effort
                         pass
+            # after every pipeline/exchange tore down: clear this query's
+            # reservations from the process-shared pool
+            mem_release()
 
     def _execute_barrier(self, sub: SubPlan, book: ExchangeStatsBook,
                          frag_drivers: Optional[dict] = None) \
@@ -281,14 +295,16 @@ class DistributedQueryRunner:
         # ONE memory pool + query context + task executor for the whole
         # query: every fragment's operators draw on the same budget and the
         # runner threads are reused across stages instead of rebuilt
-        query_memory = self.local._query_memory()
+        mem_ctx, over_target, mem_release = self.local._query_memory()
         executor = TaskExecutor(int(self.session.get("task_concurrency")),
                                 persistent=True)
         try:
-            return self._run_barrier_stages(sub, executor, query_memory,
+            return self._run_barrier_stages(sub, executor,
+                                            (mem_ctx, over_target),
                                             book, frag_drivers)
         finally:
             executor.close()
+            mem_release()
 
     def _run_barrier_stages(self, sub: SubPlan, executor: TaskExecutor,
                             query_memory, book: ExchangeStatsBook,
@@ -297,6 +313,10 @@ class DistributedQueryRunner:
         W = self.mesh.n_workers
         frag_dicts: Dict[int, List[Optional[Dictionary]]] = {}
         routed: Dict[int, List[List[Page]]] = {}  # fid -> per-worker pages
+        # one shared-pool fairness slot per QUERY (not per fragment) — the
+        # same invariant the streaming path and the cluster tier keep
+        pool_key = next_query_key("mesh-q") \
+            if bool(self.session.get("shared_pools", True)) else None
         for frag in sub.fragments:
             is_root = frag is sub.root_fragment
             root = self._fragment_root(sub, frag)
@@ -305,7 +325,8 @@ class DistributedQueryRunner:
             # the jit-compiled kernels); only splits/exchange pages differ
             lp = LocalExecutionPlanner(self.metadata, self.session,
                                        n_workers=W, remote_dicts=frag_dicts,
-                                       devices=self.mesh.devices)
+                                       devices=self.mesh.devices,
+                                       pool_key=pool_key)
             lp.attach_memory(*query_memory)
             ep = lp.plan(root)
             for fid, slot in ep.remote_slots.items():

@@ -44,6 +44,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from ..block import Block, Dictionary, Page
+from ..exec.shared_pools import AGAIN, EXCHANGE_POOL, STEP_WAIT_S, WAIT
 from ..ops.local_exchange import LocalExchangeBuffer, LocalExchangeSource
 from ..ops.operator import Operator, OperatorContext, OperatorFactory, timed
 from ..ops.scan_pipeline import page_nbytes
@@ -400,7 +401,8 @@ class StreamingExchange:
                  dicts: Sequence[Optional[Dictionary]],
                  orderings=None, chunk_rows: int = 0,
                  inflight_bytes: int = 0, page_capacity: int = 1 << 14,
-                 book: Optional[ExchangeStatsBook] = None):
+                 book: Optional[ExchangeStatsBook] = None,
+                 pool_key: Optional[str] = None, memory=None):
         self.mesh = mesh
         self.fragment_id = fragment_id
         self.kind = kind
@@ -436,6 +438,21 @@ class StreamingExchange:
                                          max_bytes=per_worker_bytes)
                      for _ in range(W)]
         self._pump: Optional[threading.Thread] = None
+        # pool_key set: the pump runs as generator steps on the process-wide
+        # EXCHANGE_POOL under the query's fairness slot; None = a dedicated
+        # pump thread (the shared_pools=False oracle)
+        self._pool_key = pool_key
+        self._pool = None
+        self._pump_started = False
+        self._pump_done = threading.Event()
+        # per-query memory context: in-flight bytes (staged producer pages +
+        # delivered-unconsumed consumer queues) reserve as user memory so
+        # exchange buffering competes with operator state in the query pool
+        self._memory = memory
+        self._mem_lock = threading.Lock()
+        # owning query's flight recorder (re-bound by the pump thread; pool
+        # steps re-bind the recorder captured at submit)
+        self._recorder = trace.active()
         self._finished_ok = False
         # stats (pump-thread private until publish)
         self.stats = {"fragment": fragment_id, "kind": kind,
@@ -453,6 +470,11 @@ class StreamingExchange:
             self._open_producers = n_producers
             self._cv.notify_all()
         record_exchange_stat("exchanges", 1, self.book)
+        self._pump_started = True
+        if self._pool_key:
+            self._pool = EXCHANGE_POOL.client(self._pool_key)
+            self._pool.submit(self._pump_steps())
+            return
         self._pump = threading.Thread(
             target=self._pump_loop, daemon=True,
             name=f"exchange-pump-f{self.fragment_id}")
@@ -461,8 +483,8 @@ class StreamingExchange:
     def close(self, error: Optional[BaseException] = None) -> None:
         """Tear down: wake every blocked party, poison the consumer queues
         (so a consumer blocked mid-stream raises instead of silently seeing
-        a truncated input) and join the pump. Idempotent; a no-op after a
-        clean pump finish except for the thread join."""
+        a truncated input) and wait for the pump to retire. Idempotent; a
+        no-op after a clean pump finish except for the bounded wait."""
         with self._cv:
             self._closed = True
             if error is not None and self._error is None:
@@ -479,6 +501,14 @@ class StreamingExchange:
                 b.poison(exc)
         if self._pump is not None:
             self._pump.join(timeout=10.0)
+        elif self._pump_started:
+            self._pump_done.wait(timeout=10.0)
+        if self._pool is not None:
+            self._pool.release()
+            self._pool = None
+        if self._memory is not None:
+            with self._mem_lock:
+                self._memory.close()  # reservation dies with the exchange
 
     # ---------------------------------------------------------- producer api
 
@@ -495,6 +525,9 @@ class StreamingExchange:
             self._inbox[worker].append(page)
             self._inbox_bytes += page_nbytes(page)
             self._cv.notify_all()
+        # over-budget raises HERE, on the producer driver: the query dies
+        # with the memory-limit error instead of buffering past its pool
+        self._charge_memory()
 
     def has_capacity(self) -> bool:
         """Producer backpressure poll. True also on error/close so parked
@@ -519,8 +552,18 @@ class StreamingExchange:
     # -------------------------------------------------------------- the pump
 
     def _pump_loop(self) -> None:
+        """Dedicated-thread scheduler (shared_pools=False): drain the pump
+        generator; its internal bounded waits provide the blocking cadence."""
+        with trace.bound(self._recorder):
+            for _ in self._pump_steps():
+                pass
+
+    def _pump_steps(self):
+        """The pump's outer guard as a generator: one logic, two schedulers
+        (a dedicated thread, or steps on the shared EXCHANGE_POOL under the
+        query's fairness slot)."""
         try:
-            self._pump_run()
+            yield from self._pump_gen()
         except _Closed:
             pass  # close() already poisoned the consumer side
         except BaseException as e:  # noqa: BLE001 - relayed to both sides
@@ -539,6 +582,7 @@ class StreamingExchange:
             # publishes what it measured — chunk counts bumped at dispatch
             # must never appear without their overlap/stall attribution
             self._publish_stats()
+            self._pump_done.set()
 
     def _check_live(self) -> None:
         with self._cv:
@@ -547,7 +591,21 @@ class StreamingExchange:
             if self._closed:
                 raise _Closed()
 
-    def _pump_run(self) -> None:
+    def _charge_memory(self) -> None:
+        """Publish staged + delivered-unconsumed bytes into the query memory
+        context (producer drivers and the pump both call this — hence the
+        dedicated lock). Raises the pool's limit exception when over
+        budget; callers let it propagate so the query fails loudly."""
+        m = self._memory
+        if m is None:
+            return
+        out_bytes = sum(b.buffered_bytes() for b in self._out)
+        with self._cv:
+            inbox = self._inbox_bytes
+        with self._mem_lock:
+            m.set_bytes(inbox + out_bytes)
+
+    def _pump_gen(self):
         W = self.W
         devices = self.mesh.devices
         state = [self._fresh_chunk(w) for w in range(W)]
@@ -566,26 +624,33 @@ class StreamingExchange:
                 # the pump is about to park: hand the in-flight chunk to the
                 # consumers now instead of letting it ride until the next
                 # dispatch (double buffering must never become starvation)
-                self._deliver(pending_delivery)
+                yield from self._deliver_gen(pending_delivery)
                 pending_delivery = None
             with self._cv:
                 t0 = time.perf_counter_ns()
-                while not any(self._inbox) and \
+                waited = False
+                if not any(self._inbox) and \
                         (self._open_producers is None or
                          self._open_producers > 0) and \
                         self._error is None and not self._closed:
-                    self._cv.wait(timeout=0.05)
-                stalled = time.perf_counter_ns() - t0
-                self.stats["stall_s"] += stalled / 1e9
-                if stalled >= 1_000_000:  # >= 1ms: a real starvation window
-                    trace.record(trace.EXCHANGE,
-                                 f"pump_stall f{self.fragment_id}",
-                                 t0, stalled)
+                    # ONE bounded wait per step, not wait-until-work: a
+                    # starved pump frees its pool worker every STEP_WAIT_S
+                    self._cv.wait(timeout=STEP_WAIT_S)
+                    waited = True
+                    stalled = time.perf_counter_ns() - t0
+                    self.stats["stall_s"] += stalled / 1e9
+                    if stalled >= 1_000_000:  # >= 1ms: real starvation
+                        trace.record(trace.EXCHANGE,
+                                     f"pump_stall f{self.fragment_id}",
+                                     t0, stalled)
                 drained = self._inbox
                 self._inbox = [[] for _ in range(W)]
                 producers_done = (self._open_producers is not None and
                                   self._open_producers <= 0)
             self._check_live()
+            if waited and not any(drained) and not producers_done:
+                yield WAIT  # still starved: park, other queries' pumps run
+                continue
 
             # ---- ingest drained pages into the absorb queues --------------
             for w in range(W):
@@ -593,7 +658,8 @@ class StreamingExchange:
                     queue[w].append(self._page_columns(p, devices[w]))
 
             # ---- absorb, dispatching whenever a chunk fills ---------------
-            pending_delivery = self._absorb(state, queue, pending_delivery)
+            pending_delivery = yield from self._absorb_gen(
+                state, queue, pending_delivery)
 
             if producers_done and not any(queue) and \
                     not any(s.count for s in state):
@@ -602,12 +668,11 @@ class StreamingExchange:
                 # flush: drain partial chunks (and any carry they generate)
                 while any(queue) or any(s.count for s in state):
                     self._check_live()
-                    pending_delivery = self._absorb(state, queue,
-                                                    pending_delivery,
-                                                    flush=True)
+                    pending_delivery = yield from self._absorb_gen(
+                        state, queue, pending_delivery, flush=True)
                 break
         if pending_delivery is not None:
-            self._deliver(pending_delivery)
+            yield from self._deliver_gen(pending_delivery)
 
     # ------------------------------------------------------------ page intake
 
@@ -669,7 +734,8 @@ class StreamingExchange:
 
     # ---------------------------------------------------------------- absorb
 
-    def _absorb(self, state, queue, pending_delivery, flush: bool = False):
+    def _absorb_gen(self, state, queue, pending_delivery,
+                    flush: bool = False):
         """Move queued pages into chunk buffers; dispatch whenever a worker's
         chunk fills with more rows waiting (or, in flush mode, whenever any
         rows remain at all). Returns the still-undelivered dispatch."""
@@ -713,7 +779,14 @@ class StreamingExchange:
                 must_dispatch = True
             if not must_dispatch:
                 return pending_delivery
-            pending_delivery = self._dispatch(state, queue, pending_delivery)
+            new_pending = self._dispatch(state, queue)
+            # deliver the PREVIOUS chunk now that this one is in flight —
+            # its live-count sync overlaps the new in-flight collective
+            # (double buffering)
+            if pending_delivery is not None:
+                yield from self._deliver_gen(pending_delivery)
+            pending_delivery = new_pending
+            yield AGAIN  # fairness checkpoint between chunk dispatches
 
     def _release_bytes(self, n: int) -> None:
         """A page absorbed into chunk buffers stops counting against the
@@ -722,6 +795,7 @@ class StreamingExchange:
         with self._cv:
             self._inbox_bytes = max(0, self._inbox_bytes - n)
             self._cv.notify_all()
+        self._charge_memory()  # releasing can only shrink the reservation
 
     # -------------------------------------------------------------- dispatch
 
@@ -733,13 +807,14 @@ class StreamingExchange:
             (self.W * L,), NamedSharding(self.mesh.mesh, P(WORKER_AXIS)),
             shards)
 
-    def _dispatch(self, state, queue, pending_delivery):
-        """Issue the collective for the current chunks (async), re-queue the
-        carry at the BACK of the absorb queue (its live count is an output
-        of this collective — back placement plus the deferred sync keep the
-        next chunk's fill off the collective's critical path), THEN deliver
-        the previous dispatch — its live-count sync overlaps this chunk's
-        in-flight collective (double buffering)."""
+    def _dispatch(self, state, queue):
+        """Issue the collective for the current chunks (async) and re-queue
+        the carry at the BACK of the absorb queue (its live count is an
+        output of this collective — back placement plus the deferred sync
+        keep the next chunk's fill off the collective's critical path). The
+        caller delivers the PREVIOUS dispatch after this one is in flight —
+        its live-count sync overlaps the new collective (double
+        buffering)."""
         W, C = self.W, self.chunk_rows
         ncols = len(self.types)
         t0 = time.perf_counter_ns()
@@ -809,9 +884,6 @@ class StreamingExchange:
                     tuple(carry_cols[c][w] for c in range(ncols)),
                     tuple(carry_cols[ncols + c][w] for c in range(ncols)),
                     carry_per_worker[w], is_carry=True))
-        # deliver the PREVIOUS chunk now that this one is in flight
-        if pending_delivery is not None:
-            self._deliver(pending_delivery)
         # the dispatch timestamp + chunk number ride along so delivery can
         # histogram the FULL chunk latency (collective issue -> pages on
         # the consumer queues)
@@ -856,10 +928,11 @@ class StreamingExchange:
             out[start // L] = sh.data
         return out
 
-    def _deliver(self, dispatched) -> None:
+    def _deliver_gen(self, dispatched):
         """Compact each worker's received shard and enqueue it as standard
-        pow2 pages on the consumer queue (blocking on the queue's byte bound
-        — the downstream half of the backpressure loop)."""
+        pow2 pages on the consumer queue (parking on the queue's byte bound
+        — the downstream half of the backpressure loop; a full queue parks
+        the pump STEP, never a pool worker)."""
         import jax
         import jax.numpy as jnp
 
@@ -903,10 +976,13 @@ class StreamingExchange:
                                         out_d[c][off:off + cap], nm,
                                         self.dicts[c]))
                 page = Page(tuple(blocks), out_m[off:off + cap])
-                self._out[w].put(page, block=True)
+                while not self._out[w].try_put(page, wait_s=STEP_WAIT_S):
+                    self._check_live()
+                    yield WAIT  # consumer backpressure: park the step
             self.stats["rows_out"] += live_w
             if self.book is not None:
                 self.book.bump("rows", live_w)
+        self._charge_memory()
         end = time.perf_counter_ns()
         # per-chunk latency = dispatch issue -> pages delivered; the /v1/
         # metrics percentiles the serving roadmap needs come from here

@@ -8,6 +8,7 @@ benchmarks — no HTTP, real operators. The distributed runner
 from __future__ import annotations
 
 import dataclasses
+import itertools
 from typing import Dict, List, Optional
 
 from .connectors.tpch.connector import TpchConnector
@@ -65,6 +66,11 @@ class QueryResult:
     # (utils/trace.py), set when the `query_trace` session knob is on;
     # loads directly in Perfetto / chrome://tracing
     trace_path: Optional[str] = None
+
+
+# unique per-query ids in the process-shared memory pool (itertools.count
+# is atomic under the GIL, so concurrent submits never collide)
+_QUERY_MEM_SEQ = itertools.count(1)
 
 
 def _scan_pipeline_stats(drivers) -> Optional[dict]:
@@ -351,7 +357,8 @@ class LocalQueryRunner:
         # target columns
         plan = self.plan_statement(stmt.query)
         local = LocalExecutionPlanner(self.metadata, self.session)
-        local.attach_memory(*self._query_memory())
+        mem, over_target, mem_release = self._query_memory()
+        local.attach_memory(mem, over_target)
         exec_plan = local.plan(plan)
 
         from .types import ArrayType, MapType
@@ -490,11 +497,18 @@ class LocalQueryRunner:
             TaskExecutor(
                 int(self.session.get("task_concurrency"))).execute(drivers)
         except BaseException:
+            for d in drivers:
+                try:
+                    d.close()
+                except Exception:  # noqa: BLE001 - teardown best effort
+                    pass
             for s in writer_fac.sinks:
                 s.abort()
             if created:  # CTAS is atomic: roll the metadata back on failure
                 meta.drop_table(handle)
             raise
+        finally:
+            mem_release()
         fragments = [p for s in writer_fac.sinks for p in s.finish()]
         meta.finish_insert(insert_handle, fragments)
         total = sum(r[0] for r in count_sink.rows())
@@ -520,19 +534,36 @@ class LocalQueryRunner:
         profile always measures the pipeline the query actually runs."""
         import time as _time
 
-        with trace.span(trace.LIFECYCLE, "local_plan"):
-            local = LocalExecutionPlanner(self.metadata, self.session,
-                                          bucket_filter=bucket_filter)
-            local.attach_memory(*self._query_memory())
-            exec_plan = local.plan(plan)
-            drivers = exec_plan.create_drivers()
-        t0 = _time.perf_counter()
-        # task executor: build/probe pipelines overlap on runner threads
-        # (blocked probes park until their lookup slot resolves)
-        with trace.span(trace.LIFECYCLE, "execute"):
-            TaskExecutor(
-                int(self.session.get("task_concurrency"))).execute(drivers)
-        return exec_plan, drivers, _time.perf_counter() - t0
+        mem, over_target, release = self._query_memory()
+        try:
+            with trace.span(trace.LIFECYCLE, "local_plan"):
+                local = LocalExecutionPlanner(self.metadata, self.session,
+                                              bucket_filter=bucket_filter)
+                local.attach_memory(mem, over_target)
+                exec_plan = local.plan(plan)
+                drivers = exec_plan.create_drivers()
+            t0 = _time.perf_counter()
+            # task executor: build/probe pipelines overlap on runner threads
+            # (blocked probes park until their lookup slot resolves)
+            try:
+                with trace.span(trace.LIFECYCLE, "execute"):
+                    TaskExecutor(
+                        int(self.session.get("task_concurrency"))
+                    ).execute(drivers)
+            except BaseException:
+                # abandoned drivers' pipelines must tear down BEFORE the
+                # query's reservations are cleared from the shared pool, or
+                # a still-running stage would re-reserve phantom bytes that
+                # outlive the query (the pool is process-shared now)
+                for d in drivers:
+                    try:
+                        d.close()
+                    except Exception:  # noqa: BLE001 - teardown best effort
+                        pass
+                raise
+            return exec_plan, drivers, _time.perf_counter() - t0
+        finally:
+            release()
 
     def _explain_analyze(self, stmt: t.Query) -> str:
         """EXPLAIN ANALYZE: execute, then render the plan with per-operator
@@ -569,17 +600,30 @@ class LocalQueryRunner:
         return "\n".join(lines)
 
     def _query_memory(self):
-        """Per-query memory root drawing on a GENERAL pool; the returned probe
-        fires when the pool crosses the revoke target (MemoryRevokingScheduler
-        trigger condition) so operators spill device state to host."""
-        from .memory import GENERAL_POOL, MemoryPool, QueryContextMemory
+        """Per-query memory root drawing on the process-SHARED general pool
+        (memory.shared_general_pool): concurrent tenants' operator state,
+        scan prefetch and exchange in-flight bytes all compete in one
+        accounting surface. Returns (memory, over_target, release): the
+        probe fires when the POOL (all tenants) crosses the revoke target —
+        OR when this query alone crosses the target fraction of its
+        session's `memory_pool_bytes`, since the shared pool is grow-only
+        and a tenant configuring a small budget must still get pressure
+        revocation even while the process pool has room; `release` clears
+        this query's reservations at end of query so failed teardowns never
+        leak phantom pressure into later tenants."""
+        from .memory import QueryContextMemory, shared_general_pool
 
-        pool = MemoryPool(GENERAL_POOL, int(self.session.get("memory_pool_bytes")))
+        session_bytes = int(self.session.get("memory_pool_bytes"))
+        pool = shared_general_pool(session_bytes)
+        qid = f"query-{next(_QUERY_MEM_SEQ)}"
         qmem = QueryContextMemory(
-            f"query-{id(self)}", pool,
-            int(self.session.get("query_max_memory_bytes")))
+            qid, pool, int(self.session.get("query_max_memory_bytes")))
         target = float(self.session.get("revoke_target_fraction"))
 
         def over_target() -> bool:
-            return pool.reserved_bytes() > pool.max_bytes * target
-        return qmem.memory, over_target
+            return (pool.reserved_bytes() > pool.max_bytes * target
+                    or pool.query_bytes(qid) > session_bytes * target)
+
+        def release() -> None:
+            pool.clear_query(qid)
+        return qmem.memory, over_target, release

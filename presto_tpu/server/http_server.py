@@ -20,6 +20,7 @@ from __future__ import annotations
 import os
 import json
 import re
+import sys
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -305,6 +306,11 @@ def main(argv=None) -> None:
     ap.add_argument("--etc", default=None,
                     help="config directory (config.properties + "
                          "catalog/*.properties; the reference's etc/ layout)")
+    ap.add_argument("--compile-ahead", nargs="?", const="1,3,6", default=None,
+                    metavar="QIDS",
+                    help="warm the kernel cache with these TPC-H queries "
+                         "(comma-separated ids, default 1,3,6) before "
+                         "serving, so first tenants never pay compile walls")
     args = ap.parse_args(argv)
 
     from ..metadata import Session
@@ -373,6 +379,26 @@ def main(argv=None) -> None:
         from ..runner import LocalQueryRunner
         runner = LocalQueryRunner(session=session, catalogs=catalogs)
         mode = "local"
+    if args.compile_ahead:
+        # worker-start cache warm (tools/compile_ahead.py): the ladder
+        # queries run once so every fused-segment/operator kernel is in the
+        # process kernel cache before the first tenant arrives
+        try:
+            from tools.compile_ahead import warm
+        except ImportError:  # installed without the tools/ tree
+            warm = None
+        qids = tuple(int(x) for x in args.compile_ahead.split(",") if x)
+        if warm is not None:
+            warm(schemas=(session.schema or args.schema,), queries=qids,
+                 session=session)
+        else:
+            from ..models.tpch_sql import QUERIES
+            for qid in qids:
+                try:
+                    runner.execute(QUERIES[qid])
+                except Exception as e:  # noqa: BLE001 - warm what we can
+                    print(f"compile-ahead q{qid}: FAILED {e!r}",
+                          file=sys.stderr)
     server = PrestoTpuServer(runner, port=port, authenticator=authenticator)
     print(f"presto-tpu server listening on :{server.port} "
           f"({mode}, schema={args.schema}"

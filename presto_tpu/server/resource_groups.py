@@ -151,14 +151,36 @@ class ResourceGroupManager:
     submit() either admits immediately, queues (blocking the caller's worker
     thread until capacity frees — the reference parks the query in QUEUED
     state the same way), or rejects when the group's queue is full.
+
+    `memory_limit_bytes` adds memory-aware admission over the process-shared
+    GENERAL pool (memory.shared_general_pool): while reserved bytes — which
+    now include scan prefetch and exchange in-flight buffers, not just
+    operator state — exceed the limit, nothing new is admitted; queued
+    queries promote as running tenants release (the reference's
+    softMemoryLimit admission gate over ClusterMemoryPool, narrowed to one
+    process). `memory_fn` overrides the probe (tests; cluster coordinators
+    wiring their aggregated view).
     """
 
     def __init__(self, root_spec: Optional[GroupSpec] = None,
-                 selectors: Sequence[SelectorSpec] = ()):
+                 selectors: Sequence[SelectorSpec] = (),
+                 memory_limit_bytes: Optional[int] = None,
+                 memory_fn=None):
         self.root = _Group(root_spec or GroupSpec("root", 1 << 30, 1 << 30),
                            None)
         self.selectors = list(selectors)
+        self.memory_limit_bytes = memory_limit_bytes
+        if memory_fn is None and memory_limit_bytes is not None:
+            from ..memory import shared_general_pool
+
+            memory_fn = shared_general_pool().reserved_bytes
+        self._memory_fn = memory_fn
         self._lock = threading.Lock()
+
+    def _memory_ok(self) -> bool:
+        if self.memory_limit_bytes is None or self._memory_fn is None:
+            return True
+        return self._memory_fn() < self.memory_limit_bytes
 
     def _resolve(self, user: str, source: str) -> _Group:
         path = None
@@ -187,7 +209,7 @@ class ResourceGroupManager:
         with self._lock:
             group = self._resolve(user, source)
             ticket = _Ticket(group, query_id)
-            if group.can_run():
+            if group.can_run() and self._memory_ok():
                 group.start()
                 ticket.admitted.set()
                 return ticket
@@ -220,6 +242,8 @@ class ResourceGroupManager:
 
     def _promote_locked(self) -> None:
         while True:
+            if not self._memory_ok():
+                return  # pool over limit: admit nothing until tenants free
             nxt = self.root.eligible_queued()
             if nxt is None:
                 return

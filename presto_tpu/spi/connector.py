@@ -106,6 +106,15 @@ class Split:
 class ConnectorPageSource(abc.ABC):
     """spi/ConnectorPageSource.java:20 — a stream of fixed-capacity masked pages."""
 
+    # True = reads may block INDEFINITELY on progress the engine does not
+    # control (remote tasks over HTTP, another coordinator, a live stream's
+    # future records). The scan pipeline must not step such a source on the
+    # shared worker pool — a read that cannot honor the bounded-step
+    # contract would wedge a pool worker and starve every other query's
+    # stages (including, circularly, the upstream producers this read is
+    # waiting for). Local file/generator reads are pure compute: False.
+    external_wait = False
+
     @abc.abstractmethod
     def __iter__(self) -> Iterator[Page]:
         ...

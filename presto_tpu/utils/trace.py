@@ -13,10 +13,15 @@ records the timeline itself:
   readers, exchange pumps, HTTP clients) append; the ring bound makes the
   recorder safe to leave on under heavy traffic (oldest spans overwrite,
   the drop count is exported).
-- One recorder is INSTALLED process-wide while a traced query runs (the
-  ``query_trace`` session knob). Every instrumentation site goes through
-  the module-level :func:`record`/:func:`span` helpers, which are a single
-  ``is None`` check when tracing is off — the hot paths pay nothing.
+- Recorders are PER-QUERY: :func:`install` binds the query's recorder to
+  its submitting thread, and every component that fans work out to other
+  threads (task-executor runs, scan-pipeline stages, exchange pumps,
+  shared-pool steps) captures :func:`active` at hand-off and re-binds it
+  with :func:`bound` — so concurrently traced queries each export their own
+  complete timeline. A process-global fallback covers ambient threads.
+  Every instrumentation site goes through the module-level
+  :func:`record`/:func:`span` helpers, which are a single thread-local load
+  + ``None`` check when tracing is off — the hot paths pay nothing.
 - Export is Chrome trace-event JSON (the ``{"traceEvents": [...]}`` shape
   that loads directly in Perfetto / ``chrome://tracing``), reachable as
   ``QueryResult.trace_path`` and over ``GET /v1/query/{id}/trace``.
@@ -160,55 +165,92 @@ _NULL_SPAN = _Span(None, "", "", None)
 
 
 # ---------------------------------------------------------------------------
-# the installed recorder: one traced query at a time, process-wide — the
-# background machinery (scan readers, exchange pumps) has no query context,
-# so scoping is by installation window exactly like EXCHANGE_STATS
+# the installed recorder: PER-QUERY scoping. A query's recorder binds to the
+# threads doing its work — install() binds the calling (query) thread, and
+# every engine component that hands work to other threads (TaskExecutor
+# runs, scan-pipeline stages, exchange pumps, shared-pool steps) re-binds
+# the recorder it captured from its submitting thread via bound(). The
+# process-global slot remains only as a FALLBACK for ambient threads with no
+# query affiliation, so the single-traced-query case keeps recording exactly
+# what it did before — while a second traced query under concurrent load now
+# exports its own complete timeline instead of silently running untraced.
 # ---------------------------------------------------------------------------
 
 _ACTIVE: Optional[TraceRecorder] = None
 _ACTIVE_LOCK = threading.Lock()
+_TLS = threading.local()
 
 
 def active() -> Optional[TraceRecorder]:
-    return _ACTIVE
+    r = getattr(_TLS, "recorder", None)
+    return r if r is not None else _ACTIVE
 
 
 def install(recorder: TraceRecorder) -> bool:
-    """Make `recorder` the process's active trace sink. False (and no-op)
-    when another query's recorder is already installed — concurrent traced
-    queries would interleave into one timeline, so the second one simply
-    runs untraced rather than corrupting the first's export."""
+    """Make `recorder` the calling thread's trace sink (and the process
+    fallback, first-installed wins). Always succeeds: concurrent traced
+    queries no longer collide — each query's threads are bound to its own
+    recorder, so the timelines stay separate."""
     global _ACTIVE
+    _TLS.recorder = recorder
     with _ACTIVE_LOCK:
-        if _ACTIVE is not None:
-            return False
-        _ACTIVE = recorder
-        return True
+        if _ACTIVE is None:
+            _ACTIVE = recorder
+    return True
 
 
 def uninstall(recorder: TraceRecorder) -> None:
     global _ACTIVE
+    if getattr(_TLS, "recorder", None) is recorder:
+        _TLS.recorder = None
     with _ACTIVE_LOCK:
         if _ACTIVE is recorder:
             _ACTIVE = None
 
 
+class _Bound:
+    """Context manager binding a recorder to the current thread (and
+    restoring whatever was bound before). Worker threads stepping another
+    query's work wrap each step so spans land on the owning query."""
+
+    __slots__ = ("rec", "prev")
+
+    def __init__(self, rec: Optional[TraceRecorder]):
+        self.rec = rec
+
+    def __enter__(self):
+        self.prev = getattr(_TLS, "recorder", None)
+        _TLS.recorder = self.rec
+        return self.rec
+
+    def __exit__(self, *exc):
+        _TLS.recorder = self.prev
+        return False
+
+
+def bound(recorder: Optional[TraceRecorder]) -> _Bound:
+    """Bind `recorder` (captured via :func:`active` on the submitting
+    thread) around work executed on a different thread."""
+    return _Bound(recorder)
+
+
 def record(cat: str, name: str, t0_ns: int, dur_ns: int,
            args: Optional[dict] = None) -> None:
-    """Hot-path append: one attribute load + None check when tracing is off."""
-    r = _ACTIVE
+    """Hot-path append: one thread-local load + None check when tracing is
+    off."""
+    r = active()
     if r is not None:
         r.record(cat, name, t0_ns, dur_ns, args)
 
 
 def instant(cat: str, name: str, args: Optional[dict] = None) -> None:
-    r = _ACTIVE
+    r = active()
     if r is not None:
         r.instant(cat, name, args)
 
 
 def span(cat: str, name: str, **args) -> _Span:
-    r = _ACTIVE
+    r = active()
     if r is None:
         return _NULL_SPAN
     return _Span(r, cat, name, args or None)

@@ -123,7 +123,8 @@ def test_parallel_build_uses_multiple_drivers():
     plan = r.plan_sql("select count(*) from orders join lineitem "
                       "on o_orderkey = l_orderkey")
     lp = LocalExecutionPlanner(r.metadata, r.session)
-    lp.attach_memory(*r._query_memory())
+    mem, check, _release = r._query_memory()
+    lp.attach_memory(mem, check)
     ep = lp.plan(plan)
     build_pipes = [p for p in ep.pipelines
                    if isinstance(p[-1], JoinBuildOperatorFactory)]

@@ -56,7 +56,7 @@ def test_memory_is_accounted():
     plan = r.plan_sql("select o_custkey, sum(o_totalprice) from orders "
                       "group by o_custkey")
     lp = LocalExecutionPlanner(r.metadata, r.session)
-    mem, check = r._query_memory()
+    mem, check, release = r._query_memory()
     lp.attach_memory(mem, check)
     ep = lp.plan(plan)
     peak = {"v": 0}

@@ -1,8 +1,9 @@
 """Query flight recorder (utils/trace.py) + distributed EXPLAIN ANALYZE.
 
 Coverage per the observability contract:
-- recorder mechanics: ring bound + drop accounting, span helpers, the
-  one-installed-recorder-at-a-time rule;
+- recorder mechanics: ring bound + drop accounting, span helpers, PER-QUERY
+  recorder scoping (thread-local install + bound() propagation, global
+  fallback for ambient threads);
 - tracing OFF is a no-op differential: identical results and zero recorded
   events on a TPC-H Q3 run;
 - tracing ON exports valid Chrome trace-event JSON (pid/tid/ts/dur/ph)
@@ -59,8 +60,6 @@ def test_span_context_manager_and_module_helpers():
 
     assert trace.install(rec)
     try:
-        # one traced query at a time: a second install is refused
-        assert not trace.install(trace.TraceRecorder("other"))
         trace.record("driver", "real", 0, 1)
         with trace.span("kernel", "build"):
             pass
@@ -69,6 +68,43 @@ def test_span_context_manager_and_module_helpers():
     assert trace.active() is None
     cats = {e[0] for e in rec.events()}
     assert cats == {"scan", "driver", "kernel"}
+
+
+def test_per_query_scoping_threads_record_separately():
+    """Concurrent traced queries no longer collide: each thread's install
+    binds its own recorder (thread-local), bound() propagates it to worker
+    threads, and unbound threads fall back to the first-installed global."""
+    rec_a = trace.TraceRecorder("a")
+    rec_b = trace.TraceRecorder("b")
+    ready = threading.Barrier(2)
+    done = threading.Barrier(2)
+
+    def query(rec, name):
+        assert trace.install(rec)
+        try:
+            ready.wait(timeout=10)
+            trace.record("driver", name, 0, 1)
+            done.wait(timeout=10)
+        finally:
+            trace.uninstall(rec)
+
+    t = threading.Thread(target=query, args=(rec_b, "from-b"))
+    t.start()
+    query(rec_a, "from-a")
+    t.join(timeout=10)
+    assert [e[1] for e in rec_a.events()] == ["from-a"]
+    assert [e[1] for e in rec_b.events()] == ["from-b"]
+
+    # bound() hands a query's recorder to a worker thread and restores
+    rec = trace.TraceRecorder("w")
+    def worker():
+        with trace.bound(rec):
+            trace.record("scan", "bound-span", 0, 1)
+        assert trace.active() is None
+    w = threading.Thread(target=worker)
+    w.start()
+    w.join(timeout=10)
+    assert [e[1] for e in rec.events()] == ["bound-span"]
 
 
 def test_chrome_trace_schema(tmp_path):

@@ -1,12 +1,19 @@
-"""Compile-ahead: populate the persistent XLA cache for the north-star set.
+"""Compile-ahead: populate the kernel + XLA caches for the north-star set.
 
 The engine bounds per-query compiled-program count (shape-bucketed pages,
 shared operator kernels via the global kernel cache), but the FIRST process
 on a TPU still pays a remote compile per kernel (~2-40s each through the
 tunnel). This tool runs the measurement-ladder queries once so every kernel
-lands in the persistent compilation cache (`~/.cache/presto_tpu_xla`,
-presto_tpu/__init__.py); afterwards a cold process replays each compile from
-disk in ~0.2s, which is what makes cold end-to-end Q3/Q5 practical.
+lands in the process kernel cache AND the persistent XLA compilation cache
+(`~/.cache/presto_tpu_xla`, presto_tpu/__init__.py); afterwards a cold
+process replays each compile from disk in ~0.2s, which is what makes cold
+end-to-end Q3/Q5 practical.
+
+:func:`warm` is importable — a serving process warms its caches at start
+(``python -m presto_tpu.server --compile-ahead``) so the first tenants of a
+fresh worker never pay compile walls, and the single-flight kernel cache
+means a concurrent thundering herd arriving mid-warm shares the same builds
+instead of duplicating them.
 
 Usage: python tools/compile_ahead.py [--schemas tiny,sf1] [--queries 1,3,5,6,9]
 """
@@ -15,29 +22,55 @@ import sys
 import time
 
 
+def warm(schemas=("tiny",), queries=(1, 3, 6), session=None,
+         verbose: bool = True) -> dict:
+    """Run the given TPC-H queries once per schema through a fresh
+    LocalQueryRunner, filling the process kernel cache (and, transitively,
+    the persistent XLA cache). Returns {"queries", "failed", "seconds",
+    "kernel_cache_entries"}; failures warm what they can."""
+    from presto_tpu.metadata import Session
+    from presto_tpu.models.tpch_sql import QUERIES
+    from presto_tpu.runner import LocalQueryRunner
+    from presto_tpu.utils import kernel_cache
+
+    t_start = time.perf_counter()
+    ran = failed = 0
+    for schema in schemas:
+        base = session or Session(catalog="tpch", schema=schema)
+        import dataclasses
+        runner = LocalQueryRunner(
+            session=dataclasses.replace(base, schema=schema))
+        for qid in queries:
+            t0 = time.perf_counter()
+            try:
+                out = runner.execute(QUERIES[int(qid)])
+                ran += 1
+                if verbose:
+                    print(f"compile-ahead {schema} q{qid}: "
+                          f"{time.perf_counter() - t0:.1f}s, "
+                          f"{len(out.rows)} rows", flush=True)
+            except Exception as e:  # noqa: BLE001 - warm what we can
+                failed += 1
+                print(f"compile-ahead {schema} q{qid}: FAILED {e!r}",
+                      file=sys.stderr, flush=True)
+    return {"queries": ran, "failed": failed,
+            "seconds": round(time.perf_counter() - t_start, 2),
+            "kernel_cache_entries": kernel_cache.stats()["entries"]}
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--schemas", default="tiny,sf1")
     ap.add_argument("--queries", default="1,3,5,6,9")
     args = ap.parse_args()
 
-    from presto_tpu.metadata import Session
-    from presto_tpu.models.tpch_sql import QUERIES
-    from presto_tpu.runner import LocalQueryRunner
-
     qids = [int(x) for x in args.queries.split(",") if x]
-    for schema in args.schemas.split(","):
-        runner = LocalQueryRunner(
-            session=Session(catalog="tpch", schema=schema))
-        for qid in qids:
-            t0 = time.perf_counter()
-            try:
-                out = runner.execute(QUERIES[qid])
-                print(f"{schema} q{qid}: {time.perf_counter() - t0:.1f}s, "
-                      f"{len(out.rows)} rows", flush=True)
-            except Exception as e:  # noqa: BLE001 - warm what we can
-                print(f"{schema} q{qid}: FAILED {e!r}", file=sys.stderr,
-                      flush=True)
+    schemas = [s for s in args.schemas.split(",") if s]
+    summary = warm(schemas=schemas, queries=qids)
+    print(f"compile-ahead: {summary['queries']} queries warmed "
+          f"({summary['failed']} failed) in {summary['seconds']}s, "
+          f"{summary['kernel_cache_entries']} kernel-cache entries",
+          flush=True)
 
 
 if __name__ == "__main__":

@@ -43,6 +43,11 @@ def main(argv: Optional[List[str]] = None) -> int:
                              "passes (lock-order, shared-state-race) see "
                              "only the changed files; the tier-1 gate "
                              "still runs the full tree")
+    parser.add_argument("--lock-graph-diff", metavar="DUMP_JSON",
+                        help="compare a locksan SANITIZER.dump() file's "
+                             "runtime acquisition-order edges against the "
+                             "static lock-discipline graph and report the "
+                             "edges the static resolver missed")
     args = parser.parse_args(argv)
 
     if args.list_passes:
@@ -65,6 +70,32 @@ def main(argv: Optional[List[str]] = None) -> int:
         print(f"prestocheck: no such path(s): {', '.join(missing)}",
               file=sys.stderr)
         return 2
+
+    if args.lock_graph_diff:
+        from .lockdiff import diff_dump_path
+
+        try:
+            diff = diff_dump_path(args.lock_graph_diff, paths)
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"prestocheck: cannot read lock dump: {e}",
+                  file=sys.stderr)
+            return 2
+        if args.as_json:
+            print(json.dumps(diff, indent=1))
+        else:
+            for m in diff["missing"]:
+                print(f"runtime edge missing from static graph: "
+                      f"{m['held']} -> {m['acquired']}  "
+                      f"(held@{m['held_site']}, acquired@{m['site']})")
+            for s in diff["unmapped"]:
+                print(f"unmapped allocation site: {s}")
+        print(f"prestocheck: lock-graph diff — "
+              f"{diff['runtime_edges']} runtime edges, "
+              f"{diff['matched']} matched, {len(diff['missing'])} missing, "
+              f"{len(diff['unmapped'])} unmapped sites", file=sys.stderr)
+        # informational (exit 0): missing edges become static-pass fixtures,
+        # they are not CI failures by themselves
+        return 0
     if args.changed_only and args.update_baseline:
         # the update would rewrite the baseline from only the changed files,
         # silently dropping every unchanged file's grandfathered entries

@@ -1,0 +1,187 @@
+"""Runtime -> static lock-graph diff.
+
+The runtime lock sanitizer (presto_tpu/utils/locksan.py) records the REAL
+acquisition-order graph — edges created through dynamic dispatch, callbacks
+and data-structure lock hand-off that the static ``lock-discipline``
+resolver cannot see. Until now comparing the two graphs was a manual
+dump-and-eyeball step; this module automates it:
+
+    python -m tools.prestocheck --lock-graph-diff dump.json [paths...]
+
+where ``dump.json`` is :func:`LockSanitizer.dump` output. Runtime lock
+names are ALLOCATION SITES (``presto_tpu/ops/scan.py:52``); static lock ids
+are USE names (``presto_tpu.ops.scan.ScanPipeline._cv``). The bridge is an
+AST scan for lock allocations (``self._cv = threading.Condition()``,
+``_LOCK = threading.Lock()``): every allocation statement maps its source
+lines to the id the static pass would assign to acquisitions of that
+variable. ``threading.Condition(self._lock)`` aliases the condition name to
+the wrapped lock's name (the sanitizer names such a condition by the inner
+lock's site), so both spellings canonicalize to one node.
+
+The report: every runtime edge whose canonical (held, acquired) pair is
+absent from the static pass's final edge set — each one is a candidate
+fixture/extension for the static resolver — plus the sites the AST scan
+could not map (locks allocated by code outside the scanned roots).
+"""
+from __future__ import annotations
+
+import ast
+import json
+import os
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from .core import Module, load_modules, terminal_attr
+from .passes.lock_discipline import LockDisciplinePass, _module_name
+
+_LOCK_FACTORIES = {"Lock", "RLock", "Condition", "Semaphore",
+                   "BoundedSemaphore"}
+
+
+def _is_lock_alloc(call: ast.Call) -> bool:
+    """`threading.Lock()` / `locksan.Condition(...)` / bare `Condition()`
+    (imported from threading) — the allocations the sanitizer instruments."""
+    f = call.func
+    if isinstance(f, ast.Attribute) and f.attr in _LOCK_FACTORIES:
+        return True
+    if isinstance(f, ast.Name) and f.id in _LOCK_FACTORIES:
+        return True
+    return False
+
+
+def _target_id(target: ast.AST, modname: str, cls: Optional[str]
+               ) -> Optional[str]:
+    """The static-pass lock id a `with <target>:` over this assignment
+    target would produce (lock_discipline.lock_id's naming)."""
+    if isinstance(target, ast.Attribute) and \
+            isinstance(target.value, ast.Name) and \
+            target.value.id in ("self", "cls") and cls:
+        return f"{modname}.{cls}.{target.attr}"
+    if isinstance(target, ast.Name):
+        return f"{modname}.{target.id}"
+    term = terminal_attr(target)
+    return f"{modname}.{term}" if term else None
+
+
+class _SiteMap:
+    """(relpath, lineno) -> static lock id, plus alias groups for
+    Condition-over-lock pairs."""
+
+    def __init__(self):
+        # path -> [(lo_line, hi_line, lock_id)]
+        self.ranges: Dict[str, List[Tuple[int, int, str]]] = {}
+        self.aliases: Dict[str, str] = {}  # id -> canonical id
+
+    def add(self, path: str, lo: int, hi: int, lock_id: str) -> None:
+        self.ranges.setdefault(path, []).append((lo, hi, lock_id))
+
+    def alias(self, a: str, b: str) -> None:
+        self.aliases[self.canon(a)] = self.canon(b)
+
+    def canon(self, lock_id: str) -> str:
+        seen = set()
+        while lock_id in self.aliases and lock_id not in seen:
+            seen.add(lock_id)
+            lock_id = self.aliases[lock_id]
+        return lock_id
+
+    def resolve_site(self, site: str) -> Optional[str]:
+        """'presto_tpu/ops/scan.py:52' -> canonical lock id, or None."""
+        path, _, lineno = site.rpartition(":")
+        try:
+            line = int(lineno)
+        except ValueError:
+            return None
+        for lo, hi, lock_id in self.ranges.get(path.replace(os.sep, "/"),
+                                               ()):
+            if lo <= line <= hi:
+                return self.canon(lock_id)
+        return None
+
+
+def _scan_allocations(modules: Sequence[Module]) -> _SiteMap:
+    from .core import REPO_ROOT
+
+    smap = _SiteMap()
+    for module in modules:
+        modname = _module_name(module.path)
+        rel = os.path.relpath(os.path.abspath(module.path), REPO_ROOT)
+        rel = rel.replace(os.sep, "/")
+
+        def visit(node: ast.AST, cls: Optional[str]):
+            if isinstance(node, ast.ClassDef):
+                for child in node.body:
+                    visit(child, node.name)
+                return
+            if isinstance(node, (ast.Assign, ast.AnnAssign)):
+                value = node.value
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else ([node.target] if node.target is not None else [])
+                if isinstance(value, ast.Call) and _is_lock_alloc(value) \
+                        and targets:
+                    lock_id = _target_id(targets[0], modname, cls)
+                    if lock_id:
+                        smap.add(rel, node.lineno,
+                                 getattr(node, "end_lineno", node.lineno),
+                                 lock_id)
+                        # Condition(self._lock): the sanitizer names the
+                        # condition by the wrapped lock's allocation site —
+                        # canonicalize the two ids to one node
+                        if value.args:
+                            inner = _target_id(value.args[0], modname, cls)
+                            if inner:
+                                smap.alias(lock_id, inner)
+            for child in ast.iter_child_nodes(node):
+                visit(child, cls)
+
+        visit(module.tree, None)
+    return smap
+
+
+def diff_dump(dump: dict, paths: Sequence[str]) -> dict:
+    """Compare a SANITIZER.dump() document's runtime acquisition-order
+    edges against the static lock-discipline graph over `paths`.
+
+    -> {"runtime_edges", "matched", "missing": [...], "unmapped": [...]}
+    where `missing` lists runtime edges absent from the static graph (the
+    static resolver's blind spots — candidate fixtures) and `unmapped`
+    lists allocation sites the AST scan could not attribute."""
+    modules = load_modules(paths)
+    lp = LockDisciplinePass()
+    for m in modules:
+        lp.check_module(m)
+    lp.finish(modules)
+    smap = _scan_allocations(modules)
+    static_edges = {(smap.canon(a), smap.canon(b))
+                    for (a, b) in lp.final_edges}
+
+    missing: List[dict] = []
+    unmapped: List[str] = []
+    matched = 0
+    for edge in dump.get("edges", []):
+        held_site, acq_site = edge.get("held", ""), edge.get("acquired", "")
+        held_id = smap.resolve_site(held_site)
+        acq_id = smap.resolve_site(acq_site)
+        for site, lock_id in ((held_site, held_id), (acq_site, acq_id)):
+            if lock_id is None and site not in unmapped:
+                unmapped.append(site)
+        if held_id is None or acq_id is None:
+            continue
+        if held_id == acq_id:
+            matched += 1  # alias-collapsed self-edge: not an ordering fact
+        elif (held_id, acq_id) in static_edges:
+            matched += 1
+        else:
+            missing.append({"held": held_id, "acquired": acq_id,
+                            "held_site": held_site,
+                            "acquired_site": acq_site,
+                            "site": edge.get("site", "")})
+    return {"runtime_edges": len(dump.get("edges", [])),
+            "static_edges": len(static_edges),
+            "matched": matched,
+            "missing": missing,
+            "unmapped": sorted(unmapped)}
+
+
+def diff_dump_path(dump_path: str, paths: Sequence[str]) -> dict:
+    with open(dump_path, "r", encoding="utf-8") as f:
+        return diff_dump(json.load(f), paths)

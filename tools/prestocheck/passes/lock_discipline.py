@@ -288,6 +288,12 @@ class LockDisciplinePass(Pass):
                         edges.setdefault((site.held, lid),
                                          (site.path, site.lineno))
 
+        # the full static acquisition-order edge set (lexical + resolved
+        # cross-module call edges) — kept for the runtime->static diff
+        # (tools/prestocheck/lockdiff.py compares SANITIZER.dump() output
+        # against exactly this graph)
+        self.final_edges = edges
+
         graph: Dict[str, Set[str]] = {}
         for (a, b) in edges:
             graph.setdefault(a, set()).add(b)
