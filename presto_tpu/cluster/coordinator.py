@@ -177,11 +177,19 @@ class ClusterQueryRunner:
                     result = run()
             else:
                 result = run()
+        except BaseException as e:
+            # failure forensics: a FAILED / OOM-killed / retry-exhausted
+            # query dumps its always-on coarse ring (task-create/poll HTTP,
+            # result pulls, retry lifecycle) pinned to the exception — the
+            # protocol layer serves it at GET /v1/query/{id}/trace
+            if installed:
+                trace.attach_failure(e, rec, session)
+            raise
         finally:
             if installed:
                 trace.uninstall(rec)
         METRICS.histogram("query.wall_s", _time.perf_counter() - t0)
-        if installed:
+        if installed and not rec.coarse:
             result.trace_path = trace.export(rec, session)
         return result
 
@@ -210,6 +218,7 @@ class ClusterQueryRunner:
         stats = {"retry_policy": policy, "query_attempts": 0,
                  "task_attempts": 0, "task_retries": 0,
                  "faults_injected": 0, "backoff_s": 0.0}
+        failure_trace: Optional[str] = None
         while True:
             stats["query_attempts"] += 1
             try:
@@ -229,6 +238,22 @@ class ClusterQueryRunner:
                         or stats["query_attempts"] > max_retries:
                     raise
                 METRICS.count("cluster.query_retries")
+                # the query will be retried and may well SUCCEED — dump the
+                # failed attempt's coarse ring now (first failure wins, it
+                # saw the original fault) so the eventual QueryResult still
+                # carries the forensic of what went wrong mid-flight
+                rec = trace.active()
+                if failure_trace is None and rec is not None:
+                    try:
+                        failure_trace = trace.export(rec, session,
+                                                     suffix="-forensic")
+                    except Exception:  # noqa: BLE001 - forensics best-effort
+                        pass
+                from ..utils import events
+                events.emit("query.retry", severity=events.WARN,
+                            attempt=stats["query_attempts"],
+                            error=type(e).__name__, message=str(e)[:300],
+                            excluded_nodes=sorted(excluded))
                 backoff.failure()
                 backoff.wait()
         stats["backoff_s"] = round(
@@ -237,6 +262,7 @@ class ClusterQueryRunner:
             if injector else 0
         METRICS.count("cluster.backoff_seconds", stats["backoff_s"])
         result.stats = stats
+        result.failure_trace_path = failure_trace
         return result
 
     def _execute_attempt(self, sql: str, policy: str, excluded: Set[str],
@@ -254,13 +280,19 @@ class ClusterQueryRunner:
                                       retry_policy=policy,
                                       excluded_nodes=excluded)
         self._schedulers[query_id] = scheduler
+        unregister = self._register_progress(query_id, scheduler)
         try:
             scheduler.schedule()
             return self._pull_results(scheduler, sub)
-        except BaseException:
+        except BaseException as e:
+            from ..utils import events
+            events.emit("query.attempt_failed", severity=events.ERROR,
+                        query_id=query_id, error=type(e).__name__,
+                        message=str(e)[:300])
             scheduler.abort()
             raise
         finally:
+            unregister()
             stats["task_attempts"] += scheduler.task_attempts
             stats["task_retries"] += scheduler.task_retries
             stats["backoff_s"] += scheduler.backoff_s
@@ -268,6 +300,24 @@ class ClusterQueryRunner:
             # free finished tasks' buffers/state on the workers
             for task in scheduler.all_tasks():
                 task.cancel(abort=False)
+
+    @staticmethod
+    def _register_progress(query_id: str, scheduler: SqlQueryScheduler):
+        """Live progress (exec/progress.py): while the attempt runs, serve
+        the freshest TaskInfo.operator_stats the monitor's 0.5s polls
+        already collect, rolled up cluster-side — per-operator rows/blocked
+        counters of a RUNNING query at GET /v1/query/{id}. No extra RPCs:
+        the provider re-reads the cached infos."""
+        from ..exec import progress
+
+        def live() -> dict:
+            ops = []
+            for task in scheduler.all_tasks():
+                info = task.info
+                if info is not None and info.operator_stats:
+                    ops.extend(info.operator_stats)
+            return {"operators": ops}
+        return progress.register(live)
 
     def _explain_analyze(self, stmt: t.Query) -> QueryResult:
         """Distributed EXPLAIN ANALYZE: schedule the inner query on the
@@ -300,6 +350,8 @@ class ClusterQueryRunner:
                      f"{len(sub.fragments)} fragments, "
                      f"{len(scheduler.all_tasks())} tasks on "
                      f"{len(nodes)} workers", ""]
+            # one shared re-poll budget for the whole stats render
+            deadline = time.monotonic() + 5.0
             for frag in sub.fragments:
                 stage = scheduler.stages.get(frag.id)
                 tasks = stage.tasks if stage is not None else []
@@ -310,10 +362,17 @@ class ClusterQueryRunner:
                 lines.append(head)
                 stats = []
                 for task in tasks:
-                    # _pull_results drove every task to completion and cached
-                    # its final TaskInfo; re-poll only the ones without one
-                    # (a lost render-time poll must not erase real stats)
-                    info = task.info or task.poll_info()
+                    # deterministic final-state stats: the cached info is
+                    # usually a MID-RUN monitor poll (racing the scan's
+                    # input accounting — the old `TableScan In=0` flake);
+                    # re-poll until the task reports a DONE state, whose
+                    # TaskInfo carries the stats snapshot SqlTask froze
+                    # before its terminal transition. The budget is shared
+                    # across the WHOLE render (one deadline, not 5s per
+                    # task): tasks legitimately still RUNNING at render
+                    # time (abandoned producers of a satisfied LIMIT) fall
+                    # back to their freshest mid-run stats, as before.
+                    info = self._final_task_info(task, deadline=deadline)
                     if info is not None and info.operator_stats:
                         stats.extend(info.operator_stats)
                 if stats:
@@ -329,6 +388,34 @@ class ClusterQueryRunner:
             self._schedulers.pop(query_id, None)
             for task in scheduler.all_tasks():
                 task.cancel(abort=False)
+
+    @staticmethod
+    def _final_task_info(task, deadline: Optional[float] = None,
+                         budget_s: float = 5.0):
+        """The task's DONE-state TaskInfo (deterministic final stats), or
+        the freshest available when `deadline` (shared by the caller across
+        ALL its tasks — a per-task budget would stack) passes first. The
+        root output was already fully consumed when this runs, so tasks are
+        normally finishing and the re-poll window is one round trip; a task
+        legitimately still RUNNING (an abandoned producer of a satisfied
+        LIMIT) falls back to its freshest mid-run stats."""
+        from .task import DONE_STATES
+
+        info = task.info
+        if info is not None and info.state in DONE_STATES:
+            return info
+        backoff = Backoff(initial_delay_s=0.01, max_delay_s=0.2)
+        if deadline is None:
+            deadline = time.monotonic() + budget_s
+        while time.monotonic() < deadline:
+            polled = task.poll_info()
+            if polled is not None:
+                info = polled
+                if info.state in DONE_STATES:
+                    return info
+            backoff.failure()
+            backoff.wait()
+        return info
 
     def _root_schema(self, scheduler: SqlQueryScheduler, sub: SubPlan):
         """Derive the root fragment's output types + dictionaries by running

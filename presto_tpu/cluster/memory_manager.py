@@ -53,15 +53,23 @@ class ClusterMemoryManager:
     def poll_once(self) -> Optional[str]:
         """One poll + policy step; returns the killed query id, if any."""
         by_query: Dict[str, int] = {}
+        per_node: Dict[str, Dict[str, int]] = {}
         total = 0
         for node in self.nodes.active_nodes():
             try:
                 status = self._fetch(node.uri)
             except Exception:  # noqa: BLE001 - dead nodes are the detector's job
                 continue
-            for qid, b in (status.get("queryMemory") or {}).items():
-                by_query[qid] = by_query.get(qid, 0) + int(b)
-                total += int(b)
+            node_mem = {qid: int(b)
+                        for qid, b in (status.get("queryMemory") or {}).items()}
+            if node_mem:
+                # tolerate minimal node stand-ins (tests inject bare
+                # uri-only objects); the uri always identifies the worker
+                per_node[getattr(node, "node_id", None)
+                         or getattr(node, "uri", "?")] = node_mem
+            for qid, b in node_mem.items():
+                by_query[qid] = by_query.get(qid, 0) + b
+                total += b
         self.last_total = total
         self.last_by_query = by_query
         if total <= self.limit_bytes or not by_query:
@@ -73,6 +81,14 @@ class ClusterMemoryManager:
         victim = max(by_query.items(), key=lambda kv: kv[1])[0]
         self._over_count = 0
         self.killed.append(victim)
+        # journal the DECISION with the evidence that justified it: the
+        # per-worker per-query byte snapshot at kill time is exactly what a
+        # post-mortem needs and is gone one poll later
+        from ..utils import events
+        events.emit("query.oom_killed", severity=events.ERROR,
+                    query_id=victim,
+                    victim_bytes=by_query[victim], total_bytes=total,
+                    limit_bytes=self.limit_bytes, per_node=per_node)
         try:
             self.kill_query(victim)
         except Exception:  # noqa: BLE001 - kill is best-effort; retried next poll

@@ -288,6 +288,12 @@ class SqlQueryScheduler:
                 if replacement is None:
                     raise
                 METRICS.count("cluster.task_retries")
+                from ..utils import events
+                events.emit("task.retry", severity=events.WARN,
+                            query_id=self.query_id, task_id=task_id,
+                            retry_kind="re-placement", failed_node=node.node_id,
+                            new_node=replacement.node_id,
+                            attempt=attempt + 1)
                 self.task_retries += 1
                 node = replacement
                 attempt += 1
@@ -348,6 +354,13 @@ class SqlQueryScheduler:
                         and failure.retryable and active_nodes \
                         and self._recover_task(stage, idx, active_nodes):
                     continue
+                from ..utils import events
+                events.emit(
+                    "node.died" if isinstance(failure, NodeDiedError)
+                    else "task.failed",
+                    severity=events.ERROR, query_id=self.query_id,
+                    task_id=task.task_id, node=task.node.node_id,
+                    message=str(failure)[:300])
                 pending.append(failure)
         if pending:
             # a dead NODE is the root cause; a FAILED task on a healthy node
@@ -414,6 +427,12 @@ class SqlQueryScheduler:
         old.cancel(abort=True)
         stage.tasks[idx] = new_task
         METRICS.count("cluster.task_retries")
+        from ..utils import events
+        events.emit("task.retry", severity=events.WARN,
+                    query_id=self.query_id, task_id=new_task.task_id,
+                    retry_kind="in-place-recovery", failed_task=old.task_id,
+                    failed_node=old.node.node_id, new_node=node.node_id,
+                    attempt=attempt)
         self.task_retries += 1
         return True
 

@@ -338,6 +338,12 @@ class SqlTask:
         # kept after planning so info() can report per-operator stats
         # (reads of the plain-int stat fields race benignly mid-run)
         self._drivers: List[object] = []
+        # final-state stats snapshot, frozen BEFORE the terminal transition:
+        # any TaskInfo that reports a DONE state carries COMPLETE operator
+        # stats — a roll-up (distributed EXPLAIN ANALYZE) that polled the
+        # task mid-run can re-poll after completion and never again render
+        # a TableScan whose input accounting was still in flight
+        self._final_stats: Optional[List[dict]] = None
         kind = self._output_kind()
         self.output = buffers.OutputBuffer(
             buffers.BROADCAST if kind == BROADCAST else
@@ -396,6 +402,8 @@ class SqlTask:
             return True
 
     def _run(self) -> None:
+        from ..utils.metrics import METRICS
+        t0 = time.perf_counter()
         try:
             self._transition(RUNNING)
             faults.fire("worker.task_run", task_id=self.task_id,
@@ -410,12 +418,24 @@ class SqlTask:
                 # fragment produced no sink operator (shouldn't happen) —
                 # still close the buffer so consumers terminate
                 self.output.set_no_more_pages()
+            # freeze the operator stats BEFORE the terminal transition: the
+            # drivers are all done here, so this snapshot is the complete
+            # final accounting — consumers that observe a DONE state get
+            # deterministic stats, never a racing mid-run read
+            self._snapshot_final_stats()
+            METRICS.histogram("task.wall_s", time.perf_counter() - t0)
             self._transition(FINISHED if not self.cancelled.is_set()
                              else CANCELED)
         except Exception as e:  # noqa: BLE001 — reported via TaskInfo
             self.error = {"message": str(e), "type": type(e).__name__,
                           "stack": traceback.format_exc()[-2000:]}
+            self._snapshot_final_stats()
             self._transition(FAILED)
+            from ..utils import events
+            events.emit("task.failed", severity=events.ERROR,
+                        query_id=self.request.query_id,
+                        task_id=self.task_id, error=type(e).__name__,
+                        message=str(e)[:500])
             # abandoned drivers must release their pipelines + memory
             # reservations (the pool is process-shared across queries now)
             for d in self._drivers:
@@ -424,6 +444,14 @@ class SqlTask:
                 except Exception:  # noqa: BLE001 - teardown best effort
                     pass
             self.output.fail(str(e))
+
+    def _snapshot_final_stats(self) -> None:
+        from ..exec.explain import driver_stats
+        try:
+            if self._drivers:
+                self._final_stats = driver_stats(self._drivers)
+        except Exception:  # noqa: BLE001 - stats must never mask the run's outcome
+            pass
 
     def _plan_drivers(self):
         req = self.request
@@ -537,7 +565,11 @@ class SqlTask:
 
         rows = self._sink.operators[0].rows_out \
             if self._sink and self._sink.operators else 0
-        stats = driver_stats(self._drivers) if self._drivers else None
+        # DONE state -> the frozen final snapshot (deterministic); mid-run
+        # -> a live racy read (what /v1/query live progress wants)
+        stats = self._final_stats
+        if stats is None:
+            stats = driver_stats(self._drivers) if self._drivers else None
         return TaskInfo(self.task_id, self.state, self.error, rows,
                         self.instance_id, operator_stats=stats)
 
@@ -553,6 +585,7 @@ class WorkerTaskManager:
         self.max_done_tasks = max_done_tasks
 
     def create_or_update(self, request: TaskUpdateRequest) -> TaskInfo:
+        created = False
         with self._lock:
             task = self.tasks.get(request.task_id)
             if task is None:
@@ -560,6 +593,7 @@ class WorkerTaskManager:
                 self.tasks[request.task_id] = task
                 task.start()
                 self._cleanup_locked()
+                created = True
             elif (request.query_id, request.fragment_id,
                   request.worker_index) != (task.request.query_id,
                                             task.request.fragment_id,
@@ -569,6 +603,13 @@ class WorkerTaskManager:
                 raise ValueError(
                     f"task {request.task_id} exists with different content "
                     f"(instance {task.instance_id})")
+        if created:
+            # journaled OUTSIDE the manager lock (the journal's file sink
+            # does I/O under its own lock; never nest that under ours)
+            from ..utils import events
+            events.emit("task.created", query_id=request.query_id,
+                        task_id=request.task_id,
+                        fragment=request.fragment_id)
         return task.info()
 
     def get(self, task_id: str) -> Optional[SqlTask]:

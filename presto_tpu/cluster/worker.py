@@ -209,6 +209,21 @@ class _WorkerHandler(BaseHTTPRequestHandler):
                 "queryMemory": query_mem,
                 "uptime": round(time.monotonic() - self.worker.start_mono, 1),
             }).encode(), 200, [("Content-Type", "application/json")])
+        if path.rstrip("/").startswith("/v1/metrics"):
+            # same surface as the coordinator: flat JSON, ?raw=1 (the
+            # mergeable bucket snapshot GET /v1/cluster/metrics consumes),
+            # ?format=prometheus for direct scraping of each worker
+            from ..utils.metrics import metrics_http_body
+
+            prefix = path.rstrip("/")[len("/v1/metrics"):].lstrip("/")
+            body, ctype = metrics_http_body(query, prefix=prefix)
+            return self._send(body, 200, [("Content-Type", ctype)])
+        if path.rstrip("/") == "/v1/events":
+            from ..utils.events import events_http_body
+
+            body, status = events_http_body(query)
+            return self._send(body, status,
+                              [("Content-Type", "application/json")])
         self._send(b"not found", 404)
 
     def do_HEAD(self) -> None:  # noqa: N802 — failure-detector ping
@@ -336,7 +351,13 @@ def main(argv=None) -> None:
     ap.add_argument("--etc", default=None,
                     help="config directory with catalog/*.properties — every "
                          "node must load the same catalog set")
+    ap.add_argument("--event-log", default=None, metavar="PATH",
+                    help="append this worker's structured event journal "
+                         "(task lifecycle, spills) as JSONL to PATH")
     args = ap.parse_args(argv)
+    if args.event_log:
+        from ..utils.events import JOURNAL
+        JOURNAL.set_log_path(args.event_log)
     catalogs = None
     if args.etc:
         from ..server.config import load_catalogs, load_plugins_for_etc
@@ -348,7 +369,8 @@ def main(argv=None) -> None:
                           node_id=args.node_id, catalogs=catalogs)
     if server._announcer:
         server._announcer.start()
-    print(f"presto-tpu worker {server.node_id} listening on :{server.port}")
+    print(f"presto-tpu worker {server.node_id} listening on "  # prestocheck: ignore[print-hygiene] - CLI startup banner
+          f":{server.port}")
     server.httpd.serve_forever()
 
 

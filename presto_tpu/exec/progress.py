@@ -1,0 +1,115 @@
+"""Live query progress: per-operator counters readable WHILE a query runs.
+
+``GET /v1/query/{id}`` on a RUNNING query must answer with live rows
+in/out, blocked time, memory reservation and pool steps — before this
+module, operator stats only surfaced after completion (EXPLAIN ANALYZE and
+QueryResult.stats are end-of-run artifacts).
+
+Wiring: the protocol layer (server/protocol.QueryManager) binds the
+client-visible query id to the executing thread with :func:`query_scope`;
+each runner tier registers one or more PROVIDERS while its drivers/tasks
+are live (the local and mesh runners snapshot their drivers' OperatorStats,
+the cluster coordinator re-serves the freshest TaskInfo.operator_stats its
+0.5s monitor polls already collect). :func:`snapshot` merges every live
+provider through the shared exec/explain roll-up — the same aggregation
+EXPLAIN ANALYZE prints, read mid-flight.
+
+Providers return ``{"operators": [stat dicts], "memory_reserved_bytes": n,
+"pool_steps": n}`` (all keys optional) and must be cheap + thread-safe to
+call from an HTTP handler thread: reading plain-int OperatorStats fields
+races benignly with the mutating driver threads (torn reads of a counter
+show a stale value, never corrupt state).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, List, Optional
+
+_TLS = threading.local()
+_LOCK = threading.Lock()
+_PROVIDERS: Dict[str, List[Callable[[], dict]]] = {}
+
+
+class query_scope:
+    """Bind `query_id` to the calling thread for the duration: provider
+    registrations inside (the engine's _run_plan / schedulers) attach to
+    this query. Re-entrant safe (restores the previous binding)."""
+
+    def __init__(self, query_id: str):
+        self.query_id = query_id
+
+    def __enter__(self):
+        self._prev = getattr(_TLS, "query_id", None)
+        _TLS.query_id = self.query_id
+        return self
+
+    def __exit__(self, *exc):
+        _TLS.query_id = self._prev
+        # end of scope = end of query: nothing should serve stale progress
+        unregister_all(self.query_id)
+        return False
+
+
+def current_query_id() -> Optional[str]:
+    return getattr(_TLS, "query_id", None)
+
+
+def register(provider: Callable[[], dict],
+             query_id: Optional[str] = None) -> Callable[[], None]:
+    """Attach a live-progress provider to `query_id` (default: the thread's
+    bound scope). Returns an unregister callable; with no bound query the
+    registration is a no-op (engine used without the protocol layer)."""
+    qid = query_id or current_query_id()
+    if not qid:
+        return lambda: None
+    with _LOCK:
+        _PROVIDERS.setdefault(qid, []).append(provider)
+
+    def unregister() -> None:
+        with _LOCK:
+            lst = _PROVIDERS.get(qid)
+            if lst is not None:
+                try:
+                    lst.remove(provider)
+                except ValueError:
+                    pass
+                if not lst:
+                    _PROVIDERS.pop(qid, None)
+    return unregister
+
+
+def unregister_all(query_id: str) -> None:
+    with _LOCK:
+        _PROVIDERS.pop(query_id, None)
+
+
+def snapshot(query_id: str) -> Optional[dict]:
+    """Merged live progress for one query: per-operator counters rolled up
+    across providers (exec/explain.rollup — the EXPLAIN ANALYZE aggregation,
+    read live), plus query-level memory/pool totals. None when the query has
+    no live providers (not running, or pre-planning)."""
+    from .explain import rollup
+
+    with _LOCK:
+        providers = list(_PROVIDERS.get(query_id, ()))
+    if not providers:
+        return None
+    operators: List[dict] = []
+    memory = 0
+    pool_steps = 0
+    for p in providers:
+        try:
+            d = p() or {}
+        except Exception:  # noqa: BLE001 - a torn mid-teardown read is not news
+            continue
+        operators.extend(d.get("operators") or ())
+        memory += int(d.get("memory_reserved_bytes") or 0)
+        pool_steps += int(d.get("pool_steps") or 0)
+    return {"operators": rollup(operators),
+            "memory_reserved_bytes": memory,
+            "pool_steps": pool_steps}
+
+
+def live_query_ids() -> List[str]:
+    with _LOCK:
+        return sorted(_PROVIDERS)

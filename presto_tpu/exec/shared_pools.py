@@ -191,8 +191,13 @@ class SharedWorkerPool:
             finished = False
             try:
                 if rec is not None:
+                    # one coarse span per step: the black-box / flight
+                    # recorder timeline shows WHEN each query's stages got
+                    # pool service (category `pool`)
                     with trace.bound(rec):
-                        next(gen)
+                        with trace.span(trace.POOL, f"{self.name}_step",
+                                        query=client.key):
+                            next(gen)
                 else:
                     next(gen)
             except StopIteration:
@@ -201,6 +206,10 @@ class SharedWorkerPool:
                 # own errors into their pipelines; anything escaping here is a
                 # pool-level bug — keep the worker alive, drop the generator
                 finished = True
+                from ..utils import events
+                events.emit("pool.step_error", severity=events.ERROR,
+                            pool=self.name, client=client.key,
+                            error=repr(e)[:300])
                 print(f"shared pool {self.name}: worker step failed: {e!r}",
                       file=sys.stderr)
             with self._cv:

@@ -200,6 +200,13 @@ class QueryContextMemory:
 
     def _on_user(self, delta: int, total: int) -> None:
         if total > self.max_user_bytes:
+            # journal BEFORE raising: the exception may surface far away
+            # (through a consumer's poisoned queue) with the byte evidence
+            # long gone — the event pins query id, limit and actual bytes
+            from .utils import events
+            events.emit("query.memory_exceeded", severity=events.ERROR,
+                        query_id=self.query_id,
+                        limit_bytes=self.max_user_bytes, reserved_bytes=total)
             raise ExceededMemoryLimitException("per-query user", self.max_user_bytes)
         self.pool.reserve(self.query_id, delta, revocable=False)
 
@@ -233,4 +240,10 @@ class MemoryRevoker:
             if b > 0:
                 op.start_memory_revoke()
                 requested += b
+        if requested:
+            from .utils import events
+            events.emit("memory.revoke", severity=events.WARN,
+                        requested_bytes=requested,
+                        pool_reserved_bytes=self.pool.reserved_bytes(),
+                        pool_max_bytes=self.pool.max_bytes)
         return requested

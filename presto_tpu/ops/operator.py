@@ -83,6 +83,11 @@ class OperatorContext:
         self.revocable_memory.set_bytes(used)
         self.stats.peak_memory_bytes = max(self.stats.peak_memory_bytes, used)
         if used and self.should_revoke():
+            # only fires under memory pressure (rare): the spill decision is
+            # exactly what a post-mortem needs to see in the journal
+            from ..utils import events
+            events.emit("memory.spill", severity=events.WARN,
+                        operator=self.stats.name, revocable_bytes=used)
             on_revoke()
 
     def release_memory(self) -> None:

@@ -153,6 +153,12 @@ class DistributedQueryRunner:
                     result = run()
             else:
                 result = run()
+        except BaseException as e:
+            # black-box forensics: the failing query's coarse ring rides
+            # the exception (QueryInfo.failure_trace_path upstream)
+            if installed:
+                trace.attach_failure(e, rec, self.session)
+            raise
         finally:
             if installed:
                 trace.uninstall(rec)
@@ -166,7 +172,7 @@ class DistributedQueryRunner:
             METRICS.count_many(
                 {k: v for k, v in snap.items()
                  if isinstance(v, (int, float))}, prefix="exchange.")
-        if installed:
+        if installed and not rec.coarse:
             result.trace_path = trace.export(rec, self.session)
         return result
 
@@ -268,8 +274,22 @@ class DistributedQueryRunner:
             # all drivers exist: producer counts are exact — start the pumps
             for fid, ex in exchanges.items():
                 ex.start(sink_facs[fid].created)
-            TaskExecutor(
-                int(self.session.get("task_concurrency"))).execute(drivers)
+            # live progress across ALL fragments' drivers (exec/progress.py;
+            # no-op outside a protocol-layer query scope)
+            from ..exec import progress as _progress
+            from ..exec.explain import driver_stats as _dstats
+            from ..runner import _pool_steps
+
+            unregister = _progress.register(lambda: {
+                "operators": _dstats(drivers),
+                "memory_reserved_bytes": mem_ctx.total_bytes(),
+                "pool_steps": _pool_steps(pool_key)})
+            try:
+                TaskExecutor(
+                    int(self.session.get("task_concurrency"))
+                ).execute(drivers)
+            finally:
+                unregister()
             return QueryResult(root_ep.sink.rows(), sub.column_names,
                                root_ep.output_types)
         finally:

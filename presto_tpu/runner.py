@@ -66,11 +66,31 @@ class QueryResult:
     # (utils/trace.py), set when the `query_trace` session knob is on;
     # loads directly in Perfetto / chrome://tracing
     trace_path: Optional[str] = None
+    # forensic export of the always-on black-box ring (utils/trace.py):
+    # set when the query survived through retries after a failed attempt —
+    # a query that failed outright carries the same path on its exception's
+    # `failure_trace_path` attribute instead (there is no result then)
+    failure_trace_path: Optional[str] = None
 
 
 # unique per-query ids in the process-shared memory pool (itertools.count
 # is atomic under the GIL, so concurrent submits never collide)
 _QUERY_MEM_SEQ = itertools.count(1)
+
+
+def _pool_steps(pool_key: Optional[str]) -> int:
+    """Live shared-pool step count of this query's fairness slots (racy
+    plain-int read by design: live progress, not an invariant)."""
+    if not pool_key:
+        return 0
+    from .exec.shared_pools import EXCHANGE_POOL, SCAN_POOL
+
+    total = 0
+    for pool in (SCAN_POOL, EXCHANGE_POOL):
+        client = pool._clients.get(pool_key)
+        if client is not None:
+            total += client.steps
+    return total
 
 
 def _scan_pipeline_stats(drivers) -> Optional[dict]:
@@ -215,8 +235,10 @@ class LocalQueryRunner:
 
     def execute(self, sql: str, user: Optional[str] = None) -> QueryResult:
         """Public entry: runs the statement under the per-query flight
-        recorder when `query_trace` is on, and histograms the wall either
-        way (`query.wall_s` p50/p95/p99 at /v1/metrics)."""
+        recorder — a FULL one when `query_trace` is on, else the always-on
+        coarse black-box ring — and histograms the wall either way
+        (`query.wall_s` p50/p95/p99 at /v1/metrics). A failing statement
+        dumps the ring as a forensic trace pinned to the exception."""
         import time as _time
 
         rec = trace.maybe_recorder(self.session)
@@ -228,11 +250,15 @@ class LocalQueryRunner:
                     result = self._execute_statement(sql, user)
             else:
                 result = self._execute_statement(sql, user)
+        except BaseException as e:
+            if installed:
+                trace.attach_failure(e, rec, self.session)
+            raise
         finally:
             if installed:
                 trace.uninstall(rec)
         METRICS.histogram("query.wall_s", _time.perf_counter() - t0)
-        if installed:
+        if installed and not rec.coarse:
             result.trace_path = trace.export(rec, self.session)
         return result
 
@@ -535,6 +561,7 @@ class LocalQueryRunner:
         import time as _time
 
         mem, over_target, release = self._query_memory()
+        unregister = lambda: None  # noqa: E731 - rebound below
         try:
             with trace.span(trace.LIFECYCLE, "local_plan"):
                 local = LocalExecutionPlanner(self.metadata, self.session,
@@ -542,6 +569,18 @@ class LocalQueryRunner:
                 local.attach_memory(mem, over_target)
                 exec_plan = local.plan(plan)
                 drivers = exec_plan.create_drivers()
+            # live progress (exec/progress.py): while the drivers run, the
+            # protocol layer can serve their per-operator counters at
+            # GET /v1/query/{id} — registration is a no-op outside a
+            # query_scope (engine used directly, no HTTP)
+            from .exec import progress as _progress
+            from .exec.explain import driver_stats as _dstats
+
+            def _live() -> dict:
+                return {"operators": _dstats(drivers),
+                        "memory_reserved_bytes": mem.total_bytes(),
+                        "pool_steps": _pool_steps(local.pool_key)}
+            unregister = _progress.register(_live)
             t0 = _time.perf_counter()
             # task executor: build/probe pipelines overlap on runner threads
             # (blocked probes park until their lookup slot resolves)
@@ -563,6 +602,7 @@ class LocalQueryRunner:
                 raise
             return exec_plan, drivers, _time.perf_counter() - t0
         finally:
+            unregister()
             release()
 
     def _explain_analyze(self, stmt: t.Query) -> str:

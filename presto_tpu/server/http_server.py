@@ -11,9 +11,18 @@ Endpoints:
   DELETE /v1/statement/{id}/{tok} cancel
   GET    /v1/info                 server info (ServerInfoResource analogue)
   GET    /v1/query                all queries (QueryResource analogue)
-  GET    /v1/query/{id}           one query's info
+  GET    /v1/query/{id}           one query's info (+ live per-operator
+                                  progress while RUNNING)
+  GET    /v1/query/{id}/trace     flight-recorder export; for FAILED
+                                  queries, the black-box forensic dump
+  GET    /v1/metrics[?format=prometheus|raw=1]   process metrics
+  GET    /v1/cluster/metrics      every worker's metrics merged (counters
+                                  summed, histogram buckets merged,
+                                  percentiles re-derived)
+  GET    /v1/events?query_id=&since=&kind=       structured event journal
 
 Run: python -m presto_tpu.server [--port 8080] [--distributed] [--schema sf1]
+    [--event-log events.jsonl]
 """
 from __future__ import annotations
 
@@ -176,13 +185,36 @@ class _Handler(BaseHTTPRequestHandler):
                            "failureRatio": round(n.failure_ratio, 3)}
                           for n in (nodes.all_nodes() if nodes else [])],
             })
-        if self.path.rstrip("/").startswith("/v1/metrics"):
+        path, _, qs = self.path.partition("?")
+        if path.rstrip("/") == "/v1/cluster/metrics":
+            return self._cluster_metrics(qs)
+        if path.rstrip("/").startswith("/v1/metrics"):
             # JMX-analogue: flat counters/gauges as JSON; optional
-            # /v1/metrics/<prefix> filters like an mbean-name lookup
-            from ..utils.metrics import METRICS
+            # /v1/metrics/<prefix> filters like an mbean-name lookup;
+            # ?format=prometheus = text exposition, ?raw=1 = mergeable
+            # bucket-level snapshot (what the cluster roll-up consumes)
+            from ..utils.metrics import metrics_http_body
 
-            prefix = self.path.rstrip("/")[len("/v1/metrics"):].lstrip("/")
-            return self._send_json(METRICS.snapshot(prefix))
+            prefix = path.rstrip("/")[len("/v1/metrics"):].lstrip("/")
+            body, ctype = metrics_http_body(qs, prefix=prefix)
+            self.send_response(200)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
+        if path.rstrip("/") == "/v1/events":
+            # structured event journal (utils/events.py): ?query_id= scopes
+            # to one query, ?since=<seq> pages forward, ?kind= prefix-filters
+            from ..utils.events import events_http_body
+
+            body, status = events_http_body(qs)
+            self.send_response(status)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
         if self.path.rstrip("/") == "/v1/query":
             return self._send_json([self._query_json(q)
                                     for q in self.manager.list_queries()])
@@ -194,12 +226,19 @@ class _Handler(BaseHTTPRequestHandler):
             info = self.manager.get(m.group(1))
             if info is None:
                 return self._not_found()
+            # opted-in full trace first; else the black-box forensic dump —
+            # which is how a FAILED query that never set query_trace still
+            # answers here with its last coarse timeline
             path = getattr(info, "trace_path", None)
+            if not path or not os.path.exists(path):
+                path = getattr(info, "failure_trace_path", None)
             if not path or not os.path.exists(path):
                 return self._send_json(
                     {"error": {"message":
                                f"query {info.query_id} has no trace "
-                               "(set session property query_trace=true)"}},
+                               "(set session property query_trace=true; "
+                               "failed queries export a forensic "
+                               "automatically)"}},
                     status=404)
             with open(path, "rb") as f:
                 body = f.read()
@@ -228,9 +267,65 @@ class _Handler(BaseHTTPRequestHandler):
             return
         self._not_found()
 
+    def _cluster_metrics(self, qs: str) -> None:
+        """ClusterStatsResource-for-metrics: pull every active worker's
+        mergeable snapshot (/v1/metrics?raw=1), merge (counters sum,
+        histogram buckets add, percentiles re-derived from the merged
+        buckets) and serve flat JSON or Prometheus text. A server without
+        workers (local/mesh mode) serves its own process snapshot — the
+        endpoint shape is uniform across deployment modes."""
+        import urllib.parse
+        import urllib.request
+
+        from ..utils.metrics import (METRICS, flatten_raw,
+                                     merge_raw_snapshots, prometheus_text)
+
+        nodes = getattr(self.manager.runner, "nodes", None)
+        active = nodes.active_nodes() if nodes else []
+
+        def fetch(node):
+            with urllib.request.urlopen(
+                    f"{node.uri}/v1/metrics?raw=1", timeout=2.0) as resp:
+                return json.loads(resp.read())
+
+        # fetch CONCURRENTLY: the scrape must cost max(worker latency), not
+        # the sum — one black-holed worker would otherwise stall the whole
+        # endpoint past a Prometheus scrape timeout
+        snaps = []
+        workers = 0
+        failed = 0
+        if active:
+            from concurrent.futures import ThreadPoolExecutor
+
+            with ThreadPoolExecutor(max_workers=min(len(active), 16)) as ex:
+                futures = [ex.submit(fetch, n) for n in active]
+                for f in futures:
+                    try:
+                        snaps.append(f.result(timeout=5.0))
+                        workers += 1
+                    except Exception:  # noqa: BLE001 - dead workers are the detector's case
+                        failed += 1
+        if not snaps:
+            snaps = [METRICS.raw_snapshot()]
+        merged = merge_raw_snapshots(snaps)
+        params = urllib.parse.parse_qs(qs or "")
+        if params.get("format", [""])[0] == "prometheus":
+            body = prometheus_text(merged).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "text/plain; version=0.0.4")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
+        out = flatten_raw(merged)
+        out["cluster.workers_merged"] = workers
+        if failed:
+            out["cluster.workers_unreachable"] = failed
+        self._send_json(out)
+
     @staticmethod
     def _query_json(info) -> dict:
-        return {
+        out = {
             "queryId": info.query_id,
             "state": info.state,
             "query": info.sql,
@@ -238,8 +333,20 @@ class _Handler(BaseHTTPRequestHandler):
             "rowCount": info.row_count,
             "elapsedMillis": info.elapsed_millis(),
             "hasTrace": bool(getattr(info, "trace_path", None)),
+            "hasFailureTrace": bool(getattr(info, "failure_trace_path",
+                                            None)),
             "error": info.error,
         }
+        if info.state == "RUNNING":
+            # live per-operator counters (exec/progress.py): rows in/out,
+            # blocked ns, memory reservation, pool steps — progress visible
+            # BEFORE completion on every runner tier
+            from ..exec import progress
+
+            prog = progress.snapshot(info.query_id)
+            if prog is not None:
+                out["progress"] = prog
+        return out
 
 
 class PrestoTpuServer:
@@ -311,7 +418,15 @@ def main(argv=None) -> None:
                     help="warm the kernel cache with these TPC-H queries "
                          "(comma-separated ids, default 1,3,6) before "
                          "serving, so first tenants never pay compile walls")
+    ap.add_argument("--event-log", default=None, metavar="PATH",
+                    help="append the structured event journal (query "
+                         "lifecycle, OOM kills, retries, spills) as JSONL "
+                         "to PATH — the durable half of GET /v1/events")
     args = ap.parse_args(argv)
+
+    if args.event_log:
+        from ..utils.events import JOURNAL
+        JOURNAL.set_log_path(args.event_log)
 
     from ..metadata import Session
     catalogs = None
@@ -400,7 +515,7 @@ def main(argv=None) -> None:
                     print(f"compile-ahead q{qid}: FAILED {e!r}",
                           file=sys.stderr)
     server = PrestoTpuServer(runner, port=port, authenticator=authenticator)
-    print(f"presto-tpu server listening on :{server.port} "
+    print(f"presto-tpu server listening on :{server.port} "  # prestocheck: ignore[print-hygiene] - CLI startup banner
           f"({mode}, schema={args.schema}"
           f"{', password-auth' if authenticator else ''})")
     server.serve()

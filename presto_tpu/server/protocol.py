@@ -55,6 +55,11 @@ class QueryInfo:
     # flight-recorder export (query_trace session knob): local path of the
     # Chrome trace JSON, served at GET /v1/query/{id}/trace
     trace_path: Optional[str] = None
+    # black-box forensic dump (always-on coarse ring, utils/trace.py): set
+    # when the query FAILED (from the exception's failure_trace_path) or
+    # survived a failed attempt; /v1/query/{id}/trace serves it when no
+    # opted-in trace exists — failed queries are debuggable after the fact
+    failure_trace_path: Optional[str] = None
 
     def done(self) -> bool:
         return self.state in _DONE
@@ -119,8 +124,10 @@ class QueryManager:
             self.monitor.query_created(
                 QueryCreatedEvent(qid, sql, user=user, source=source,
                                   trace_token=trace_token))
+        from ..utils import events
         from ..utils.metrics import METRICS
         METRICS.count("query_manager.submitted")
+        events.emit("query.submitted", query_id=qid, user=user, source=source)
         # daemon (a wedged kernel must not block interpreter exit) but
         # REGISTERED: close() joins every live one, bounded
         t = threading.Thread(target=self._run, args=(info,),
@@ -154,6 +161,7 @@ class QueryManager:
         info = self._queries.get(query_id)
         if info is None:
             return False
+        canceled = False
         with self._lock:
             if not info.done():
                 # engine slices are not interruptible mid-kernel; the query is
@@ -161,6 +169,11 @@ class QueryManager:
                 info.state = CANCELED
                 info.end_time = time.time()
                 info.end_mono = time.monotonic()
+                canceled = True
+        if canceled:
+            from ..utils import events
+            events.emit("query.canceled", severity=events.WARN,
+                        query_id=query_id)
         return True
 
     def list_queries(self) -> List[QueryInfo]:
@@ -225,10 +238,15 @@ class QueryManager:
                 for cat in self.transactions.catalog_names():
                     self.transactions.join(tx, cat)
             runner = self._scoped_runner(info)
-            if self._execute_takes_user:
-                result = runner.execute(info.sql, user=info.user)
-            else:
-                result = runner.execute(info.sql)
+            # live progress: the engine's _run_plan / schedulers register
+            # their per-operator providers under THIS query id while the
+            # query runs (served at GET /v1/query/{id})
+            from ..exec import progress as _progress
+            with _progress.query_scope(info.query_id):
+                if self._execute_takes_user:
+                    result = runner.execute(info.sql, user=info.user)
+                else:
+                    result = runner.execute(info.sql)
             rows = [self._to_json_row(r) for r in result.rows]
             if tx is not None:
                 self.transactions.commit(tx)
@@ -238,15 +256,21 @@ class QueryManager:
                     return
                 info.rows = rows
                 info.trace_path = getattr(result, "trace_path", None)
+                info.failure_trace_path = getattr(
+                    result, "failure_trace_path", None)
                 info.row_count = len(rows)
                 info.columns = [{"name": n, "type": self._type_name(result, i)}
                                 for i, n in enumerate(result.column_names)]
                 info.state = FINISHED
                 info.end_time = time.time()
                 info.end_mono = time.monotonic()
+            from ..utils import events
             from ..utils.metrics import METRICS
             METRICS.count("query_manager.completed")
             METRICS.count("query_manager.output_rows", len(rows))
+            events.emit("query.finished", query_id=info.query_id,
+                        rows=len(rows),
+                        wall_s=round(time.monotonic() - t_run, 4))
         except Exception as e:  # noqa: BLE001 - reported through the protocol
             with self._lock:
                 info.error = {
@@ -254,11 +278,20 @@ class QueryManager:
                     "errorType": type(e).__name__,
                     "stack": traceback.format_exc()[-2000:],
                 }
+                # the engine's failure forensic (always-on black-box ring)
+                # rides the exception; GET /v1/query/{id}/trace serves it
+                info.failure_trace_path = getattr(e, "failure_trace_path",
+                                                  None)
                 info.state = FAILED
                 info.end_time = time.time()
                 info.end_mono = time.monotonic()
+            from ..utils import events
             from ..utils.metrics import METRICS
             METRICS.count("query_manager.failed")
+            events.emit("query.failed", severity=events.ERROR,
+                        query_id=info.query_id, error=type(e).__name__,
+                        message=str(e)[:500],
+                        forensic=bool(info.failure_trace_path))
         finally:
             with self._lock:
                 self._run_threads.pop(info.query_id, None)

@@ -159,7 +159,7 @@ def main(argv=None) -> None:
                     help="advertised base URI when behind a load balancer")
     args = ap.parse_args(argv)
     server = ProxyServer(args.backend, args.port, args.public_base)
-    print(f"proxy on :{server.port} -> {args.backend}", flush=True)
+    print(f"proxy on :{server.port} -> {args.backend}", flush=True)  # prestocheck: ignore[print-hygiene] - CLI startup banner
     server.httpd.serve_forever()
 
 

@@ -206,18 +206,51 @@ class ResourceGroupManager:
 
     def submit(self, query_id: str, user: str = "", source: str = "",
                timeout_s: float = 300.0) -> _Ticket:
+        from ..utils import events
+        # the outcome is DECIDED inside the lock (a concurrent finish()
+        # could promote the queued ticket before we journal — re-reading
+        # ticket.admitted outside would then emit a duplicate admitted and
+        # suppress the queued event that actually happened); the emits
+        # themselves stay OUTSIDE the lock (the journal's file sink does
+        # I/O under its own lock)
+        rejected = None
+        queued = None
         with self._lock:
             group = self._resolve(user, source)
             ticket = _Ticket(group, query_id)
-            if group.can_run() and self._memory_ok():
+            memory_ok = self._memory_ok()
+            if group.can_run() and memory_ok:
                 group.start()
                 ticket.admitted.set()
-                return ticket
-            if len(group.queue) >= group.spec.max_queued:
-                raise QueryRejected(
+                outcome = "admitted"
+            elif len(group.queue) >= group.spec.max_queued:
+                rejected = QueryRejected(
                     f"Too many queued queries for {group.name!r} "
                     f"(max_queued {group.spec.max_queued})")
-            group.queue.append(ticket)
+                outcome = "rejected"
+            else:
+                group.queue.append(ticket)
+                queued = (group.name, len(group.queue), group.running)
+                outcome = "queued"
+        if outcome == "admitted":
+            events.emit("query.admitted", query_id=query_id,
+                        group=group.name)
+            return ticket
+        if outcome == "rejected":
+            events.emit("query.rejected", severity=events.WARN,
+                        query_id=query_id, group=group.name,
+                        reason=str(rejected))
+            raise rejected
+        events.emit("query.queued", severity=events.WARN, query_id=query_id,
+                    group=queued[0], queue_depth=queued[1],
+                    running=queued[2])
+        if not memory_ok:
+            # the reason the query parked was pool pressure, not group
+            # concurrency: that saturation is its own operational signal
+            events.emit("pool.saturated", severity=events.WARN,
+                        query_id=query_id,
+                        reserved_bytes=self._memory_fn(),
+                        limit_bytes=self.memory_limit_bytes)
         deadline = time.monotonic() + timeout_s
         while not ticket.admitted.wait(min(1.0, timeout_s)):
             # periodic re-promotion: cpu quotas refill with TIME, not only on
@@ -225,7 +258,8 @@ class ResourceGroupManager:
             # last finish() ran while tokens were negative would starve its
             # queue until timeout
             with self._lock:
-                self._promote_locked()
+                promoted = self._promote_locked()
+            self._emit_promotions(promoted)
             if ticket.admitted.is_set():
                 break
             if time.monotonic() > deadline:
@@ -236,27 +270,41 @@ class ResourceGroupManager:
                         ticket.group.queue.remove(ticket)
                     except ValueError:
                         pass
+                events.emit("query.rejected", severity=events.WARN,
+                            query_id=query_id, group=group.name,
+                            reason="queued time limit exceeded")
                 raise QueryRejected(
                     f"Query exceeded queued time limit in {group.name!r}")
         return ticket
 
-    def _promote_locked(self) -> None:
+    def _promote_locked(self) -> List["_Ticket"]:
+        promoted: List[_Ticket] = []
         while True:
             if not self._memory_ok():
-                return  # pool over limit: admit nothing until tenants free
+                return promoted  # pool over limit: admit nothing until tenants free
             nxt = self.root.eligible_queued()
             if nxt is None:
-                return
+                return promoted
             nxt.group.queue.remove(nxt)
             nxt.group.start()
             nxt.admitted.set()
+            promoted.append(nxt)
+
+    @staticmethod
+    def _emit_promotions(promoted: List["_Ticket"]) -> None:
+        from ..utils import events
+        for t in promoted:
+            events.emit("query.admitted", query_id=t.query_id,
+                        group=t.group.name, promoted=True,
+                        queued_s=round(time.monotonic() - t.start_time, 3))
 
     def finish(self, ticket: _Ticket, cpu_seconds: float = 0.0) -> None:
         with self._lock:
             if cpu_seconds:
                 ticket.group.charge_cpu(cpu_seconds)
             ticket.group.finish()
-            self._promote_locked()
+            promoted = self._promote_locked()
+        self._emit_promotions(promoted)
 
     def stats(self) -> Dict[str, Tuple[int, int]]:
         """group name -> (running, queued), for /v1/resourceGroup."""

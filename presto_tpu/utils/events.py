@@ -1,0 +1,169 @@
+"""Structured event journal: the engine's append-only lifecycle log.
+
+Analogue of the reference's query events + eventlistener plumbing
+(QueryMonitor / QueryCompletedEvent) widened into an operational journal:
+every lifecycle decision an operator would grep server logs for — query
+admitted/queued/rejected/killed/failed, task retry and re-placement, the
+OOM-kill decision with the per-worker bytes snapshot that justified the
+victim, pool-memory exceeded, spill/revoke, pool saturation — lands here as
+ONE structured record instead of a free-form print.
+
+Shape: each event is a JSON-safe dict
+``{"seq", "kind", "severity", "query_id", "task_id", "wall_ts", "mono_ns",
+...fields}`` — ``seq`` is a process-wide monotone cursor (the ``since=``
+paging key of ``GET /v1/events``), ``wall_ts`` the human timestamp,
+``mono_ns`` the perf-counter stamp that orders events exactly even across
+NTP steps.
+
+Sinks: a bounded in-memory ring (the HTTP endpoint's source — old events
+drop, the drop count is kept) plus an optional append-only JSONL file
+(``--event-log`` on the server/worker CLIs) so forensics survive the
+process. ``emit()`` is a few dict ops + one lock acquisition; it must never
+raise into the engine paths that call it.
+"""
+from __future__ import annotations
+
+import itertools
+import json
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+# severity vocabulary (free-form accepted; these are the conventional ones)
+INFO = "info"
+WARN = "warn"
+ERROR = "error"
+
+DEFAULT_MAX_EVENTS = 4096
+
+
+class EventJournal:
+    """Bounded in-memory journal + optional JSONL file sink."""
+
+    def __init__(self, max_events: int = DEFAULT_MAX_EVENTS):
+        self._lock = threading.Lock()
+        self._events: deque = deque(maxlen=max(int(max_events), 16))
+        self._seq = itertools.count(1)
+        self._log_file = None
+        self.log_path: Optional[str] = None
+        self.dropped = 0
+
+    # ------------------------------------------------------------------ emit
+
+    def emit(self, kind: str, severity: str = INFO, query_id: str = "",
+             task_id: str = "", **fields) -> int:
+        """Append one event; returns its seq (0 if the journal is wedged —
+        emit must never raise into engine code)."""
+        try:
+            evt: Dict = {
+                "seq": next(self._seq),
+                "kind": kind,
+                "severity": severity,
+                "query_id": query_id or "",
+                "task_id": task_id or "",
+                "wall_ts": time.time(),
+                "mono_ns": time.perf_counter_ns(),
+            }
+            if fields:
+                evt.update(fields)
+            with self._lock:
+                if len(self._events) == self._events.maxlen:
+                    self.dropped += 1
+                self._events.append(evt)
+                f = self._log_file
+                if f is not None:
+                    # the file is the durable sink: flush per event so an
+                    # OOM-killed process leaves its last decision on disk
+                    f.write(json.dumps(evt, default=str) + "\n")
+                    f.flush()
+            return evt["seq"]
+        except Exception:  # noqa: BLE001 - journaling must never break the engine
+            return 0
+
+    # ----------------------------------------------------------------- query
+
+    def events(self, query_id: Optional[str] = None, since: int = 0,
+               kind: Optional[str] = None, limit: int = 1000) -> List[dict]:
+        """Events with seq > `since`, optionally filtered by query id and
+        kind prefix, in seq order (what GET /v1/events serves)."""
+        with self._lock:
+            snap = list(self._events)
+        out: List[dict] = []
+        if limit <= 0:
+            # limit=0 is the "just give me lastSeq/dropped" idiom
+            return out
+        for evt in snap:
+            if evt["seq"] <= since:
+                continue
+            if query_id and evt.get("query_id") != query_id:
+                continue
+            if kind and not str(evt.get("kind", "")).startswith(kind):
+                continue
+            out.append(evt)
+            if len(out) >= limit:
+                break
+        return out
+
+    def last_seq(self) -> int:
+        with self._lock:
+            return self._events[-1]["seq"] if self._events else 0
+
+    # ----------------------------------------------------------------- sinks
+
+    def set_log_path(self, path: Optional[str]) -> None:
+        """Attach (or detach with None) the append-only JSONL file sink."""
+        with self._lock:
+            if self._log_file is not None:
+                try:
+                    self._log_file.close()
+                except OSError:
+                    pass
+                self._log_file = None
+            self.log_path = path
+            if path:
+                self._log_file = open(path, "a", encoding="utf-8")
+
+    def clear(self) -> None:
+        """Test hook: drop buffered events (the seq cursor keeps advancing
+        so `since=` pagination stays monotone across clears)."""
+        with self._lock:
+            self._events.clear()
+            self.dropped = 0
+
+
+JOURNAL = EventJournal()
+
+
+def emit(kind: str, severity: str = INFO, query_id: str = "",
+         task_id: str = "", **fields) -> int:
+    """Module-level shorthand onto the process journal."""
+    return JOURNAL.emit(kind, severity=severity, query_id=query_id,
+                        task_id=task_id, **fields)
+
+
+def events_http_body(query: str) -> tuple:
+    """Shared GET /v1/events renderer for the server AND worker handlers:
+    -> (body bytes, status). One implementation so the two endpoints can
+    never drift on params, validation or response shape."""
+    import urllib.parse
+
+    params = urllib.parse.parse_qs(query or "")
+
+    def p(name, default=""):
+        return params.get(name, [default])[0]
+
+    try:
+        since = int(p("since", "0") or 0)
+        limit = int(p("limit", "1000") or 1000)
+    except ValueError:
+        return (json.dumps(
+            {"error": {"message": "since/limit must be integers"}}).encode(),
+            400)
+    return (json.dumps({
+        "events": JOURNAL.events(query_id=p("query_id") or None,
+                                 since=since, kind=p("kind") or None,
+                                 limit=limit),
+        "lastSeq": JOURNAL.last_seq(),
+        "dropped": JOURNAL.dropped,
+    }).encode(), 200)

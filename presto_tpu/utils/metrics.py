@@ -25,7 +25,7 @@ import math
 import sys
 import threading
 import time
-from typing import Callable, Dict, List
+from typing import Callable, Dict, List, Optional
 
 # log2 histogram geometry: bucket 0 holds values <= _HIST_MIN seconds (1us);
 # bucket i holds (MIN*2^(i-1), MIN*2^i]. 64 buckets reach ~2.9e5 hours —
@@ -47,9 +47,12 @@ class Histogram:
         self.total = 0.0
 
     def add(self, value: float) -> None:
-        self.counts[self._bucket(value)] += 1
-        self.n += 1
-        self.total += value
+        # registry-level lock discipline (class docstring): registry-owned
+        # instances mutate only under MetricsRegistry._lock; merge-path
+        # instances (from_raw/merge_raw) are function-local scratch
+        self.counts[self._bucket(value)] += 1  # prestocheck: ignore[shared-state-race] - guarded by MetricsRegistry._lock
+        self.n += 1  # prestocheck: ignore[shared-state-race] - guarded by MetricsRegistry._lock
+        self.total += value  # prestocheck: ignore[shared-state-race] - guarded by MetricsRegistry._lock
 
     @staticmethod
     def _bucket(value: float) -> int:
@@ -62,6 +65,35 @@ class Histogram:
     def bucket_bound(i: int) -> float:
         """Upper bound (seconds) of bucket i."""
         return _HIST_MIN * (1 << i)
+
+    def raw(self) -> Dict:
+        """Mergeable form: the raw bucket counts (not percentiles) — what
+        workers export at /v1/metrics?raw=1 so the coordinator can merge
+        distributions and re-derive percentiles cluster-wide. Percentiles do
+        not compose; bucket counts do."""
+        return {"counts": list(self.counts), "n": self.n,
+                "total": self.total}
+
+    @classmethod
+    def from_raw(cls, raw: Dict) -> "Histogram":
+        h = cls()
+        counts = list(raw.get("counts") or ())[:_HIST_BUCKETS]
+        for i, c in enumerate(counts):
+            h.counts[i] = int(c)
+        h.n = int(raw.get("n") or sum(h.counts))
+        h.total = float(raw.get("total") or 0.0)
+        return h
+
+    def merge_raw(self, raw: Dict) -> None:
+        """Element-wise bucket merge — exact: the merged histogram is the
+        histogram of the union of the samples (fixed shared geometry)."""
+        # merge targets are merge-local scratch Histograms (built fresh in
+        # merge_raw_snapshots, never the registry's lock-guarded instances)
+        counts = list(raw.get("counts") or ())[:_HIST_BUCKETS]
+        for i, c in enumerate(counts):
+            self.counts[i] += int(c)  # prestocheck: ignore[shared-state-race] - merge-local instance
+        self.n += int(raw.get("n") or sum(int(c) for c in counts))  # prestocheck: ignore[shared-state-race] - merge-local instance
+        self.total += float(raw.get("total") or 0.0)  # prestocheck: ignore[shared-state-race] - merge-local instance
 
     def percentile(self, q: float) -> float:
         """Value at quantile ``q`` in [0, 1]: the upper bound of the bucket
@@ -164,6 +196,26 @@ class MetricsRegistry:
             out["uptime_seconds"] = round(time.monotonic() - self._start, 1)
         return out
 
+    def raw_snapshot(self, prefix: str = "") -> Dict:
+        """Mergeable snapshot: counters + sampled gauges as numbers,
+        histograms as raw bucket counts. The cluster roll-up's wire shape
+        (/v1/metrics?raw=1) — merge with :func:`merge_raw_snapshots`."""
+        with self._lock:
+            counters = {k: v for k, v in self._counters.items()
+                        if k.startswith(prefix)}
+            gauges = [(k, fn) for k, fn in self._gauges.items()
+                      if k.startswith(prefix)]
+            hists = {k: h.raw() for k, h in self._hists.items()
+                     if k.startswith(prefix)}
+        gauge_vals: Dict[str, float] = {}
+        for k, fn in gauges:
+            try:
+                gauge_vals[k] = fn()
+            except Exception:  # noqa: BLE001 - snapshot() owns gauge diagnostics
+                pass
+        return {"counters": counters, "gauges": gauge_vals,
+                "histograms": hists}
+
     def reset(self) -> None:
         """Test hook."""
         with self._lock:
@@ -174,3 +226,104 @@ class MetricsRegistry:
 
 
 METRICS = MetricsRegistry()
+
+
+# ---------------------------------------------------------------------------
+# cluster roll-up: merge raw snapshots from many processes, re-derive
+# percentiles from the MERGED buckets (memory/ClusterMemoryManager's shape
+# applied to metrics: the coordinator's GET /v1/cluster/metrics sums every
+# worker's counters and merges every worker's histogram buckets — summing
+# per-worker percentiles would be statistically meaningless)
+# ---------------------------------------------------------------------------
+
+def merge_raw_snapshots(snapshots) -> Dict:
+    """Merge raw_snapshot() dicts: counters and gauges sum, histogram
+    buckets add element-wise. Returns the same raw shape."""
+    counters: Dict[str, float] = {}
+    gauges: Dict[str, float] = {}
+    hists: Dict[str, Histogram] = {}
+    for snap in snapshots:
+        if not snap:
+            continue
+        for k, v in (snap.get("counters") or {}).items():
+            if isinstance(v, (int, float)):
+                counters[k] = counters.get(k, 0) + v
+        for k, v in (snap.get("gauges") or {}).items():
+            if isinstance(v, (int, float)):
+                gauges[k] = gauges.get(k, 0) + v
+        for k, raw in (snap.get("histograms") or {}).items():
+            h = hists.get(k)
+            if h is None:
+                h = hists[k] = Histogram()
+            h.merge_raw(raw)
+    return {"counters": counters, "gauges": gauges,
+            "histograms": {k: h.raw() for k, h in hists.items()}}
+
+
+def flatten_raw(raw: Dict) -> Dict[str, float]:
+    """Raw snapshot -> the flat JSON shape /v1/metrics serves (histograms
+    expand to <name>.count/.p50/.p95/.p99, re-derived from the buckets)."""
+    out: Dict[str, float] = dict(raw.get("counters") or {})
+    out.update(raw.get("gauges") or {})
+    for k, hraw in (raw.get("histograms") or {}).items():
+        for stat, v in Histogram.from_raw(hraw).summary().items():
+            out[f"{k}.{stat}"] = v
+    return out
+
+
+def _prom_name(name: str) -> str:
+    import re
+    return "presto_tpu_" + re.sub(r"[^a-zA-Z0-9_:]", "_", name)
+
+
+def prometheus_text(raw: Dict) -> str:
+    """Prometheus text exposition (v0.0.4) of a raw snapshot: counters as
+    `counter`, gauges as `gauge`, histograms as native Prometheus histograms
+    (cumulative le-bucketed counts + _sum + _count) so one scrape config
+    covers every server and `?format=prometheus` on the cluster endpoint
+    yields fleet-wide distributions."""
+    lines = []
+    for k in sorted(raw.get("counters") or {}):
+        v = raw["counters"][k]
+        name = _prom_name(k)
+        lines.append(f"# TYPE {name} counter")
+        lines.append(f"{name} {v}")
+    for k in sorted(raw.get("gauges") or {}):
+        v = raw["gauges"][k]
+        name = _prom_name(k)
+        lines.append(f"# TYPE {name} gauge")
+        lines.append(f"{name} {v}")
+    for k in sorted(raw.get("histograms") or {}):
+        h = Histogram.from_raw(raw["histograms"][k])
+        name = _prom_name(k + "_seconds")
+        lines.append(f"# TYPE {name} histogram")
+        cum = 0
+        last = max((i for i, c in enumerate(h.counts) if c), default=-1)
+        for i in range(last + 1):
+            cum += h.counts[i]
+            le = Histogram.bucket_bound(i)
+            lines.append(f'{name}_bucket{{le="{le:g}"}} {cum}')
+        lines.append(f'{name}_bucket{{le="+Inf"}} {h.n}')
+        lines.append(f"{name}_sum {h.total}")
+        lines.append(f"{name}_count {h.n}")
+    return "\n".join(lines) + "\n"
+
+
+def metrics_http_body(query: str, registry: Optional[MetricsRegistry] = None,
+                      prefix: str = "") -> tuple:
+    """Shared /v1/metrics renderer for the server and worker handlers:
+    -> (body bytes, content-type). `query` is the raw URL query string —
+    `raw=1` serves the mergeable snapshot, `format=prometheus` the text
+    exposition, default the flat JSON."""
+    import json as _json
+    import urllib.parse
+
+    reg = registry or METRICS
+    params = urllib.parse.parse_qs(query or "")
+    if params.get("raw", [""])[0] in ("1", "true"):
+        return (_json.dumps(reg.raw_snapshot(prefix)).encode(),
+                "application/json")
+    if params.get("format", [""])[0] == "prometheus":
+        return (prometheus_text(reg.raw_snapshot(prefix)).encode(),
+                "text/plain; version=0.0.4")
+    return _json.dumps(reg.snapshot(prefix)).encode(), "application/json"

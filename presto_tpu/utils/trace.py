@@ -25,6 +25,15 @@ records the timeline itself:
 - Export is Chrome trace-event JSON (the ``{"traceEvents": [...]}`` shape
   that loads directly in Perfetto / ``chrome://tracing``), reachable as
   ``QueryResult.trace_path`` and over ``GET /v1/query/{id}/trace``.
+- **Black-box mode (always on)**: production failures happen on queries
+  nobody opted into tracing. Every query therefore gets a COARSE recorder
+  (small ring, operator/segment per-page spans dropped at the source) unless
+  the ``query_blackbox`` session knob turns it off; when the query fails, is
+  OOM-killed or exhausts its retries, the ring is exported as a forensic
+  Chrome trace attached to the failure (``QueryResult.failure_trace_path``,
+  the exception's ``failure_trace_path`` attribute, and
+  ``GET /v1/query/{id}/trace`` — which now answers for FAILED queries).
+  A query that succeeds pays only the ring appends and drops the recorder.
 
 Categories — one per instrumented subsystem:
   lifecycle  parse / plan / local-plan / execute phases
@@ -35,6 +44,7 @@ Categories — one per instrumented subsystem:
   exchange   streaming-exchange chunk dispatch/delivery + pump stalls
   kernel     kernel-cache misses (jit closure builds)
   http       cluster task create/poll and exchange pulls
+  pool       shared-pool generator steps (exec/shared_pools.py)
 """
 from __future__ import annotations
 
@@ -53,8 +63,18 @@ SCAN = "scan"
 EXCHANGE = "exchange"
 KERNEL = "kernel"
 HTTP = "http"
+POOL = "pool"
 
 DEFAULT_MAX_EVENTS = 1 << 16
+
+# always-on black-box ring: small enough to be free, large enough that the
+# last seconds of a failing query's coarse timeline survive to the dump
+BLACKBOX_MAX_EVENTS = 1 << 13
+
+# per-page categories a coarse (black-box) recorder drops at the source —
+# everything else (driver quanta, exchange chunks, scan stage work/stalls,
+# pool steps, kernel builds, cluster HTTP) is coarse by construction
+_COARSE_DROP = frozenset((OPERATOR, SEGMENT))
 
 # operator add_input/get_output fire constantly (get_output polls return
 # None most slices); spans shorter than this are noise that would churn the
@@ -67,9 +87,15 @@ _TRACE_SEQ = itertools.count(1)
 class TraceRecorder:
     """Ring buffer of (category, name, t0_ns, dur_ns, tid, tname, args)."""
 
-    def __init__(self, query_id: str = "", max_events: int = 0):
+    def __init__(self, query_id: str = "", max_events: int = 0,
+                 coarse: bool = False):
         self.query_id = query_id or f"trace-{next(_TRACE_SEQ)}"
         self.max_events = max(int(max_events or DEFAULT_MAX_EVENTS), 16)
+        # coarse = the always-on black-box mode: per-page operator/segment
+        # spans are dropped before the tuple is even built, so the hot paths
+        # pay one frozenset lookup — the ring holds only coarse spans
+        self.coarse = coarse
+        self._drop = _COARSE_DROP if coarse else frozenset()
         self._lock = threading.Lock()
         self._events: List[tuple] = []
         self._next = 0           # overwrite cursor once the ring is full
@@ -80,6 +106,8 @@ class TraceRecorder:
 
     def record(self, cat: str, name: str, t0_ns: int, dur_ns: int,
                args: Optional[dict] = None) -> None:
+        if cat in self._drop:
+            return
         t = threading.current_thread()
         evt = (cat, name, t0_ns, dur_ns, t.ident, t.name, args)
         with self._lock:
@@ -132,7 +160,8 @@ class TraceRecorder:
                   "args": {"name": n}} for t, n in sorted(threads.items())]
         return {"traceEvents": meta + spans, "displayTimeUnit": "ms",
                 "otherData": {"query_id": self.query_id,
-                              "dropped_events": self.dropped}}
+                              "dropped_events": self.dropped,
+                              "coarse": self.coarse}}
 
     def write(self, path: str) -> str:
         with open(path, "w", encoding="utf-8") as f:
@@ -261,14 +290,23 @@ def span(cat: str, name: str, **args) -> _Span:
 # ---------------------------------------------------------------------------
 
 def maybe_recorder(session, query_id: str = "") -> Optional[TraceRecorder]:
-    """A TraceRecorder when the session's `query_trace` knob is on."""
-    if not session.get("query_trace"):
+    """The query's recorder: a FULL one when the session's `query_trace`
+    knob is on, else the always-on coarse black-box ring (disable with
+    `query_blackbox=False` — what the bench's overhead rung compares
+    against). None only when both are off."""
+    if session.get("query_trace"):
+        return TraceRecorder(query_id,
+                             int(session.get("query_trace_max_events") or 0))
+    if not session.get("query_blackbox", True):
         return None
-    return TraceRecorder(query_id,
-                         int(session.get("query_trace_max_events") or 0))
+    return TraceRecorder(
+        query_id,
+        int(session.get("query_blackbox_max_events") or 0)
+        or BLACKBOX_MAX_EVENTS,
+        coarse=True)
 
 
-def export(recorder: TraceRecorder, session) -> str:
+def export(recorder: TraceRecorder, session, suffix: str = "") -> str:
     """Write the Chrome trace JSON under `query_trace_dir` (tempdir default)
     and return the path (what QueryResult.trace_path carries)."""
     import tempfile
@@ -277,8 +315,30 @@ def export(recorder: TraceRecorder, session) -> str:
         tempfile.gettempdir()
     os.makedirs(directory, exist_ok=True)
     path = os.path.join(
-        directory, f"presto-trace-{os.getpid()}-{recorder.query_id}.json")
+        directory,
+        f"presto-trace-{os.getpid()}-{recorder.query_id}{suffix}.json")
     return recorder.write(path)
+
+
+def attach_failure(exc: BaseException, recorder: TraceRecorder,
+                   session) -> Optional[str]:
+    """Failure forensics: dump `recorder`'s ring (scoped to this query) as a
+    Chrome trace and pin the path onto the exception — the protocol layer
+    ships it as `QueryInfo.failure_trace_path` so `GET /v1/query/{id}/trace`
+    answers for FAILED queries. First writer wins (the innermost engine tier
+    saw the most detail); the dump itself must never mask the real error."""
+    if getattr(exc, "failure_trace_path", None):
+        return exc.failure_trace_path
+    try:
+        path = export(recorder, session, suffix="-forensic")
+        exc.failure_trace_path = path
+        from . import events
+        events.emit("query.forensic_dumped", severity="error",
+                    query_id=recorder.query_id, path=path,
+                    error=type(exc).__name__)
+        return path
+    except Exception:  # noqa: BLE001 - forensics are best-effort
+        return None
 
 
 # ---------------------------------------------------------------------------

@@ -236,9 +236,9 @@ def main(argv=None) -> int:
     results = v.run(suite)
     bad = 0
     for r in results:
-        print(f"{r.name:>6}  {r.status:<14} {r.detail}")
+        print(f"{r.name:>6}  {r.status:<14} {r.detail}")  # prestocheck: ignore[print-hygiene] - verifier CLI renderer
         bad += r.status != MATCH
-    print(f"{len(results) - bad}/{len(results)} MATCH")
+    print(f"{len(results) - bad}/{len(results)} MATCH")  # prestocheck: ignore[print-hygiene] - verifier CLI renderer
     return 1 if bad else 0
 
 

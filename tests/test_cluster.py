@@ -265,3 +265,21 @@ def test_graceful_shutdown_drains():
         assert exc.value.code == 503
     finally:
         w.stop()
+
+
+def test_cluster_explain_analyze_final_stats_deterministic(cluster):
+    """De-flake regression (the old `TableScan In=0`): rendered roll-ups
+    must come from the final-state stats snapshot each SqlTask freezes
+    before its terminal transition, never from a cached mid-run monitor
+    poll. Three back-to-back runs all render complete scan accounting."""
+    runner, _local = cluster
+    for _ in range(3):
+        res = runner.execute(
+            "explain analyze select r_name, count(*) from region "
+            "group by r_name")
+        text = "\n".join(r[0] for r in res.rows)
+        scan_lines = [line for line in text.splitlines()
+                      if line.strip().startswith("TableScan")]
+        assert scan_lines, text
+        for line in scan_lines:
+            assert int(line.split()[1]) > 0, f"TableScan In=0 flake:\n{text}"

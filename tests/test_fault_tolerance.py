@@ -7,6 +7,7 @@ storms on task create, injected task failures. Results of retried queries are
 checked row-identical against the single-process LocalQueryRunner."""
 import random
 import threading
+import time
 
 import pytest
 
@@ -402,3 +403,141 @@ def test_deterministic_query_error_is_not_retried(local_runner):
                 "select count(*) from memory.default.coord_only2")
     finally:
         cluster.close()
+
+
+# ---------------------------------------------------------------------------
+# black-box failure forensics (observability PR): a query that never opted
+# into tracing still leaves a Chrome-trace forensic when it fails
+# ---------------------------------------------------------------------------
+
+def _load_forensic(exc):
+    import json as _json
+
+    path = getattr(exc, "failure_trace_path", None)
+    assert path, f"no forensic on {type(exc).__name__}: {exc}"
+    with open(path) as f:
+        doc = _json.load(f)
+    assert doc["otherData"]["coarse"] is True
+    assert isinstance(doc["traceEvents"], list) and doc["traceEvents"]
+    return doc
+
+
+def test_fault_injected_failure_dumps_forensic_trace():
+    """NONE policy + worker killed mid-query: the failure carries a
+    Perfetto-loadable forensic of the always-on coarse ring (cluster HTTP
+    spans included) even though query_trace was never set."""
+    from presto_tpu.utils import trace as _trace
+    from presto_tpu.utils.events import JOURNAL
+
+    cluster = _Cluster()  # retry_policy NONE: fails fast
+    victim = cluster.workers[0]
+    _kill_rule(cluster, victim)
+    try:
+        with pytest.raises(Exception) as ei:
+            cluster.runner.execute(AGG_SQL)
+    finally:
+        cluster.close()
+    doc = _load_forensic(ei.value)
+    cats = _trace.span_categories(doc)
+    assert cats.get("http", 0) > 0, f"no cluster HTTP spans: {cats}"
+    # the journal recorded the attempt failure with the cluster query id
+    attempts = JOURNAL.events(kind="query.attempt_failed")
+    assert attempts and attempts[-1]["query_id"].startswith("cq")
+
+
+def test_query_surviving_retry_carries_failed_attempt_forensic(local_runner):
+    """QUERY policy, kill survives via retry: the SUCCESSFUL result still
+    carries the forensic of the failed first attempt plus a query.retry
+    journal event."""
+    from presto_tpu.utils.events import JOURNAL
+
+    cluster = _Cluster(properties={"retry_policy": "QUERY",
+                                   "retry_initial_delay_s": 0.02,
+                                   "retry_max_delay_s": 0.1})
+    victim = cluster.workers[0]
+    _kill_rule(cluster, victim)
+    try:
+        got = cluster.runner.execute(AGG_SQL)
+    finally:
+        cluster.close()
+    assert_rows_equal(got.rows, local_runner.execute(AGG_SQL).rows,
+                      ordered=False)
+    assert got.failure_trace_path, "retried query lost its attempt forensic"
+    import json as _json
+    doc = _json.load(open(got.failure_trace_path))
+    assert doc["otherData"]["coarse"] is True
+    retries = JOURNAL.events(kind="query.retry")
+    assert retries and retries[-1]["attempt"] >= 1
+
+
+def test_oom_killed_query_dumps_forensic_and_journals_decision():
+    """Deterministic OOM kill: a ClusterMemoryManager polled by hand (with
+    a status fetch that inflates reported bytes) kills the live query; the
+    query fails with a forensic attached, and the journal holds the
+    query.oom_killed decision with the per-worker bytes snapshot that
+    justified the victim."""
+    import json as _json
+    import urllib.request as _rq
+
+    from presto_tpu.cluster.memory_manager import ClusterMemoryManager
+    from presto_tpu.utils.events import JOURNAL
+
+    # small exchange error budget: the OOM abort poisons task buffers and
+    # consumers see 500s — they must give up in seconds, not the 60s default
+    cluster = _Cluster(properties={"exchange_error_budget_s": 2.0})
+    runner = cluster.runner
+
+    def inflated(uri):
+        with _rq.urlopen(f"{uri}/v1/status", timeout=2.0) as resp:
+            status = _json.loads(resp.read())
+        status["queryMemory"] = {
+            qid: b + (1 << 40)
+            for qid, b in (status.get("queryMemory") or {}).items()}
+        return status
+
+    mgr = ClusterMemoryManager(runner.nodes, kill_query=runner._kill_query,
+                               limit_bytes=1 << 30, grace_polls=1,
+                               fetch_status=inflated)
+
+    # hold every results pull briefly so the query stays live across polls
+    inj = faults.FaultInjector(seed=3)
+    inj.add("worker.results", faults.CALLBACK, times=None,
+            callback=lambda ctx: time.sleep(0.15))
+    faults.install(inj)
+
+    box = {}
+
+    def run():
+        try:
+            runner.execute(AGG_SQL)
+            box["ok"] = True
+        except BaseException as e:  # noqa: BLE001 - inspected by the test
+            box["error"] = e
+
+    t = threading.Thread(target=run)
+    t.start()
+    try:
+        deadline = time.monotonic() + 30.0
+        victim = None
+        while victim is None and time.monotonic() < deadline \
+                and t.is_alive():
+            victim = mgr.poll_once()
+            time.sleep(0.05)
+        t.join(timeout=60.0)
+        assert not t.is_alive(), "query wedged after OOM kill"
+        if "ok" in box:
+            pytest.skip("query finished before the memory manager saw it")
+        assert victim is not None, "memory manager never picked a victim"
+    finally:
+        cluster.close()
+        faults.clear()
+
+    _load_forensic(box["error"])
+    kills = JOURNAL.events(kind="query.oom_killed")
+    assert kills, "no oom_killed event journaled"
+    kill = kills[-1]
+    assert kill["query_id"] == victim and kill["severity"] == "error"
+    # the per-worker evidence snapshot rode along
+    assert kill["per_node"], kill
+    assert any(victim in qmap for qmap in kill["per_node"].values())
+    assert kill["victim_bytes"] > kill["limit_bytes"] >= 1 << 30

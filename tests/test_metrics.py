@@ -119,3 +119,139 @@ def test_query_lifecycle_counters_and_endpoint():
         assert all(k.startswith("query_manager") for k in only)
     finally:
         server.stop()
+
+
+# ---------------------------------------------------------------------------
+# mergeable snapshots + cluster roll-up math (observability PR)
+# ---------------------------------------------------------------------------
+
+def test_histogram_raw_roundtrip_and_merge_equals_union():
+    """Merged percentiles == union-of-samples percentiles EXACTLY: the
+    fixed shared bucket geometry makes the bucket-count merge lossless
+    relative to per-histogram bucketing (the satellite's oracle)."""
+    import random
+
+    from presto_tpu.utils.metrics import (Histogram, MetricsRegistry,
+                                          flatten_raw, merge_raw_snapshots)
+
+    rng = random.Random(42)
+    a = [rng.uniform(1e-6, 30.0) for _ in range(700)]
+    b = [rng.uniform(1e-5, 0.5) for _ in range(350)]
+    r1, r2 = MetricsRegistry(), MetricsRegistry()
+    for v in a:
+        r1.histogram("x.wall_s", v)
+    r1.count("c.total", 5)
+    for v in b:
+        r2.histogram("x.wall_s", v)
+    r2.count("c.total", 7)
+    r2.histogram("only.on_two_s", 0.25)
+
+    merged = merge_raw_snapshots([r1.raw_snapshot(), r2.raw_snapshot()])
+    flat = flatten_raw(merged)
+
+    oracle = Histogram()
+    for v in a + b:
+        oracle.add(v)
+    assert flat["c.total"] == 12
+    assert flat["x.wall_s.count"] == len(a) + len(b)
+    for q, key in ((0.50, "x.wall_s.p50"), (0.95, "x.wall_s.p95"),
+                   (0.99, "x.wall_s.p99")):
+        assert flat[key] == round(oracle.percentile(q), 6)
+    # a histogram present on only one worker merges through unchanged
+    assert flat["only.on_two_s.count"] == 1
+    # raw -> Histogram roundtrip preserves everything
+    h = Histogram.from_raw(oracle.raw())
+    assert h.raw() == oracle.raw()
+
+
+def test_prometheus_exposition_shape():
+    from presto_tpu.utils.metrics import MetricsRegistry, prometheus_text
+
+    reg = MetricsRegistry()
+    reg.count("queries.completed", 3)
+    reg.set_gauge("pool.bytes", lambda: 123)
+    for v in (0.002, 0.004, 1.5):
+        reg.histogram("q.wall_s", v)
+    text = prometheus_text(reg.raw_snapshot())
+    assert "# TYPE presto_tpu_queries_completed counter" in text
+    assert "presto_tpu_queries_completed 3" in text
+    assert "# TYPE presto_tpu_pool_bytes gauge" in text
+    assert "# TYPE presto_tpu_q_wall_s_seconds histogram" in text
+    # cumulative buckets end at +Inf == count; sum carries the total
+    assert 'presto_tpu_q_wall_s_seconds_bucket{le="+Inf"} 3' in text
+    assert "presto_tpu_q_wall_s_seconds_count 3" in text
+    lines = [l for l in text.splitlines() if "_bucket{" in l]
+    counts = [int(l.rsplit(" ", 1)[1]) for l in lines]
+    assert counts == sorted(counts), "bucket counts must be cumulative"
+
+
+def test_metrics_http_raw_and_prometheus_formats():
+    from presto_tpu.utils.metrics import MetricsRegistry, metrics_http_body
+
+    reg = MetricsRegistry()
+    reg.count("a.b", 2)
+    reg.histogram("h.s", 0.1)
+    body, ctype = metrics_http_body("raw=1", registry=reg)
+    snap = json.loads(body)
+    assert ctype == "application/json"
+    assert snap["counters"]["a.b"] == 2 and "h.s" in snap["histograms"]
+    body, ctype = metrics_http_body("format=prometheus", registry=reg)
+    assert ctype.startswith("text/plain")
+    assert b"# TYPE presto_tpu_a_b counter" in body
+    # default stays the flat snapshot (back-compat)
+    body, _ = metrics_http_body("", registry=reg)
+    flat = json.loads(body)
+    assert flat["a.b"] == 2 and flat["h.s.count"] == 1
+
+
+def test_cluster_metrics_endpoint_merges_workers():
+    """GET /v1/cluster/metrics on a coordinator merges the workers'
+    /v1/metrics?raw=1 snapshots; the flat answer equals a hand-merge."""
+    import urllib.request as _rq
+
+    from presto_tpu.cluster.worker import WorkerServer
+    from presto_tpu.metadata import Session
+    from presto_tpu.runner import LocalQueryRunner
+    from presto_tpu.server.http_server import PrestoTpuServer
+    from presto_tpu.utils.metrics import flatten_raw, merge_raw_snapshots
+
+    workers = [WorkerServer(port=0).start() for _ in range(2)]
+    runner = LocalQueryRunner(session=Session(catalog="tpch", schema="tiny"))
+
+    class _Nodes:  # minimal DiscoveryNodeManager stand-in
+        def active_nodes(self):
+            import dataclasses
+
+            @dataclasses.dataclass
+            class N:
+                node_id: str
+                uri: str
+            return [N(w.node_id, w.uri) for w in workers]
+
+    runner.nodes = _Nodes()
+    server = PrestoTpuServer(runner, port=0)
+    server.start()
+    base = f"http://127.0.0.1:{server.port}"
+    try:
+        raws = []
+        for w in workers:
+            with _rq.urlopen(f"{w.uri}/v1/metrics?raw=1", timeout=10) as r:
+                raws.append(json.loads(r.read()))
+        oracle = flatten_raw(merge_raw_snapshots(raws))
+        merged = json.loads(_rq.urlopen(
+            _rq.Request(f"{base}/v1/cluster/metrics",
+                        headers={"X-Presto-User": "t"}), timeout=10).read())
+        assert merged["cluster.workers_merged"] == 2
+        for k in oracle:
+            if k.endswith((".p50", ".p95", ".p99", ".count")):
+                assert merged.get(k) == oracle[k], (k, merged.get(k),
+                                                    oracle[k])
+        prom = _rq.urlopen(
+            _rq.Request(f"{base}/v1/cluster/metrics?format=prometheus",
+                        headers={"X-Presto-User": "t"}),
+            timeout=10).read().decode()
+        assert prom.startswith("# TYPE") or "# TYPE" in prom
+    finally:
+        server.stop()
+        for w in workers:
+            w.stop()

@@ -23,7 +23,8 @@ EXPECTED_PASSES = {"undefined-name", "tracer-safety", "lock-discipline",
                    "exception-hygiene", "retry-discipline",
                    "mutable-default-args", "sleep-poll", "host-sync",
                    "unbounded-cache", "wallclock-duration",
-                   "shared-state-race", "thread-lifecycle"}
+                   "shared-state-race", "thread-lifecycle",
+                   "print-hygiene"}
 
 
 def _scan(tmp_path, source, select=None, name="mod.py"):
@@ -1059,6 +1060,55 @@ def test_wallclock_duration_suppression(tmp_path):
             return time.time() - grace_s  # prestocheck: ignore[wallclock-duration]
         """, select=["wallclock-duration"])
     assert findings == [], _messages(findings)
+
+
+# ------------------------------------------------------------- print-hygiene
+
+def test_print_hygiene_flags_bare_print(tmp_path):
+    findings = _scan(tmp_path, """
+        def report(state):
+            print("engine state:", state)
+        """, select=["print-hygiene"])
+    assert len(findings) == 1
+    assert "events.emit" in findings[0].message
+
+
+def test_print_hygiene_allows_stderr_and_suppression(tmp_path):
+    findings = _scan(tmp_path, """
+        import sys
+
+        def diag(e):
+            print(f"probe failed: {e!r}", file=sys.stderr)
+
+        def banner(port):
+            print(f"listening on :{port}")  # prestocheck: ignore[print-hygiene] - CLI banner
+
+        def journaled(qid):
+            from presto_tpu.utils import events
+            events.emit("query.finished", query_id=qid)
+        """, select=["print-hygiene"])
+    assert findings == [], _messages(findings)
+
+
+def test_print_hygiene_exempts_cli_tools_and_main(tmp_path):
+    src = """
+        def main():
+            print("interactive output")
+        """
+    for rel in ("cli/repl.py", "tools/sweep.py", "tests/test_x.py",
+                "__main__.py"):
+        path = tmp_path / rel
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(textwrap.dedent(src))
+        findings = run([str(path)], select=["print-hygiene"],
+                       baseline_path=None).new_findings
+        assert findings == [], (rel, _messages(findings))
+    # the same module OUTSIDE an exempt segment is flagged
+    flagged = tmp_path / "engine.py"
+    flagged.write_text(textwrap.dedent(src))
+    findings = run([str(flagged)], select=["print-hygiene"],
+                   baseline_path=None).new_findings
+    assert len(findings) == 1
 
 
 # ------------------------------------------------------------- tier-1 gate

@@ -271,3 +271,80 @@ def test_trace_http_endpoint(tmp_path):
         assert info["elapsedMillis"] >= 0
     finally:
         server.stop()
+
+
+# ---------------------------------------------------------------------------
+# always-on black-box mode (observability PR)
+# ---------------------------------------------------------------------------
+
+def test_maybe_recorder_modes():
+    from presto_tpu.utils.trace import BLACKBOX_MAX_EVENTS, TraceRecorder
+
+    coarse = trace.maybe_recorder(Session(catalog="tpch", schema="tiny"))
+    assert isinstance(coarse, TraceRecorder)
+    assert coarse.coarse and coarse.max_events == BLACKBOX_MAX_EVENTS
+
+    full = trace.maybe_recorder(Session(
+        catalog="tpch", schema="tiny", properties={"query_trace": True}))
+    assert not full.coarse
+
+    off = trace.maybe_recorder(Session(
+        catalog="tpch", schema="tiny",
+        properties={"query_blackbox": False}))
+    assert off is None
+
+
+def test_coarse_recorder_drops_per_page_categories():
+    rec = trace.TraceRecorder("q", max_events=64, coarse=True)
+    rec.record(trace.OPERATOR, "op.add_input", 0, 100)
+    rec.record(trace.SEGMENT, "page", 0, 100)
+    rec.record(trace.DRIVER, "scan->sink", 0, 100)
+    rec.record(trace.EXCHANGE, "chunk_dispatch", 0, 100)
+    rec.record(trace.POOL, "scan_step", 0, 100)
+    cats = {e[0] for e in rec.events()}
+    assert cats == {trace.DRIVER, trace.EXCHANGE, trace.POOL}
+
+
+def test_blackbox_success_exports_nothing_failure_dumps_forensic(tmp_path):
+    import json as _json
+
+    runner = LocalQueryRunner(session=Session(
+        catalog="tpch", schema="tiny",
+        properties={"query_trace_dir": str(tmp_path)}))
+    ok = runner.execute(QUERIES[6])
+    assert ok.trace_path is None and ok.failure_trace_path is None
+    assert trace.active() is None
+    assert list(tmp_path.iterdir()) == []  # success writes no files
+
+    with pytest.raises(Exception) as ei:
+        runner.execute("select definitely_missing from lineitem")
+    path = getattr(ei.value, "failure_trace_path", None)
+    assert path and path.startswith(str(tmp_path))
+    doc = _json.load(open(path))
+    assert doc["otherData"]["coarse"] is True
+    assert trace.active() is None  # recorder never leaks past its query
+
+
+def test_blackbox_off_is_off():
+    runner = LocalQueryRunner(session=Session(
+        catalog="tpch", schema="tiny",
+        properties={"query_blackbox": False}))
+    with pytest.raises(Exception) as ei:
+        runner.execute("select definitely_missing from lineitem")
+    assert getattr(ei.value, "failure_trace_path", None) is None
+
+
+def test_full_trace_still_wins_for_failed_queries(tmp_path):
+    """query_trace=on + failure: the forensic rides the exception AND the
+    ring has the full (non-coarse) detail."""
+    import json as _json
+
+    runner = LocalQueryRunner(session=Session(
+        catalog="tpch", schema="tiny",
+        properties={"query_trace": True,
+                    "query_trace_dir": str(tmp_path)}))
+    with pytest.raises(Exception) as ei:
+        runner.execute("select definitely_missing from lineitem")
+    path = getattr(ei.value, "failure_trace_path", None)
+    assert path
+    assert _json.load(open(path))["otherData"]["coarse"] is False

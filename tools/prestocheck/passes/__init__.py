@@ -11,3 +11,4 @@ from . import unbounded_cache  # noqa: F401
 from . import wallclock_duration  # noqa: F401
 from . import shared_state_race  # noqa: F401
 from . import thread_lifecycle  # noqa: F401
+from . import print_hygiene  # noqa: F401

@@ -1,0 +1,63 @@
+"""print-hygiene: bare ``print(...)`` in engine code.
+
+The observability PR built a structured event journal (utils/events.py —
+bounded ring + JSONL sink + GET /v1/events) precisely so operational facts
+stop leaking out as free-form stdout lines nobody can query, filter or ship.
+A bare ``print()`` in engine code is invisible to the journal, interleaves
+arbitrarily under concurrent queries, and corrupts machine-read stdout
+protocols (the bench's single JSON line, the graft driver's ``KEY=`` lines).
+This pass keeps the pattern from reappearing.
+
+Rules:
+- Flagged: any ``print(...)`` call with no ``file=`` keyword (stdout).
+- Not flagged: ``print(..., file=sys.stderr)`` — an explicit diagnostic
+  channel (stderr never collides with protocol stdout); these sites should
+  usually ALSO journal, but the print itself is hygienic.
+- Exempt paths: ``tools/`` and ``tests/`` (developer CLIs), any path
+  segment named ``cli`` (the interactive REPL is a renderer by definition),
+  and ``__main__.py`` modules.
+- CLI entry banners and explicit renderers inside engine modules carry a
+  justified ``# prestocheck: ignore[print-hygiene]`` — the suppression IS
+  the documentation that stdout is the intended surface there.
+"""
+from __future__ import annotations
+
+import ast
+import os
+
+from ..core import Finding, Module, Pass, register
+
+_EXEMPT_SEGMENTS = {"tools", "tests", "cli"}
+
+
+def _exempt(path: str) -> bool:
+    parts = os.path.normpath(path).split(os.sep)
+    if any(p in _EXEMPT_SEGMENTS for p in parts):
+        return True
+    return parts[-1] == "__main__.py"
+
+
+@register
+class PrintHygienePass(Pass):
+    id = "print-hygiene"
+    description = ("bare print() in engine code — route operational facts "
+                   "through the event journal (utils/events.emit) or an "
+                   "explicit renderer; stderr diagnostics must say "
+                   "file=sys.stderr")
+
+    def check_module(self, module: Module):
+        if module.tree is None or _exempt(module.path):
+            return
+        for node in ast.walk(module.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Name)
+                    and node.func.id == "print"):
+                continue
+            if any(kw.arg == "file" for kw in node.keywords):
+                continue  # explicit channel (stderr diagnostics)
+            yield Finding(
+                module.path, node.lineno, node.col_offset, self.id,
+                "bare print() writes engine state to stdout — use "
+                "utils/events.emit (journaled, queryable at /v1/events) or "
+                "print(..., file=sys.stderr) for diagnostics; renderers "
+                "carry a justified suppression")
