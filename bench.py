@@ -659,6 +659,179 @@ def bench_serving(clients=(1, 4, 8), per_client: int = 4,
         server.stop()
 
 
+def bench_hash_kernels(quick: bool = False, skew_devices: int = 4,
+                       skew_budget_s: float = 600.0) -> dict:
+    """Pallas hash-kernel rung (VERDICT ask #6: one Pallas kernel that wins
+    — or a written negative result). Three measurements:
+
+    - micro: open-addressing insert+probe (ops/pallas_hash.py, interpreted
+      off-TPU) vs the sorted build (argsort) + binary-search probe, same
+      keys, SF1-scale N — the isolated build/probe wall comparison;
+    - engine: warm TPC-H Q3 wall with `hash_kernels=pallas` vs `sorted`
+      (the strategy knob end to end, SF1 on the full ladder);
+    - skew: a 99%-one-key partitioned INNER join on a virtual mesh
+      (subprocess), skew-aware vs not — wall + per-partition row spread.
+
+    The rung's top-level `wall_s` is the DEFAULT path's Q3 wall, so
+    `--compare` gates the production path; the pallas numbers ride along as
+    the measured verdict (win or dated negative result, recorded either
+    way)."""
+    import statistics
+
+    import jax
+    import jax.numpy as jnp
+
+    from presto_tpu.ops import pallas_hash as ph
+    from presto_tpu.ops.hash_join import (_probe_match_sorted_unique,
+                                          _sorted_kernel_ck)
+
+    out = {"interpreted": ph.interpret_mode()}
+
+    def median_wall(fn, runs=5):
+        walls = []
+        for _ in range(runs):
+            t0 = time.perf_counter()
+            jax.block_until_ready(fn())
+            walls.append(time.perf_counter() - t0)
+        return statistics.median(walls)
+
+    # ---- micro: build + probe walls on identical keys --------------------
+    n = 1 << 17 if quick else 1 << 20
+    rng = np.random.RandomState(42)
+    keys = jnp.asarray(rng.permutation(8 * n)[:n].astype(np.int64))
+    mask = jnp.ones(n, dtype=jnp.bool_)
+    probes = jnp.asarray(rng.randint(0, 8 * n, n).astype(np.int64))
+    slots = ph.table_slots(n)
+    insert = ph.insert_table_jit(1, n, slots)
+    (slot_keys,), slot_rows, _gid, stats = jax.block_until_ready(
+        insert((keys,), mask))
+    trips = ph.probe_trips_for(int(np.asarray(stats)[1]))
+    import functools as _ft
+    pallas_probe = jax.jit(_ft.partial(ph.probe_table, trips=trips))
+    sorted_key, sorted_row = jax.block_until_ready(
+        _sorted_kernel_ck(keys, mask))
+    micro = {
+        "n_rows": n, "table_slots": slots, "probe_trips": trips,
+        "pallas_build_wall_s": round(
+            median_wall(lambda: insert((keys,), mask)), 4),
+        "sorted_build_wall_s": round(
+            median_wall(lambda: _sorted_kernel_ck(keys, mask)), 4),
+        "pallas_probe_wall_s": round(
+            median_wall(lambda: pallas_probe(slot_keys, slot_rows, probes,
+                                             mask)), 4),
+        "sorted_probe_wall_s": round(
+            median_wall(lambda: _probe_match_sorted_unique(
+                sorted_key, sorted_row, probes, (probes,), mask,
+                (keys,))), 4),
+    }
+    micro["build_speedup"] = round(
+        micro["sorted_build_wall_s"] /
+        max(micro["pallas_build_wall_s"], 1e-9), 3)
+    micro["probe_speedup"] = round(
+        micro["sorted_probe_wall_s"] /
+        max(micro["pallas_probe_wall_s"], 1e-9), 3)
+    out["micro"] = micro
+
+    # ---- engine: Q3 warm wall, strategy knob end to end -------------------
+    from presto_tpu.metadata import Session
+    from presto_tpu.runner import LocalQueryRunner
+    from presto_tpu.models.tpch_sql import QUERIES
+    from presto_tpu.utils.metrics import METRICS
+
+    schema = "tiny" if quick else "sf1"
+    engine = {"schema": schema}
+    for strategy in ("sorted", "pallas"):
+        runner = LocalQueryRunner(session=Session(
+            catalog="tpch", schema=schema,
+            properties={"hash_kernels": strategy}))
+        before = METRICS.snapshot().get("pallas.join_builds", 0)
+        runner.execute(QUERIES[3])  # warm
+        walls = []
+        for _ in range(2 if quick else 3):
+            t0 = time.perf_counter()
+            runner.execute(QUERIES[3])
+            walls.append(time.perf_counter() - t0)
+        engine[f"{strategy}_q3_wall_s"] = round(statistics.median(walls), 3)
+        if strategy == "pallas":
+            engine["pallas_join_builds"] = \
+                METRICS.snapshot().get("pallas.join_builds", 0) - before
+    engine["pallas_vs_sorted"] = round(
+        engine["sorted_q3_wall_s"] / max(engine["pallas_q3_wall_s"], 1e-9),
+        3)
+    out["engine"] = engine
+    out["wall_s"] = engine["sorted_q3_wall_s"]  # --compare gates the default
+
+    # ---- skew: 99%-one-key join, spread + wall (subprocess mesh) ----------
+    if not quick:
+        out["skew"] = _bench_skew_join(skew_devices, skew_budget_s)
+    return out
+
+
+def _bench_skew_join(n_devices: int, budget_s: float) -> dict:
+    """Skew-aware repartitioning on a virtual mesh in a subprocess: the
+    99%-one-key INNER join with spreading on vs off — wall clock and the
+    per-partition delivered-row counts from the new exchange stats."""
+    import subprocess
+
+    script = (
+        "import os, json, time\n"
+        "os.environ['JAX_PLATFORMS'] = 'cpu'\n"
+        "flags = os.environ.get('XLA_FLAGS', '')\n"
+        "if 'host_platform_device_count' not in flags:\n"
+        f"    os.environ['XLA_FLAGS'] = (flags + "
+        f"' --xla_force_host_platform_device_count={n_devices}').strip()\n"
+        "import jax\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        "from presto_tpu.metadata import Session\n"
+        "from presto_tpu.parallel.mesh import MeshContext\n"
+        "from presto_tpu.parallel.runner import DistributedQueryRunner\n"
+        f"mesh = MeshContext(jax.devices()[:{n_devices}])\n"
+        "sql = ('select count(*), sum(o.k) from '\n"
+        "       '(select case when o_orderkey % 100 = 0 then o_custkey '\n"
+        "       ' else 7 end as k from orders) o '\n"
+        "       'join (select c_custkey as k from customer) c "
+        "on o.k = c.k')\n"
+        "out = {}\n"
+        "rows = None\n"
+        "for name, aware in (('skew_off', False), ('skew_on', True)):\n"
+        "    r = DistributedQueryRunner(mesh, session=Session(\n"
+        "        catalog='tpch', schema='sf1', properties={\n"
+        "            'join_distribution_type': 'PARTITIONED',\n"
+        "            'skew_aware_exchange': aware}))\n"
+        "    t0 = time.perf_counter()\n"
+        "    res = r.execute(sql)\n"
+        "    out[name + '_wall_s'] = round(time.perf_counter() - t0, 2)\n"
+        "    if rows is None:\n"
+        "        rows = res.rows\n"
+        "    elif res.rows != rows:\n"
+        "        out['error'] = 'rows diverged between skew modes'\n"
+        "    for e in (res.stats or {}).get('exchange', {}).get(\n"
+        "            'per_exchange', []):\n"
+        "        if e.get('skew_role') == 'probe' or (\n"
+        "                not aware and e.get('kind') == 'repartition'\n"
+        "                and max(e.get('partition_rows', [0])) >\n"
+        "                0.5 * max(sum(e.get('partition_rows', [1])), 1)):\n"
+        "            out[name + '_partition_rows'] = e['partition_rows']\n"
+        "            if aware:\n"
+        "                out['hot_keys'] = e.get('hot_keys', 0)\n"
+        "print('SKEW=' + json.dumps(out))\n")
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", script], capture_output=True, text=True,
+            timeout=budget_s, env=dict(os.environ, JAX_PLATFORMS="cpu"))
+        for line in proc.stdout.splitlines():
+            if line.startswith("SKEW="):
+                skew = json.loads(line[5:])
+                skew["n_devices"] = n_devices
+                parts = skew.get("skew_on_partition_rows")
+                if parts:
+                    skew["partitions_used"] = sum(p > 0 for p in parts)
+                return skew
+        return {"error": (proc.stderr or proc.stdout)[-300:]}
+    except Exception as e:  # noqa: BLE001 - the rung must never kill the run
+        return {"error": repr(e)[:300]}
+
+
 WALL_REGRESSION_THRESHOLD = 0.15
 
 
@@ -693,6 +866,13 @@ def compare_benches(prev: dict, cur: dict,
         p, c = pd.get(rung) or {}, cd.get(rung) or {}
         same_schema = p.get("schema") == c.get("schema")
         record(rung, p, c, gate=comparable and same_schema)
+    # hash_kernels rung: its wall_s is the DEFAULT (sorted) Q3 wall — the
+    # pallas/skew numbers are a recorded comparison, not a gate
+    p = pd.get("hash_kernels") or {}
+    c = cd.get("hash_kernels") or {}
+    same_schema = (p.get("engine") or {}).get("schema") == \
+        (c.get("engine") or {}).get("schema")
+    record("hash_kernels", p, c, gate=comparable and same_schema)
     for key in sorted((pd.get("serving") or {}).get("rungs", {})):
         p = (pd.get("serving") or {}).get("rungs", {}).get(key) or {}
         c = (cd.get("serving") or {}).get("rungs", {}).get(key) or {}
@@ -844,6 +1024,13 @@ def main():
             per_client=2 if args.quick else 4)
     except Exception as e:
         detail["serving"] = {"error": repr(e)[:300]}
+
+    # Pallas hash kernels: sorted-vs-pallas build/probe + Q3 walls, plus the
+    # skew-aware 99%-one-key join spread (VERDICT #6's measured verdict)
+    try:
+        detail["hash_kernels"] = bench_hash_kernels(quick=args.quick)
+    except Exception as e:
+        detail["hash_kernels"] = {"error": repr(e)[:300]}
 
     # streaming mesh exchange: chunk/compile/overlap accounting on a small
     # virtual mesh (subprocess — must not disturb this process's backend)

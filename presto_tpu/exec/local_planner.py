@@ -766,7 +766,8 @@ class LocalExecutionPlanner:
         unique = self._keys_unique(node.right, right_keys)
         build_fac = JoinBuildOperatorFactory(
             next(self._ids), build_key_ch, payload_ch, payload_meta,
-            strategy="sorted", unique=unique,
+            strategy=self._join_strategy(node, build_key_ch, unique),
+            unique=unique,
             track_unmatched=node.type == "full")
         self._add_pipeline(build_chain.factories + [build_fac])
 
@@ -878,6 +879,31 @@ class LocalExecutionPlanner:
             filter_build_channels=filter_build_ch, filter_key=filter_key)
         return Chain(src.factories + [fac], list(src.symbols), list(src.dicts))
 
+    def _join_strategy(self, node: JoinNode, build_key_ch, unique: bool) -> str:
+        """Build-strategy pick for the `hash_kernels` session property:
+        'pallas'/'auto' route eligible builds (unique single-key
+        INNER/LEFT) onto the open-addressing Pallas table; everything else
+        — and the 'sorted' default — keeps the sort + binary-search build.
+        The fallback is silent by contract (never an error): `auto` and
+        `pallas` must degrade to `sorted` for duplicate-key / multi-key /
+        FULL builds (ops/hash_join.pallas_join_eligible)."""
+        from ..ops.hash_join import pallas_join_eligible
+
+        hk = str(self.session.get("hash_kernels", "sorted"))
+        if hk == "auto":
+            # profitability gate: the 2026-08 measurement (README "Pallas
+            # hash kernels") shows the INTERPRETED kernels lose to sorted
+            # everywhere — auto only routes builds to pallas where the
+            # kernel actually compiles (a real TPU backend)
+            from ..ops.pallas_hash import interpret_mode
+
+            hk = "sorted" if interpret_mode() else "pallas"
+        if hk == "pallas" and \
+                pallas_join_eligible(self._join_type(node), build_key_ch,
+                                     unique):
+            return "pallas"
+        return "sorted"
+
     @staticmethod
     def _join_type(node: JoinNode) -> str:
         if node.type == "inner":
@@ -987,10 +1013,15 @@ class LocalExecutionPlanner:
                 out_dicts.append(out_dict)
 
         op_step = {P_PARTIAL: OP_PARTIAL, P_FINAL: OP_FINAL}.get(step, SINGLE)
+        # hash_kernels session property -> the sort-grouping builder's
+        # Pallas insert-or-accumulate mode ("force" = wherever correct,
+        # "auto" = where the runtime heuristic expects a win, default off)
+        hk = str(self.session.get("hash_kernels", "sorted"))
         fac = HashAggregationOperatorFactory(
             next(self._ids), key_ch, key_types, key_dicts, key_domains, calls,
             op_step, self.page_capacity,
-            max_groups=int(self.session.get("max_groups")))
+            max_groups=int(self.session.get("max_groups")),
+            hash_grouping={"pallas": "force", "auto": "auto"}.get(hk, "off"))
         return Chain(src.factories + [fac], out_syms, out_dicts)
 
     def visit_WindowNode(self, node) -> Chain:

@@ -63,6 +63,26 @@ class Session:
         # dispatch per page (ops/fused_segment.py). False = per-operator
         # dispatches — the differential-testing oracle
         "segment_fusion": True,
+        # --- Pallas hash kernels (ops/pallas_hash.py) ---
+        # join build/probe + aggregation grouping strategy:
+        #   sorted — the sort + binary-search / segment-reduce paths (the
+        #            differential oracle, today's default);
+        #   pallas — open-addressing hash tables built and probed by the
+        #            Pallas kernels wherever they are CORRECT (unique
+        #            single-key INNER/LEFT builds; table-friendly group
+        #            counts) — ineligible shapes fall back to sorted, never
+        #            raise;
+        #   auto   — pallas only where the runtime heuristics also expect it
+        #            to be PROFITABLE (joins: compiled backends only — the
+        #            interpreted kernels measurably lose; agg: small
+        #            observed group counts on sync-cheap backends), sorted
+        #            everywhere else.
+        # Kernels interpret off-TPU, so all three values are row-identical
+        # on every backend (tests/test_pallas_hash.py is the contract).
+        # Note: aggregations whose partials run inside FUSED segments keep
+        # the sort kernel (the segment compiles the sort partial config at
+        # plan time); the agg half engages on unfused pipelines.
+        "hash_kernels": "sorted",
         # --- streaming scan pipeline (ops/scan_pipeline.py) ---
         # staged host->HBM ingest: split-parallel readers -> ordered
         # re-batch into device-shaped pages -> async upload. False =
@@ -96,6 +116,15 @@ class Session:
         # full intermediate result; 0 = engine default
         # (streaming_exchange.DEFAULT_INFLIGHT_BYTES, 256MB)
         "exchange_inflight_bytes": 0,
+        # skew-aware repartitioning for partitioned INNER joins (streaming
+        # mode): the build-side exchange samples its first chunk for heavy-
+        # hitter keys, SPLITS hot build rows round-robin across partitions
+        # and the probe-side exchange REPLICATES matching probe rows to all
+        # partitions — a 99%-one-key join spreads across the mesh instead of
+        # landing on one chip (carry-over already made it *correct*; this
+        # makes it *parallel*). Per-partition delivered-row counts surface
+        # in QueryResult.stats["exchange"]. False = hash-only routing.
+        "skew_aware_exchange": True,
         # --- multi-tenant serving (exec/shared_pools.py) ---
         # run scan-pipeline stages and exchange pumps on the process-wide
         # shared worker pools with per-query round-robin fairness, so N

@@ -216,6 +216,7 @@ class DistributedQueryRunner:
             if bool(self.session.get("shared_pools", True)) else None
         drivers = []
         root_ep = None
+        planned = []  # (fragment, local plan) — skew wiring scans consumers
         try:
             for frag in sub.fragments:
                 is_root = frag is sub.root_fragment
@@ -260,6 +261,7 @@ class DistributedQueryRunner:
                 # in fragment order, so every referenced exchange exists)
                 for fid, slot in ep.remote_slots.items():
                     slot.stream = exchanges[fid]
+                planned.append(ep)
                 for w in workers:
                     worker_drivers = ep.create_drivers(w)
                     drivers.extend(worker_drivers)
@@ -271,6 +273,11 @@ class DistributedQueryRunner:
                             worker_drivers)
                 if is_root:
                     root_ep = ep
+            # skew-aware routing: pair each INNER join's build-side and
+            # probe-side REPARTITION exchanges BEFORE any pump runs (the
+            # roles change the compiled routing program for the stream)
+            if bool(self.session.get("skew_aware_exchange", True)):
+                _wire_skew(planned, exchanges)
             # all drivers exist: producer counts are exact — start the pumps
             for fid, ex in exchanges.items():
                 ex.start(sink_facs[fid].created)
@@ -418,6 +425,129 @@ class DistributedQueryRunner:
         return QueryResult([[line] for line in lines], ["Query Plan"],
                            stats=result.stats,
                            trace_path=result.trace_path)
+
+
+# ---------------------------------------------------------------------------
+# skew wiring: pair each INNER join's build/probe exchanges for heavy-hitter
+# handling (parallel/streaming_exchange.py SkewCoordinator)
+# ---------------------------------------------------------------------------
+
+def _pipeline_members(chain) -> list:
+    """Factory chain with fused segments expanded back to their members —
+    the join build/probe factories the skew wiring looks for may sit inside
+    a FusedSegmentOperatorFactory."""
+    from ..ops.fused_segment import FusedSegmentOperatorFactory
+
+    members = []
+    for f in chain:
+        if isinstance(f, FusedSegmentOperatorFactory):
+            members.extend(f.mid_factories)
+            if f.terminal_factory is not None:
+                members.append(f.terminal_factory)
+        else:
+            members.append(f)
+    return members
+
+
+def _skew_pair_safe(build_members, probe_members, probe_join,
+                    build_src, exchanges) -> bool:
+    """Is spraying/replicating this join's hot keys invisible to everything
+    else in the consumer fragment? Skew routing breaks the "all rows of key
+    k on one partition" invariant that add_exchanges may have RELIED on
+    when it elided downstream exchanges (a SINGLE-step aggregation on the
+    join key, a second same-key partitioned join) — so the pair only wires
+    when the build pipeline is exactly remote-source -> row-local* -> build,
+    and everything downstream of the probe join is partition-AGNOSTIC:
+    row-local operators, PARTIAL aggregations (re-exchanged by key later),
+    TopN/sort/limit (order-based), sinks, and further joins only when their
+    build side arrived by BROADCAST (location-independent by construction).
+    Anything else keeps plain hash routing — correct, just concentrated."""
+    from ..ops.coalesce import CoalesceOperatorFactory
+    from ..ops.filter_project import FilterProjectOperatorFactory
+    from ..ops.hash_agg import PARTIAL, HashAggregationOperatorFactory
+    from ..ops.hash_join import LookupJoinOperatorFactory
+    from ..ops.topn import (LimitOperatorFactory, OrderByOperatorFactory,
+                            TopNOperatorFactory)
+    from ..utils.testing import PageConsumerFactory
+
+    row_local = (FilterProjectOperatorFactory, CoalesceOperatorFactory)
+    if any(not isinstance(f, row_local) for f in build_members[:-1]):
+        return False
+    ji = probe_members.index(probe_join)
+    if any(not isinstance(f, row_local) for f in probe_members[:ji]):
+        return False
+    for f in probe_members[ji + 1:]:
+        if isinstance(f, row_local + (TopNOperatorFactory,
+                                      OrderByOperatorFactory,
+                                      LimitOperatorFactory,
+                                      ExchangeSinkOperatorFactory,
+                                      PageConsumerFactory)):
+            continue
+        if isinstance(f, HashAggregationOperatorFactory) and \
+                f.step == PARTIAL:
+            continue
+        if isinstance(f, LookupJoinOperatorFactory):
+            bfid = build_src.get(id(f.lookup_factory))
+            bex = exchanges.get(bfid) if bfid is not None else None
+            if bex is not None and bex.kind == BROADCAST:
+                continue
+            return False
+        return False
+    return True
+
+
+def _wire_skew(planned, exchanges) -> None:
+    """Scan every consumer fragment's pipelines for partitioned joins and
+    pair the REPARTITION exchange feeding each JoinBuildOperatorFactory
+    ("build" side) with the one feeding the matching LookupJoin probe
+    ("probe" side) on one SkewCoordinator: both sample their first chunk,
+    and a heavy-hitter key splits round-robin on its own side while the
+    peer replicates it. INNER joins only — a replicated row would emit
+    spurious unmatched rows under LEFT/FULL/semi semantics — and only
+    unambiguous 1:1 pairs whose consumer fragment is provably partition-
+    agnostic downstream of the join (:func:`_skew_pair_safe`)."""
+    from ..ops.hash_join import INNER, JoinBuildOperatorFactory, \
+        LookupJoinOperatorFactory
+    from .streaming_exchange import SkewCoordinator
+
+    build_src = {}   # id(lookup_factory) -> producer fragment id
+    build_info = {}  # id(lookup_factory) -> build pipeline members
+    probe_src = {}   # id(lf) -> (fid, join factory, members) | None
+    for ep in planned:
+        for chain in ep.pipelines:
+            fid = getattr(getattr(chain[0], "slot", None),
+                          "fragment_id", None)
+            if fid is None:
+                continue
+            members = _pipeline_members(chain[1:])
+            if members and isinstance(members[-1], JoinBuildOperatorFactory):
+                build_src[id(members[-1].lookup_factory)] = fid
+                build_info[id(members[-1].lookup_factory)] = members
+            for f in members:
+                if isinstance(f, LookupJoinOperatorFactory):
+                    key = id(f.lookup_factory)
+                    if key in probe_src:
+                        probe_src[key] = None  # ambiguous: two probe feeds
+                    else:
+                        probe_src[key] = (fid, f, members)
+    for key, bfid in build_src.items():
+        pair = probe_src.get(key)
+        if not pair:
+            continue
+        pfid, join_fac, probe_members = pair
+        if join_fac.join_type != INNER or pfid == bfid:
+            continue
+        bex, pex = exchanges.get(bfid), exchanges.get(pfid)
+        if bex is None or pex is None or \
+                bex.kind != REPARTITION or pex.kind != REPARTITION or \
+                bex._skew is not None or pex._skew is not None:
+            continue
+        if not _skew_pair_safe(build_info[key], probe_members, join_fac,
+                               build_src, exchanges):
+            continue
+        coord = SkewCoordinator()
+        bex.set_skew("build", coord)
+        pex.set_skew("probe", coord)
 
 
 # ---------------------------------------------------------------------------

@@ -214,6 +214,85 @@ DEFAULT_INFLIGHT_BYTES = 1 << 28
 # capacities correct; tiny floors only cost extra dispatches under skew
 _MIN_STREAM_OUT_CAP = 1 << 6
 
+# ---------------------------------------------------------------------------
+# skew-aware repartitioning (the `skew_aware_exchange` session knob)
+# ---------------------------------------------------------------------------
+#
+# PR 5's carry-over made a 99%-one-key partitioned join CORRECT — but every
+# hot-key row still hashes to one partition, so one chip does the join while
+# the rest idle. The fix is the JSPIM/PRPD shape (PAPERS.md): detect heavy
+# hitters, then treat them specially on BOTH sides of the join boundary.
+# Each side of an INNER join's REPARTITION pair samples its OWN first chunk
+# for heavy-hitter combined keys and freezes the result (exactly like MERGE
+# splitters freeze at first dispatch; freeze-before-wait, so the handshake
+# can never deadlock). A key hot on one side is then
+#
+# - SPLIT round-robin across all partitions on the side where it is hot
+#   (that side's rows are the volume to spread), and
+# - REPLICATED to every partition on the PEER side via an extra all_gather
+#   lane in the same collective (its own capacity + carry),
+#
+# so every (probe row, build row) pair of a hot key meets on exactly one
+# partition while the heavy side's rows — and the join work — spread across
+# the mesh. A key hot on BOTH sides splits on the build side only (both
+# sides derive the same resolution from the frozen sets). Correct for INNER
+# joins only — a replicated row would emit spurious unmatched rows under
+# LEFT/FULL/semi semantics — which is why the runner wires roles only onto
+# REPARTITION pairs feeding an INNER join (parallel/runner._wire_skew).
+
+# hot = a key holding at least this fraction of the first chunk's sampled
+# rows; at 0.4 at most two keys can qualify organically — this is a heavy-
+# hitter detector, not a frequency histogram
+SKEW_HOT_FRACTION = 0.4
+# below this many sampled rows the first chunk says nothing about skew
+SKEW_MIN_SAMPLE = 64
+# static hot-set capacity per side (the membership compare is
+# rows x SKEW_MAX_HOT); sets pad with a repeated real key, so membership
+# stays exact
+SKEW_MAX_HOT = 8
+
+BUILD_SIDE, PROBE_SIDE = "build", "probe"
+
+
+class SkewCoordinator:
+    """The frozen-hot-set handshake between one INNER join's build-side and
+    probe-side exchanges. Each side freezes its OWN sample once (at its
+    first dispatch, or empty at pump end/teardown so the peer can never
+    hang), then waits for the peer before routing anything — the routing
+    treatment of every key must be identical across the whole stream."""
+
+    def __init__(self):
+        self._freeze_lock = threading.Lock()
+        self._events = {BUILD_SIDE: threading.Event(),
+                        PROBE_SIDE: threading.Event()}
+        self._hot = {BUILD_SIDE: None, PROBE_SIDE: None}
+
+    def freeze(self, side: str, hot_keys) -> None:
+        # locked check-then-act: the pump's sample freeze and teardown's
+        # empty freeze race on different threads, and a LATER write would
+        # flip plan() mid-stream (the one invariant this class exists for)
+        with self._freeze_lock:
+            if self._events[side].is_set():
+                return
+            self._hot[side] = np.asarray(hot_keys, dtype=np.int64)
+            self._events[side].set()
+
+    def frozen(self, side: str) -> bool:
+        return self._events[side].is_set()
+
+    def wait_peer(self, side: str, timeout: float) -> bool:
+        peer = PROBE_SIDE if side == BUILD_SIDE else BUILD_SIDE
+        return self._events[peer].wait(timeout)
+
+    def plan(self, side: str):
+        """-> (spray_keys, replicate_keys) for `side`, both frozen sets
+        resolved consistently: build-hot keys split on the build side and
+        replicate on the probe side; probe-hot keys (minus any also hot on
+        the build side) the other way around."""
+        hb, hp = self._hot[BUILD_SIDE], self._hot[PROBE_SIDE]
+        hp = np.setdiff1d(hp, hb)
+        return (hb, hp) if side == BUILD_SIDE else (hp, hb)
+
 
 @functools.lru_cache(maxsize=128)
 def _fill_chunk_jit(ncols: int, C: int):
@@ -265,27 +344,33 @@ COLLECTIVE_DISPATCH_LOCK = threading.Lock()
 
 def _streaming_program(mesh, kind: str, key_idx: Optional[Tuple[int, ...]],
                        ncols: int, W: int, C: int, out_cap: int,
-                       range_dtype: Optional[str]):
+                       range_dtype: Optional[str],
+                       skew: Optional[str] = None):
     """-> (program, compiled_now). Carry-aware analogue of the barrier
     path's _exchange_program: REPARTITION/MERGE return
     (out_arrays, out_mask, carry_arrays, carry_mask); BROADCAST/GATHER
     return (out_arrays, out_mask) — an all_gather has full capacity, so
-    nothing can ever overflow. Programs live in the global LRU kernel cache
-    (one compile per (mesh, kind, keys, shape), ever)."""
+    nothing can ever overflow. `skew` selects the REPARTITION heavy-hitter
+    variants: "split" sprays hot rows round-robin, "replicate" routes them
+    through an all_gather lane (extra hot outputs + a second carry).
+    Programs live in the global LRU kernel cache (one compile per
+    (mesh, kind, keys, shape, skew), ever)."""
     from ..utils import kernel_cache as kc
 
     key = ("exchange-stream", mesh, kind, key_idx, ncols, W, C, out_cap,
-           range_dtype)
+           range_dtype, skew)
     return kc.get_or_build(
         key, lambda: _build_streaming_program(mesh, kind, key_idx, ncols, W,
-                                              C, out_cap))
+                                              C, out_cap, skew))
 
 
 def _build_streaming_program(mesh, kind: str,
                              key_idx: Optional[Tuple[int, ...]],
-                             ncols: int, W: int, C: int, out_cap: int):
+                             ncols: int, W: int, C: int, out_cap: int,
+                             skew: Optional[str] = None):
     import jax
     import jax.numpy as jnp
+    from jax import lax
     from jax.sharding import PartitionSpec as P
 
     from ..ops.hash_join import combined_key
@@ -295,6 +380,65 @@ def _build_streaming_program(mesh, kind: str,
 
     n_arrays = 2 * ncols
     sharded = tuple(P(WORKER_AXIS) for _ in range(n_arrays))
+
+    def _combined(arrays, mask):
+        keys = [jnp.where(arrays[ncols + i], 0,
+                          arrays[i]).astype(jnp.int64) for i in key_idx]
+        return combined_key(keys)
+
+    if kind == REPARTITION and skew == "skew":
+        hot_cap = out_cap
+
+        def skew_stage(arrays, mask, spray_keys, spray_n, repl_keys, repl_n,
+                       offset):
+            n = mask.shape[0]
+            ck = _combined(arrays, mask)
+            # membership against a FIXED-width key set; empty sets are
+            # disabled by their count (padding repeats a real key)
+            spray_hot = mask & (spray_n[0] > 0) & jnp.any(
+                ck[:, None] == spray_keys[None, :], axis=1)
+            repl_hot = mask & (repl_n[0] > 0) & ~spray_hot & jnp.any(
+                ck[:, None] == repl_keys[None, :], axis=1)
+            # spray: the i-th hot row of this worker's chunk k goes to
+            # partition (k + worker + i) mod W — deterministic, balanced,
+            # different workers start offset apart
+            pid = partition_ids(ck, W)
+            hidx = jnp.cumsum(spray_hot.astype(jnp.int32)) - 1
+            spray = (offset[0] + lax.axis_index(WORKER_AXIS) + hidx) % W
+            pid = jnp.where(spray_hot, spray.astype(jnp.int32), pid)
+            base = mask & ~repl_hot
+            pid = jnp.where(base, pid, W)
+            out, m, carry, cm = repartition_by_pid_with_carry(
+                list(arrays), base, pid, W, out_cap)
+            # replicate: compact hot rows into a fixed lane and all_gather
+            # it — every partition sees every replicated row (each meets
+            # the sprayed peer rows its partition holds exactly once)
+            hpos = jnp.cumsum(repl_hot.astype(jnp.int32)) - 1
+            into = repl_hot & (hpos < hot_cap)
+            htgt = jnp.where(into, hpos, hot_cap)
+            hmask = jnp.zeros(hot_cap, dtype=jnp.bool_).at[htgt].set(
+                into, mode="drop")
+            hbufs = [jnp.zeros(hot_cap, dtype=a.dtype).at[htgt].set(
+                a, mode="drop") for a in arrays]
+            hout, hm = broadcast_gather(hbufs, hmask)
+            # replicate-lane overflow: its own carry (same re-feed protocol
+            # as the base carry; membership re-resolves at the next chunk)
+            hover = repl_hot & ~into
+            hcpos = jnp.cumsum(hover.astype(jnp.int32)) - 1
+            hct = jnp.where(hover, hcpos, n)
+            hcm = jnp.zeros(n, dtype=jnp.bool_).at[hct].set(hover,
+                                                            mode="drop")
+            hcarry = tuple(jnp.zeros(n, dtype=a.dtype).at[hct].set(
+                a, mode="drop") for a in arrays)
+            return (tuple(out), m, tuple(hout), hm, tuple(carry), cm,
+                    hcarry, hcm)
+
+        smapped = shard_map(
+            skew_stage, mesh=mesh,
+            in_specs=(sharded, P(WORKER_AXIS), P(), P(), P(), P(), P()),
+            out_specs=(sharded, P(WORKER_AXIS), sharded, P(WORKER_AXIS),
+                       sharded, P(WORKER_AXIS), sharded, P(WORKER_AXIS)))
+        return jax.jit(smapped)
 
     if kind == MERGE:
         def merge_stage(arrays, mask, range_key, splitters):
@@ -454,14 +598,31 @@ class StreamingExchange:
         # steps re-bind the recorder captured at submit)
         self._recorder = trace.active()
         self._finished_ok = False
-        # stats (pump-thread private until publish)
+        # skew-aware routing (wired by parallel/runner._wire_skew onto the
+        # REPARTITION pair feeding an INNER join): "detect" samples + splits
+        # hot build keys, "replicate" fans hot probe rows to all partitions
+        self._skew: Optional[SkewCoordinator] = None
+        self._skew_role: Optional[str] = None
+        # stats (pump-thread private until publish). partition_rows counts
+        # DELIVERED live rows per consumer partition — the observable proof
+        # that a skewed key spread instead of landing on one worker
         self.stats = {"fragment": fragment_id, "kind": kind,
                       "chunk_rows": self.chunk_rows, "out_cap": self.out_cap,
                       "chunks": 0, "overlap_chunks": 0, "rows_in": 0,
                       "rows_out": 0, "carry_rows": 0, "compiles": 0,
-                      "dispatch_s": 0.0, "overlap_s": 0.0, "stall_s": 0.0}
+                      "dispatch_s": 0.0, "overlap_s": 0.0, "stall_s": 0.0,
+                      "partition_rows": [0] * W, "hot_keys": 0,
+                      "replicated_rows": 0}
 
     # ------------------------------------------------------------- lifecycle
+
+    def set_skew(self, role: str, coordinator: SkewCoordinator) -> None:
+        """Attach a skew side BEFORE start(): "build" or "probe" of the
+        INNER join this REPARTITION pair feeds. Both sides sample + freeze
+        their own first chunk and handle the peer's hot keys."""
+        assert role in (BUILD_SIDE, PROBE_SIDE), role
+        self._skew_role = role
+        self._skew = coordinator
 
     def start(self, n_producers: int) -> None:
         """Called once all producer sinks are created (driver instantiation
@@ -490,6 +651,10 @@ class StreamingExchange:
             if error is not None and self._error is None:
                 self._error = error
             self._cv.notify_all()
+        if self._skew is not None:
+            # the peer must never park forever on a torn-down exchange: an
+            # empty freeze keeps it on plain hash routing
+            self._skew.freeze(self._skew_role, np.zeros(0, dtype=np.int64))
         # poison BEFORE joining: a pump blocked on a full consumer queue (or
         # a consumer blocked on an empty one) wakes through the buffer's own
         # condition, not the exchange's
@@ -578,6 +743,12 @@ class StreamingExchange:
             for b in self._out:
                 b.producer_finished()
         finally:
+            if self._skew is not None:
+                # a stream that ended without dispatching a single chunk
+                # (zero rows) has no skew to report — freeze empty so the
+                # peer proceeds on plain hash routing
+                self._skew.freeze(self._skew_role,
+                                  np.zeros(0, dtype=np.int64))
             # even an interrupted pump (close mid-flush, producer error)
             # publishes what it measured — chunk counts bumped at dispatch
             # must never appear without their overlap/stall attribution
@@ -779,6 +950,24 @@ class StreamingExchange:
                 must_dispatch = True
             if not must_dispatch:
                 return pending_delivery
+            if self._skew is not None:
+                # freeze OUR hot sample first (from the staged chunks about
+                # to dispatch), then wait for the peer's — routing is only
+                # well-defined once BOTH sets froze: a chunk hashed out
+                # before the peer's freeze would miss rows that split or
+                # replicate after it. Freeze-before-wait means the two
+                # sides can never deadlock; the waits are bounded so the
+                # pool step parks and re-arms instead of wedging a worker
+                # (a peer that never dispatches freezes empty at pump end
+                # or teardown)
+                if not self._skew.frozen(self._skew_role):
+                    own = self._detect_hot(state)
+                    self._skew.freeze(self._skew_role, own)
+                    self.stats["hot_keys"] = int(len(own))
+                while not self._skew.wait_peer(self._skew_role,
+                                               timeout=STEP_WAIT_S):
+                    self._check_live()
+                    yield WAIT
             new_pending = self._dispatch(state, queue)
             # deliver the PREVIOUS chunk now that this one is in flight —
             # its live-count sync overlaps the new in-flight collective
@@ -821,6 +1010,28 @@ class StreamingExchange:
         range_keys = None
         if self.kind == MERGE:
             range_keys = self._merge_range_keys(state)
+        # skew plan (REPARTITION only; both sets frozen by _absorb_gen's
+        # freeze-then-wait handshake before the first dispatch): a non-empty
+        # plan swaps in the skew routing program for the whole stream
+        skew_mode = None
+        skew_args = None
+        if self._skew is not None and self.kind == REPARTITION:
+            spray, repl = self._skew.plan(self._skew_role)
+
+            def _pad(keys):
+                # pad with a REAL member (membership stays exact); all-zero
+                # pads of an EMPTY set are disabled by the count arg
+                out = np.full(SKEW_MAX_HOT,
+                              keys[0] if len(keys) else 0, dtype=np.int64)
+                out[:len(keys)] = keys
+                return out
+
+            if len(spray) or len(repl):
+                skew_mode = "skew"
+                skew_args = (
+                    _pad(spray), np.asarray([len(spray)], dtype=np.int32),
+                    _pad(repl), np.asarray([len(repl)], dtype=np.int32),
+                    np.asarray([self.stats["chunks"]], dtype=np.int32))
         dev_arrays = [self._assemble([state[w].datas[c] for w in range(W)], C)
                       for c in range(ncols)]
         dev_arrays += [self._assemble([state[w].nulls[c] for w in range(W)],
@@ -828,16 +1039,21 @@ class StreamingExchange:
         dev_mask = self._assemble([state[w].mask for w in range(W)], C)
         program, compiled = _streaming_program(
             self.mesh.mesh, self.kind, self.key_idx, ncols, W, C,
-            self.out_cap, self._range_dtype)
+            self.out_cap, self._range_dtype, skew=skew_mode)
         if compiled:
             self.stats["compiles"] += 1
             if self.book is not None:
                 self.book.bump("collective_compiles")
+        hot_out = hot_mask = hot_carry = hot_carry_mask = None
         with COLLECTIVE_DISPATCH_LOCK:
             if self.kind == MERGE:
                 g_rk = self._assemble(range_keys, C)
                 out_arrays, out_mask, carry_arrays, carry_mask = program(
                     tuple(dev_arrays), dev_mask, g_rk, self._splitters)
+            elif self.kind == REPARTITION and skew_mode == "skew":
+                (out_arrays, out_mask, hot_out, hot_mask, carry_arrays,
+                 carry_mask, hot_carry, hot_carry_mask) = program(
+                    tuple(dev_arrays), dev_mask, *skew_args)
             elif self.kind == REPARTITION:
                 out_arrays, out_mask, carry_arrays, carry_mask = program(
                     tuple(dev_arrays), dev_mask)
@@ -884,10 +1100,23 @@ class StreamingExchange:
                     tuple(carry_cols[c][w] for c in range(ncols)),
                     tuple(carry_cols[ncols + c][w] for c in range(ncols)),
                     carry_per_worker[w], is_carry=True))
+        if hot_carry_mask is not None:
+            # the replicate variant's second carry: hot rows beyond the
+            # all_gather lane's capacity re-feed exactly like base carry
+            # (membership re-resolves when the next chunk dispatches)
+            hc_per_worker = self._shards_by_worker(hot_carry_mask, C)
+            hc_cols = [self._shards_by_worker(a, C) for a in hot_carry]
+            for w in range(W):
+                queue[w].append(_QueuedPage(
+                    tuple(hc_cols[c][w] for c in range(ncols)),
+                    tuple(hc_cols[ncols + c][w] for c in range(ncols)),
+                    hc_per_worker[w], is_carry=True))
         # the dispatch timestamp + chunk number ride along so delivery can
         # histogram the FULL chunk latency (collective issue -> pages on
-        # the consumer queues)
-        return (out_arrays, out_mask, t0, chunk_no)
+        # the consumer queues); the replicate variant's hot lane delivers
+        # alongside the regular output
+        hot_part = (hot_out, hot_mask) if hot_mask is not None else None
+        return (out_arrays, out_mask, hot_part, t0, chunk_no)
 
     def _merge_range_keys(self, state):
         """Per-worker routing keys for this chunk (eager, on each worker's
@@ -919,6 +1148,36 @@ class StreamingExchange:
         return [jax.device_put(keys[w], self.mesh.devices[w])
                 for w in range(self.W)]
 
+    def _detect_hot(self, state) -> np.ndarray:
+        """Heavy-hitter sample over the FIRST chunk's staged rows (all
+        workers' send buffers — up to W * chunk_rows rows, one batched
+        device_get, once per exchange): keys holding >= SKEW_HOT_FRACTION
+        of the sample, top-SKEW_MAX_HOT by count. The cheap per-chunk
+        top-k the JSPIM line of work runs in hardware, run on the host."""
+        import jax
+        import jax.numpy as jnp
+
+        from ..ops.hash_join import combined_key
+
+        samples = []
+        for w in range(self.W):
+            st = state[w]
+            if not st.count:
+                continue
+            keys = [jnp.where(st.nulls[i], 0, st.datas[i]).astype(jnp.int64)
+                    for i in self.key_idx]
+            # chunks pack live rows at the front: [:count] is the live set
+            samples.append(np.asarray(
+                jax.device_get(combined_key(keys)))[:st.count])
+        pooled = np.concatenate(samples) if samples else \
+            np.zeros(0, dtype=np.int64)
+        if len(pooled) < SKEW_MIN_SAMPLE:
+            return np.zeros(0, dtype=np.int64)
+        uniq, counts = np.unique(pooled, return_counts=True)
+        top = np.argsort(counts)[::-1][:SKEW_MAX_HOT]
+        hot = uniq[top][counts[top] >= SKEW_HOT_FRACTION * len(pooled)]
+        return hot.astype(np.int64)
+
     # -------------------------------------------------------------- delivery
 
     def _shards_by_worker(self, arr, L: int):
@@ -932,12 +1191,34 @@ class StreamingExchange:
         """Compact each worker's received shard and enqueue it as standard
         pow2 pages on the consumer queue (parking on the queue's byte bound
         — the downstream half of the backpressure loop; a full queue parks
-        the pump STEP, never a pool worker)."""
+        the pump STEP, never a pool worker). The replicate variant's hot
+        lane (every worker holds a full copy) delivers through the same
+        path as a second part."""
+        out_arrays, out_mask, hot_part, dispatch_t0, chunk_no = dispatched
+        t0 = time.perf_counter_ns()
+        yield from self._deliver_part(out_arrays, out_mask)
+        if hot_part is not None:
+            hot_arrays, hot_mask = hot_part
+            replicated = yield from self._deliver_part(hot_arrays, hot_mask)
+            self.stats["replicated_rows"] += replicated
+        self._charge_memory()
+        end = time.perf_counter_ns()
+        # per-chunk latency = dispatch issue -> pages delivered; the /v1/
+        # metrics percentiles the serving roadmap needs come from here
+        METRICS.histogram("exchange.chunk_latency_s",
+                          (end - dispatch_t0) / 1e9)
+        trace.record(trace.EXCHANGE, f"chunk_deliver f{self.fragment_id}",
+                     t0, end - t0,
+                     {"chunk": chunk_no}
+                     if trace.active() is not None else None)
+
+    def _deliver_part(self, out_arrays, out_mask):
+        """One output lane (regular or hot) -> consumer queues. Returns the
+        total live rows delivered; per-partition counts accumulate into
+        stats["partition_rows"] (the skew-spread observable)."""
         import jax
         import jax.numpy as jnp
 
-        out_arrays, out_mask, dispatch_t0, chunk_no = dispatched
-        t0 = time.perf_counter_ns()
         W, ncols = self.W, len(self.types)
         out_len = out_mask.shape[0] // W
         compact = _compact_pad_jit()
@@ -980,24 +1261,19 @@ class StreamingExchange:
                     self._check_live()
                     yield WAIT  # consumer backpressure: park the step
             self.stats["rows_out"] += live_w
+            self.stats["partition_rows"][w] += live_w
             if self.book is not None:
                 self.book.bump("rows", live_w)
-        self._charge_memory()
-        end = time.perf_counter_ns()
-        # per-chunk latency = dispatch issue -> pages delivered; the /v1/
-        # metrics percentiles the serving roadmap needs come from here
-        METRICS.histogram("exchange.chunk_latency_s",
-                          (end - dispatch_t0) / 1e9)
-        trace.record(trace.EXCHANGE, f"chunk_deliver f{self.fragment_id}",
-                     t0, end - t0,
-                     {"chunk": chunk_no}
-                     if trace.active() is not None else None)
+        return sum(lives)
 
     def _publish_stats(self) -> None:
         if self.book is not None:
             entry = dict(self.stats)
             for k in ("dispatch_s", "overlap_s", "stall_s"):
                 entry[k] = round(entry[k], 6)
+            entry["partition_rows"] = list(self.stats["partition_rows"])
+            if self._skew_role is not None:
+                entry["skew_role"] = self._skew_role
             self.book.add_exchange(entry)
             self.book.bump("overlap_s", self.stats["overlap_s"])
             self.book.bump("stall_s", self.stats["stall_s"])

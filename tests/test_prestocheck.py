@@ -468,6 +468,154 @@ def test_host_sync_suppression(tmp_path):
     assert findings == [], _messages(findings)
 
 
+# ------------------------------------------ pallas kernel bodies as jit roots
+
+def test_tracer_safety_pallas_kernel_is_a_root(tmp_path):
+    findings = _scan(tmp_path, """
+        import time
+        import jax
+        from jax.experimental import pallas as pl
+
+        def kernel(x_ref, o_ref):
+            t = time.time()              # freezes at trace time: flagged
+            n = x_ref[0].item()          # concretizes a Ref: flagged
+            o_ref[:] = x_ref[:] * 2
+
+        def launch(x):
+            return pl.pallas_call(
+                kernel,
+                out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype))(x)
+        """, select=["tracer-safety"])
+    msgs = "\n".join(_messages(findings))
+    assert "time.time()" in msgs and "`kernel`" in msgs
+    assert ".item()" in msgs
+
+
+def test_tracer_safety_pallas_flags_python_control_flow_on_refs(tmp_path):
+    findings = _scan(tmp_path, """
+        import jax
+        import jax.numpy as jnp
+        from jax.experimental import pallas as pl
+
+        TRIPS = 8
+
+        def kernel(x_ref, mask_ref, o_ref):
+            if mask_ref[0]:              # python branch on a Ref: flagged
+                o_ref[:] = x_ref[:]
+            while x_ref[0] > 0:          # python loop on a Ref: flagged
+                pass
+            for _d in range(TRIPS):      # static python loop: fine
+                o_ref[:] = o_ref[:] + 1
+
+        def launch(x, mask):
+            return pl.pallas_call(
+                kernel,
+                out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype))(x, mask)
+        """, select=["tracer-safety"])
+    msgs = _messages(findings)
+    assert any("`if` on kernel parameter `mask_ref`" in m for m in msgs), msgs
+    assert any("`while` on kernel parameter `x_ref`" in m for m in msgs), msgs
+    assert len(msgs) == 2, msgs
+
+
+def test_tracer_safety_pallas_clean_kernel(tmp_path):
+    findings = _scan(tmp_path, """
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+        from jax.experimental import pallas as pl
+
+        def kernel(x_ref, o_ref):
+            def trip(d, acc):
+                return acc + x_ref[d]
+            o_ref[:] = lax.fori_loop(0, 8, trip, jnp.zeros_like(o_ref[:]))
+
+        def launch(x):
+            return pl.pallas_call(
+                kernel,
+                out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype))(x)
+        """, select=["tracer-safety"])
+    assert findings == [], _messages(findings)
+
+
+def test_pallas_roots_resolve_factory_made_kernels(tmp_path):
+    # the repo's real kernels come from builder factories:
+    # pl.pallas_call(_make_body(n, s), ...) — the closure defined inside
+    # the factory must be treated as the kernel body by BOTH passes
+    src = """
+        import time
+        import numpy as np
+        import jax
+        from jax.experimental import pallas as pl
+
+        def _make_body(slots):
+            def kernel(x_ref, o_ref):
+                t = time.time()                 # tracer-safety: flagged
+                _h = np.asarray(x_ref[:])       # host-sync: flagged
+                o_ref[:] = x_ref[:] * slots
+            return kernel
+
+        def launch(x):
+            return pl.pallas_call(
+                _make_body(8),
+                out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype))(x)
+        """
+    ts = _scan(tmp_path, src, select=["tracer-safety"])
+    assert any("time.time()" in m and "`kernel`" in m
+               for m in _messages(ts)), _messages(ts)
+    hs = _scan(tmp_path, src, select=["host-sync"], name="mod2.py")
+    assert any("np.asarray" in m and "pallas kernel `kernel`" in m
+               for m in _messages(hs)), _messages(hs)
+
+
+def test_host_sync_flags_syncs_in_pallas_kernels(tmp_path):
+    findings = _scan(tmp_path, """
+        import numpy as np
+        import jax
+        from jax.experimental import pallas as pl
+
+        def kernel(x_ref, o_ref):
+            host = np.asarray(x_ref[:])          # host sync in a kernel
+            o_ref[:] = x_ref[:].block_until_ready()
+
+        def launch(x):
+            return pl.pallas_call(
+                kernel,
+                out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype))(x)
+        """, select=["host-sync"])
+    msgs = _messages(findings)
+    assert any("np.asarray" in m and "pallas kernel `kernel`" in m
+               for m in msgs), msgs
+    assert any(".block_until_ready()" in m for m in msgs), msgs
+
+
+def test_host_sync_pallas_clean_and_suppressed(tmp_path):
+    findings = _scan(tmp_path, """
+        import numpy as np
+        import jax
+        from jax.experimental import pallas as pl
+
+        def kernel(x_ref, o_ref):
+            o_ref[:] = x_ref[:] * 2
+
+        def debug_kernel(x_ref, o_ref):
+            o_ref[:] = x_ref[:]
+            _peek = np.asarray(o_ref[:])  # prestocheck: ignore[host-sync]
+
+        def launch(x):
+            a = pl.pallas_call(
+                kernel,
+                out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype))(x)
+            return pl.pallas_call(
+                debug_kernel,
+                out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype))(a)
+
+        def host_helper(x):
+            return np.asarray(x)  # NOT a kernel: out of this pass's scope
+        """, select=["host-sync"])
+    assert findings == [], _messages(findings)
+
+
 # ------------------------------------------------------- mutable-default-args
 
 def test_mutable_defaults_flagged_and_none_is_fine(tmp_path):

@@ -126,13 +126,17 @@ def test_skew_carryover(eight_devices):
     # partial agg collapses the skew before routing): each 512-row chunk
     # overflows its 128-slot peer slice and the overflow must carry into
     # later dispatches instead of dropping — correct by construction where
-    # the barrier path relies on worst-case capacity sizing
+    # the barrier path relies on worst-case capacity sizing.
+    # skew_aware_exchange=False: this test exercises the CARRY correctness
+    # backstop; with spreading on, hot rows never overflow a peer slice
+    # (that path is covered by test_skew_spreads_hot_key below)
     mesh = MeshContext(eight_devices[:8])
     sql = ("select count(*) from (select o_custkey * 0 as k from orders) o "
            "join (select r_regionkey * 0 as k from region "
            "where r_regionkey = 0) r on o.k = r.k")
     s = DistributedQueryRunner(
         mesh, session=_session(exchange_chunk_rows=512,
+                               skew_aware_exchange=False,
                                join_distribution_type="PARTITIONED"))
     b = DistributedQueryRunner(
         mesh, session=_session(streaming_exchange=False,
@@ -142,6 +146,100 @@ def test_skew_carryover(eight_devices):
     assert_rows_equal(rs.rows, rb.rows)
     assert rs.stats["exchange"]["carry_rows"] > 0, \
         "total skew must exercise the overflow carry-over path"
+
+
+# -------------------------------------------------- skew-aware spreading
+
+SKEWED_JOIN = (
+    # ~99% of the probe rows share key 7; the build side (customer) is
+    # unique per key — the probe exchange must detect the heavy hitter,
+    # spray its rows round-robin, and the build exchange must replicate
+    # key 7's single build row to every partition
+    "select count(*), sum(o.k) from "
+    "(select case when o_orderkey % 100 = 0 then o_custkey else 7 end as k "
+    " from orders) o "
+    "join (select c_custkey as k from customer) c on o.k = c.k")
+
+
+def _skewed_runner(eight_devices, n=4, **props):
+    mesh = MeshContext(eight_devices[:n])
+    return DistributedQueryRunner(
+        mesh, session=_session(exchange_chunk_rows=512,
+                               join_distribution_type="PARTITIONED",
+                               **props))
+
+
+def test_skew_spreads_hot_key(eight_devices):
+    # acceptance: the 99%-one-key partitioned join spreads the hot key
+    # across >= 2 partitions (per-partition exchange stats) and stays
+    # row-identical to the non-skew-aware path
+    oracle = _skewed_runner(eight_devices,
+                            skew_aware_exchange=False).execute(SKEWED_JOIN)
+    skewed = _skewed_runner(eight_devices).execute(SKEWED_JOIN)
+    assert_rows_equal(skewed.rows, oracle.rows)
+    per_ex = {e.get("skew_role"): e
+              for e in skewed.stats["exchange"]["per_exchange"]}
+    probe = per_ex.get("probe")
+    build = per_ex.get("build")
+    assert probe is not None and build is not None, per_ex.keys()
+    assert probe["hot_keys"] >= 1, probe
+    # the heavy side's rows landed on >= 2 partitions, and no partition
+    # holds more than ~half the stream (the old behavior: ~99% on one)
+    parts = probe["partition_rows"]
+    assert sum(p > 0 for p in parts) >= 2, parts
+    assert max(parts) < 0.6 * sum(parts), parts
+    # the peer replicated the hot key's build rows to every partition
+    assert build["replicated_rows"] > 0, build
+    # the oracle run concentrated the same stream on one partition
+    op = {e.get("fragment"): e
+          for e in oracle.stats["exchange"]["per_exchange"]}
+    oparts = op[probe["fragment"]]["partition_rows"]
+    assert max(oparts) > 0.9 * sum(oparts), oparts
+
+
+def test_skew_build_side_hot(eight_devices):
+    # the mirrored case: duplicate hot keys on the BUILD side split, and
+    # the probe side replicates its matching rows
+    sql = ("select count(*) from "
+           "(select o_custkey as k from orders where o_custkey <= 50) o "
+           "join (select case when c_custkey % 50 = 0 then c_custkey "
+           "             else 13 end as k from customer) c on o.k = c.k")
+    oracle = _skewed_runner(eight_devices,
+                            skew_aware_exchange=False).execute(sql)
+    skewed = _skewed_runner(eight_devices).execute(sql)
+    assert_rows_equal(skewed.rows, oracle.rows)
+    per_ex = {e.get("skew_role"): e
+              for e in skewed.stats["exchange"]["per_exchange"]}
+    assert per_ex["build"]["hot_keys"] >= 1, per_ex["build"]
+    parts = per_ex["build"]["partition_rows"]
+    assert sum(p > 0 for p in parts) >= 2, parts
+
+
+def test_skew_off_knob(eight_devices):
+    # skew_aware_exchange=False must leave every exchange unwired
+    r = _skewed_runner(eight_devices,
+                       skew_aware_exchange=False).execute(SKEWED_JOIN)
+    for e in r.stats["exchange"]["per_exchange"]:
+        assert "skew_role" not in e, e
+
+
+def test_skew_declines_when_downstream_needs_copartitioning(eight_devices):
+    # GROUP BY on the join key AFTER the join: the planner elides the
+    # re-exchange (join output is "partitioned" on k), so spraying the hot
+    # key would split one group across partitions and emit duplicate group
+    # rows. _skew_pair_safe must DECLINE the wiring (a non-PARTIAL agg
+    # downstream of the probe) — concentrated but correct, and the skew
+    # stats must show no roles were attached.
+    sql = (SKEWED_JOIN.replace("select count(*), sum(o.k)",
+                               "select o.k, count(*)")
+           + " group by o.k order by 2 desc, 1 limit 5")
+    oracle = _skewed_runner(eight_devices,
+                            skew_aware_exchange=False).execute(sql)
+    skewed = _skewed_runner(eight_devices).execute(sql)
+    assert_rows_equal(skewed.rows, oracle.rows)
+    assert not any("skew_role" in e
+                   for e in skewed.stats["exchange"]["per_exchange"]), \
+        skewed.stats["exchange"]["per_exchange"]
 
 
 # ------------------------------------------------- backpressure / teardown

@@ -16,12 +16,19 @@ silently skipped on later calls. The classic wrong-answer generators:
 
 Roots: functions decorated ``@jax.jit`` / ``@functools.partial(jax.jit,...)``
 or passed to a ``jax.jit(...)`` call anywhere in the module (including
-``jax.jit(self._method)``). Parameters named in ``static_argnames`` /
+``jax.jit(self._method)``), PLUS ``pl.pallas_call(kernel, ...)`` kernel
+bodies — a Pallas kernel traces exactly once like any jit root, and its
+parameters are all Refs/tracers. Parameters named in ``static_argnames`` /
 ``static_argnums`` are exempt from the concretization checks (static args are
 concrete by contract). The module-local call graph extends the checks to
 helpers reachable from a root — for those, only the always-wrong checks run
 (print / time / random / global / ``.item()``), since we cannot tell which of
 their arguments are traced.
+
+Pallas kernel bodies get one extra check: PYTHON control flow (``if`` /
+``while``) whose test touches a kernel parameter — a Ref has no truth value
+at trace time (and a branch on one would freeze at trace time if it did);
+kernels must use ``@pl.when`` / ``lax.cond`` / mask arithmetic instead.
 """
 from __future__ import annotations
 
@@ -98,8 +105,15 @@ class _FnInfo:
     def __init__(self, node):
         self.node = node
         self.is_root = False
+        self.is_pallas = False  # a pl.pallas_call kernel body
         self.static_spec: Set = set()
         self.reachable = False
+
+
+def _is_pallas_call(node: ast.AST) -> bool:
+    """`pl.pallas_call` / `pallas.pallas_call` / bare `pallas_call`."""
+    name = dotted_name(node)
+    return name is not None and name.split(".")[-1] == "pallas_call"
 
 
 @register
@@ -135,20 +149,39 @@ class TracerSafetyPass(Pass):
                         if spec is not None:
                             info.is_root = True
                             info.static_spec |= spec
-        # ---- roots from jax.jit(f) / jax.jit(self._m) call sites
+        # ---- roots from jax.jit(f) / jax.jit(self._m) call sites, and
+        # ---- pallas kernel bodies from pl.pallas_call(kernel, ...)
         for node in ast.walk(tree):
-            if not (isinstance(node, ast.Call) and _is_jax_jit(node.func)
-                    and node.args):
+            if not (isinstance(node, ast.Call) and node.args):
                 continue
-            spec = _jit_call_static(node) or set()
+            pallas = _is_pallas_call(node.func)
+            if not pallas and not _is_jax_jit(node.func):
+                continue
+            spec = set() if pallas else (_jit_call_static(node) or set())
             target = node.args[0]
             name = None
             if isinstance(target, ast.Name):
                 name = target.id
             elif isinstance(target, ast.Attribute):
                 name = target.attr
+            elif pallas and isinstance(target, ast.Call):
+                # factory pattern: pl.pallas_call(_make_body(...), ...) —
+                # the kernel is a closure DEFINED INSIDE the factory; mark
+                # the factory's direct child defs as the kernel bodies
+                fname = dotted_name(target.func)
+                fname = fname.split(".")[-1] if fname else None
+                for factory in fns.get(fname or "", []):
+                    for stmt in factory.node.body:
+                        if isinstance(stmt, (ast.FunctionDef,
+                                             ast.AsyncFunctionDef)):
+                            inner = infos_of(stmt)
+                            if inner is not None:
+                                inner.is_root = True
+                                inner.is_pallas = True
+                continue
             for info in fns.get(name, []):
                 info.is_root = True
+                info.is_pallas = info.is_pallas or pallas
                 info.static_spec |= spec
 
         roots = [i for infos in fns.values() for i in infos if i.is_root]
@@ -205,6 +238,18 @@ class TracerSafetyPass(Pass):
             return None
 
         for node in ast.walk(fn):
+            if info.is_pallas and isinstance(node, (ast.If, ast.While)):
+                # python control flow on a kernel Ref/tracer: branches
+                # resolve at trace time (or fail outright on a Ref) — use
+                # @pl.when / lax.cond / mask arithmetic inside kernels
+                hit = touches_traced(node.test)
+                if hit:
+                    kw = "while" if isinstance(node, ast.While) else "if"
+                    yield from emit(
+                        node, f"python `{kw}` on kernel parameter `{hit}` "
+                        f"in pallas kernel `{fn.name}` — control flow must "
+                        "be @pl.when / lax.cond / masked arithmetic")
+                continue
             if isinstance(node, ast.Global):
                 # global + assignment in this fn = trace-time-only mutation
                 assigned = {t.id for a in ast.walk(fn)
