@@ -659,6 +659,137 @@ def bench_serving(clients=(1, 4, 8), per_client: int = 4,
         server.stop()
 
 
+def bench_chaos() -> dict:
+    """Chaos rung (reported, never gated): the same high-cardinality
+    aggregation on an in-process 2-worker HTTP cluster, run (a) clean,
+    (b) with a worker killed mid-stream under TASK retry — delivered+acked
+    chunks must replay from the producer spool — and (c) with one leaf
+    stalled far past the straggler-speculation threshold. Reports recovery
+    overhead (wall vs clean), attempts/retries/speculations, the peak
+    spooled bytes the workers reported, and row correctness — the
+    robustness analogue of a perf number."""
+    import threading as _th
+    import urllib.request as _rq
+
+    from presto_tpu.cluster import faults
+    from presto_tpu.cluster.coordinator import ClusterQueryRunner
+    from presto_tpu.cluster.scheduler import _remote_source_ids
+    from presto_tpu.cluster.worker import WorkerServer
+    from presto_tpu.metadata import Session
+    from presto_tpu.runner import LocalQueryRunner
+
+    sql = ("select l_orderkey, count(*), sum(l_quantity) "
+           "from lineitem group by l_orderkey")
+    want_rows = sorted(LocalQueryRunner(
+        session=Session(catalog="tpch", schema="tiny")).execute(sql).rows)
+
+    def run_mode(mode: str) -> dict:
+        props = {"retry_policy": "TASK",
+                 "exchange_flush_rows": 512,
+                 "retry_initial_delay_s": 0.01,
+                 "retry_max_delay_s": 0.05}
+        if mode == "speculation":
+            props.update({"speculative_execution": True,
+                          "speculation_min_wall_s": 0.4,
+                          "speculation_multiplier": 2.0})
+        runner = ClusterQueryRunner(
+            session=Session(catalog="tpch", schema="tiny", properties=props),
+            min_workers=2, worker_wait_s=10.0)
+        workers = [WorkerServer(port=0).start() for _ in range(2)]
+        dead, stop = set(), _th.Event()
+        for w in workers:
+            runner.nodes.announce(w.node_id, w.uri)
+
+        def keep_alive():
+            while not stop.wait(0.5):
+                for w in workers:
+                    if w.node_id not in dead:
+                        runner.nodes.announce(w.node_id, w.uri)
+                for nid in list(dead):
+                    runner.nodes.remove(nid)
+
+        _th.Thread(target=keep_alive, daemon=True).start()
+        sub = runner.plan_sql(sql)
+        leaf = next(f.id for f in sub.fragments
+                    if not _remote_source_ids(f.root)
+                    and f.id != sub.root_fragment.id)
+        inj = faults.FaultInjector(seed=23)
+        if mode == "mid_stream_kill":
+            victim = min(workers, key=lambda w: w.node_id)
+            killed = _th.Event()
+
+            def kill(ctx):
+                token = int(ctx["path"].partition("?")[0]
+                            .rstrip("/").rsplit("/", 1)[-1])
+                if token < 1 or killed.is_set():
+                    return
+                killed.set()
+                dead.add(victim.node_id)
+                victim.stop()
+                runner.nodes.remove(victim.node_id)
+                raise faults.InjectedDisconnect("worker killed")
+
+            # kill only once a consumer asks for token >= 1 of the victim's
+            # leaf stream: chunk 0 was delivered AND acked by then, so the
+            # recovery must replay mid-stream from the spool
+            inj.add("worker.results", faults.CALLBACK,
+                    node_id=victim.node_id, task_re=rf"\.{leaf}\.0$",
+                    times=None, callback=kill)
+        elif mode == "speculation":
+            inj.add("worker.task_run", faults.DELAY, delay_s=5.0, times=1,
+                    task_re=rf"\.{leaf}\.0$")
+        faults.install(inj)
+
+        # sample the workers' reported spool while the query runs: the
+        # acceptance surface for "spooled bytes live in the unified pool"
+        spool_peak = [0]
+        mon_stop = _th.Event()
+
+        def spool_monitor():
+            while not mon_stop.wait(0.05):
+                for w in workers:
+                    if w.node_id in dead:
+                        continue
+                    try:
+                        with _rq.urlopen(f"{w.uri}/v1/status",
+                                         timeout=1.0) as r:
+                            st = json.loads(r.read())
+                        spool_peak[0] = max(spool_peak[0],
+                                            int(st.get("spooledBytes") or 0))
+                    except Exception:  # noqa: BLE001 - monitor is best-effort
+                        pass
+
+        _th.Thread(target=spool_monitor, daemon=True).start()
+        t0 = time.time()
+        try:
+            got = runner.execute(sql)
+            wall = time.time() - t0
+        finally:
+            mon_stop.set()
+            stop.set()
+            faults.clear()
+            runner.detector.stop()
+            for w in workers:
+                if w.node_id not in dead:
+                    w.stop()
+        return {"wall_s": round(wall, 3),
+                "rows_match": sorted(got.rows) == want_rows,
+                "query_attempts": got.stats.get("query_attempts"),
+                "task_retries": got.stats.get("task_retries"),
+                "task_speculations": got.stats.get("task_speculations"),
+                "faults_injected": got.stats.get("faults_injected"),
+                "spooled_bytes_peak": spool_peak[0]}
+
+    out = {"schema": "tiny"}
+    for mode in ("clean", "mid_stream_kill", "speculation"):
+        out[mode] = run_mode(mode)
+    clean = out["clean"].get("wall_s")
+    kill_wall = out["mid_stream_kill"].get("wall_s")
+    if clean and kill_wall:
+        out["recovery_overhead_x"] = round(kill_wall / clean, 3)
+    return out
+
+
 def bench_hash_kernels(quick: bool = False, skew_devices: int = 4,
                        skew_budget_s: float = 600.0) -> dict:
     """Pallas hash-kernel rung (VERDICT ask #6: one Pallas kernel that wins
@@ -882,6 +1013,12 @@ def compare_benches(prev: dict, cur: dict,
         same_load = (p.get("queries") == c.get("queries")
                      and p.get("clients") == c.get("clients"))
         record(f"serving.{key}", p, c, gate=comparable and same_load)
+    # chaos rung: recovery walls are dominated by injected faults and retry
+    # backoff, not engine speed — reported for trend-watching, never gated
+    for key in ("clean", "mid_stream_kill", "speculation"):
+        p = (pd.get("chaos") or {}).get(key) or {}
+        c = (cd.get("chaos") or {}).get(key) or {}
+        record(f"chaos.{key}", p, c, gate=False)
     return {"threshold": threshold, "comparable_platform": comparable,
             "prev_platform": pd.get("platform"),
             "cur_platform": cd.get("platform"),
@@ -1024,6 +1161,14 @@ def main():
             per_client=2 if args.quick else 4)
     except Exception as e:
         detail["serving"] = {"error": repr(e)[:300]}
+
+    # chaos rung: mid-stream worker kill + straggler speculation on an
+    # in-process cluster — recovery-overhead numbers ride along with every
+    # bench run (reported in --compare, never gated)
+    try:
+        detail["chaos"] = bench_chaos()
+    except Exception as e:
+        detail["chaos"] = {"error": repr(e)[:300]}
 
     # Pallas hash kernels: sorted-vs-pallas build/probe + Q3 walls, plus the
     # skew-aware 99%-one-key join spread (VERDICT #6's measured verdict)

@@ -165,11 +165,24 @@ class ClusterQueryRunner:
         captures lifecycle spans plus every task-create/poll and result-pull
         HTTP call (the `http` category). The lifecycle span only opens when
         THIS query's recorder actually installed — an untraced query running
-        concurrently with a traced one must not write into its timeline."""
+        concurrently with a traced one must not write into its timeline.
+
+        Correlation: with the protocol layer in front, the ambient progress
+        scope already carries the client-visible query id and the recorder
+        inherits it. Used directly (embedded coordinator, tests), no scope
+        exists — bind the recorder's id so the internal per-attempt cq* ids
+        journaled below it pick up a corr_id and one filter finds both."""
         import time as _time
+
+        from ..exec import progress
 
         rec = trace.maybe_recorder(session)
         installed = rec is not None and trace.install(rec)
+        scope = None
+        if rec is not None and rec.query_id \
+                and progress.current_query_id() is None:
+            scope = progress.query_scope(rec.query_id)
+            scope.__enter__()
         t0 = _time.perf_counter()
         try:
             if installed:
@@ -186,6 +199,8 @@ class ClusterQueryRunner:
                 trace.attach_failure(e, rec, session)
             raise
         finally:
+            if scope is not None:
+                scope.__exit__(None, None, None)
             if installed:
                 trace.uninstall(rec)
         METRICS.histogram("query.wall_s", _time.perf_counter() - t0)
@@ -217,7 +232,8 @@ class ClusterQueryRunner:
         faults_before = injector.total_fired if injector else 0
         stats = {"retry_policy": policy, "query_attempts": 0,
                  "task_attempts": 0, "task_retries": 0,
-                 "faults_injected": 0, "backoff_s": 0.0}
+                 "task_speculations": 0, "faults_injected": 0,
+                 "backoff_s": 0.0}
         failure_trace: Optional[str] = None
         while True:
             stats["query_attempts"] += 1
@@ -295,6 +311,7 @@ class ClusterQueryRunner:
             unregister()
             stats["task_attempts"] += scheduler.task_attempts
             stats["task_retries"] += scheduler.task_retries
+            stats["task_speculations"] += scheduler.task_speculations
             stats["backoff_s"] += scheduler.backoff_s
             self._schedulers.pop(query_id, None)
             # free finished tasks' buffers/state on the workers
@@ -447,6 +464,10 @@ class ClusterQueryRunner:
                     int(self.session.get("page_capacity") or (1 << 16)),
                     error_budget_s=float(
                         _MAX_ERROR_S if budget is None else budget))
+                # hand the in-process consumer to the scheduler: root-task
+                # recovery rewires its chunk cursor directly (there is no
+                # worker-side /sources endpoint for the coordinator)
+                scheduler.register_root_consumer(source)
                 for page in source:
                     rows.extend(page.to_pylists())
             except BaseException as e:  # noqa: BLE001
@@ -458,7 +479,9 @@ class ClusterQueryRunner:
                                   daemon=True)
         puller.start()
         while not done.wait(timeout=0.5):
-            scheduler.check_failures(active_nodes=self.nodes.active_nodes())
+            active = self.nodes.active_nodes()
+            scheduler.check_failures(active_nodes=active)
+            scheduler.maybe_speculate(active)
         # `done` is set in pull()'s finally, so the thread is exiting: the
         # bounded join keeps it from outliving the query (and from racing a
         # teardown of `rows`/`error`, which it captured by closure)

@@ -8,14 +8,19 @@ off and retry under the shared cluster/retry.Backoff budget
 (server/remotetask/Backoff.java); a hard error or an upstream task failure
 fails the consumer.
 
-Fault tolerance: a client whose stream is still virgin (token 0, nothing
-consumed) can be REWIRED to a replacement producer location when the
-scheduler recovers a failed leaf task (POST /v1/task/{id}/sources ->
-SqlTask.update_sources -> reset_location here); once any frame has been
-consumed a rewire is rejected and the failure escalates to a query retry."""
+Fault tolerance: every client tracks a per-consumer CHUNK CURSOR (`token`,
+the next sequence number it needs). When the scheduler recovers a failed
+producer (POST /v1/task/{id}/sources -> SqlTask.update_sources ->
+reset_location here) the client keeps its cursor and re-issues GET from it
+against the replacement — the replacement re-produces the same deterministic
+frame sequence (single sink driver; a nondeterministic sink marks its buffer
+non-replayable server-side), its spool absorbs the prefix the consumer
+already has, and a monotonic sequence check asserts exactly-once delivery.
+A replayed token that was already retired from the producer's bounded spool
+answers HTTP 410 (`replay window lost`) — a hard error that escalates
+loudly to a query-level retry instead of silently skipping data."""
 from __future__ import annotations
 
-import json
 import threading
 import time
 import urllib.error
@@ -36,16 +41,6 @@ from .serde import deserialize_pages
 # (the exchange_error_budget_s session default in metadata.py matches; use
 # this constant as the fallback wherever that property might be None)
 _MAX_ERROR_S = 60.0
-
-
-def http_json(method: str, url: str, body: Optional[bytes] = None,
-              timeout_s: float = 30.0) -> dict:
-    req = urllib.request.Request(url, data=body, method=method)
-    if body is not None:
-        req.add_header("Content-Type", "application/octet-stream")
-    with urllib.request.urlopen(req, timeout=timeout_s) as resp:
-        data = resp.read()
-    return json.loads(data) if data else {}
 
 
 class PageBufferClient:
@@ -78,7 +73,9 @@ class PageBufferClient:
         req = urllib.request.Request(url, method="GET")
         t0 = time.perf_counter_ns()
         try:
-            faults.fire("client.results", location=location)
+            # token rides along so chaos callbacks can key on consumption
+            # state (e.g. "fail this consumer once it has committed 2 chunks")
+            faults.fire("client.results", location=location, token=self.token)
             with urllib.request.urlopen(req, timeout=timeout_s + 15.0) as resp:
                 nxt = int(resp.headers.get("X-Next-Token", self.token))
                 complete = resp.headers.get("X-Complete") == "true"
@@ -90,6 +87,15 @@ class PageBufferClient:
                              {"location": location,
                               "bytes": len(frame) if frame else 0})
         except urllib.error.HTTPError as e:
+            if e.code == 410:
+                # the producer retired this chunk from its replay spool
+                # (overflow or nondeterministic sink): waiting cannot help
+                # and skipping would lose rows — hard-fail; the message
+                # marker classifies it QUERY-retryable upstream
+                detail = e.read()[:300].decode(errors="replace")
+                raise RuntimeError(
+                    f"exchange source {location} cannot replay: "
+                    f"{detail or 'replay window lost'}") from e
             if e.code == 404 or e.code >= 500:
                 # 404: producer task not created yet (all-at-once scheduling
                 # may reach the consumer first); 5xx: a server-side blip or
@@ -125,6 +131,13 @@ class PageBufferClient:
                         f"(instance {self._instance_id} -> {instance}); "
                         f"stream tokens are no longer valid")
             self._backoff.success()
+            if frame and nxt != self.token + 1:
+                # exactly-once guard: a served frame must advance the cursor
+                # by exactly one sequence number — anything else means the
+                # producer skipped or re-delivered a chunk
+                raise RuntimeError(
+                    f"exchange source {location} sequence violation: "
+                    f"cursor {self.token} answered with next token {nxt}")
             self.token = nxt
             self.complete = complete
         return frame if frame else None
@@ -140,22 +153,29 @@ class PageBufferClient:
         return None
 
     def can_reset(self) -> bool:
-        with self._lock:
-            return not (self.token > 0 or self.complete or self.done)
+        # the chunk cursor makes a mid-stream rewire sound: the client
+        # re-issues GET from `token` and the replacement's spool replays or
+        # absorbs the already-consumed prefix (410 if it cannot)
+        return True
 
     def reset_location(self, new_location: str) -> bool:
-        """Point this client at a replacement producer. Sound only while the
-        stream is virgin: any consumed frame would be silently re-produced
-        by the replacement (which restarts at token 0). Bumps the epoch so
-        an in-flight poll against the old location cannot commit."""
+        """Point this client at a replacement producer, KEEPING the chunk
+        cursor: the next poll re-issues GET from `token` and sequence
+        numbers assert exactly-once delivery across the rewire. Bumps the
+        epoch so an in-flight poll against the old location cannot commit;
+        clears the pinned instance id (the replacement is a new instance by
+        design). A finished (`done`) client just releases the replacement's
+        buffer so the new task never wedges on backpressure."""
+        was_done = False
         with self._lock:
-            if self.token > 0 or self.complete or self.done:
-                return False
             self.location = new_location.rstrip("/")
             self._instance_id = None
             self._epoch += 1
             self._backoff.success()
-            return True
+            was_done = self.done
+        if was_done:
+            self.finished_ack()
+        return True
 
     def finished_ack(self) -> None:
         """Final ack freeing the server-side buffer (abort endpoint)."""
@@ -204,8 +224,8 @@ class StreamingRemoteSource(ConnectorPageSource):
 
     def reset_location(self, old_location: str, new_location: str) -> bool:
         """Rewire the client pulling `old_location` to a replacement
-        producer; False when no virgin client matches (already consumed —
-        the caller escalates to a query-level retry)."""
+        producer, cursor preserved (mid-stream rewires are sound under the
+        chunk protocol); False only when no client matches that location."""
         old = old_location.rstrip("/")
         with self._lock:
             for client in self.clients:
@@ -245,6 +265,13 @@ class StreamingRemoteSource(ConnectorPageSource):
                 idle.wait()
 
     def close(self) -> None:
+        # a CANCELLED consumer must NOT send final acks: the DELETE would
+        # release the producer-side buffer (and its replay spool) that this
+        # task's replacement — same buffer id, fresh cursor — still needs.
+        # On the abort path the producers are torn down too, which frees
+        # their buffers without any ack.
+        if self.cancelled is not None and self.cancelled.is_set():
+            return
         with self._lock:
             clients = list(self.clients)
         for c in clients:
@@ -288,24 +315,31 @@ class MergingRemoteSource(ConnectorPageSource):
     def can_reset_location(self, old_location: str) -> bool:
         old = old_location.rstrip("/")
         with self._lock:
-            return not self._started and \
-                any(loc.rstrip("/") == old for loc in self.locations)
+            if self._started:
+                inner = list(self._inner)
+            else:
+                return any(loc.rstrip("/") == old for loc in self.locations)
+        return any(src.can_reset_location(old) for src in inner)
 
     def reset_location(self, old_location: str, new_location: str) -> bool:
-        """Rewire is sound only before the merge started consuming (the heap
-        interleaves rows from every stream, so no per-stream virginity check
-        helps once iteration began)."""
+        """Rewire one producer stream to a replacement. Before the merge
+        starts this just swaps the location; after, it delegates to the
+        per-stream inner source, whose chunk cursor makes the mid-stream
+        rewire sound — the heap has consumed exactly the frames below that
+        cursor, and already-deserialized rows stay buffered in the merge."""
         old = old_location.rstrip("/")
         with self._lock:
-            if self._started:
-                return False
             for i, loc in enumerate(self.locations):
                 if loc.rstrip("/") == old:
                     self.locations[i] = new_location
-                    return True
-        return False
+            if self._started:
+                inner = list(self._inner)
+            else:
+                return any(loc.rstrip("/") == new_location.rstrip("/")
+                           for loc in self.locations)
+        return any(src.reset_location(old, new_location) for src in inner)
 
-    def _row_iter(self, location: str):
+    def _row_iter(self, src: "StreamingRemoteSource"):
         """-> (sort key, row values tuple, row nulls tuple) per live row."""
         from ..exec.grouped import _Cmp, _Neg, _Null
 
@@ -315,11 +349,6 @@ class MergingRemoteSource(ConnectorPageSource):
             d = self.dicts[ch]
             if d is not None and hasattr(d, "sort_keys"):
                 ranks[ch] = np.asarray(d.sort_keys())
-        src = StreamingRemoteSource([location], self.buffer_id, self.types,
-                                    self.dicts, self.page_capacity,
-                                    cancelled=self.cancelled,
-                                    error_budget_s=self.error_budget_s)
-        self._inner.append(src)
         for page in src:
             mask = np.asarray(page.mask)
             datas = [np.asarray(b.data) for b in page.blocks]
@@ -348,9 +377,17 @@ class MergingRemoteSource(ConnectorPageSource):
         from ..block import Block, Page as _Page
 
         with self._lock:
-            self._started = True  # rewire window closes here
-            locations = list(self.locations)
-        merged = heapq.merge(*(self._row_iter(loc) for loc in locations),
+            # materialize one inner source per producer BEFORE marking
+            # started: a rewire arriving from here on always finds a live
+            # per-stream cursor to delegate to (no lazy-creation race)
+            for loc in self.locations:
+                self._inner.append(StreamingRemoteSource(
+                    [loc], self.buffer_id, self.types, self.dicts,
+                    self.page_capacity, cancelled=self.cancelled,
+                    error_budget_s=self.error_budget_s))
+            self._started = True
+            inner = list(self._inner)
+        merged = heapq.merge(*(self._row_iter(src) for src in inner),
                              key=lambda t: t[0])
         ncols = len(self.types)
         buf_vals: List[list] = [[] for _ in range(ncols)]

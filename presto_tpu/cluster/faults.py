@@ -49,6 +49,17 @@ ERROR = "error"            # raise InjectedFault (plain exception)
 CALLBACK = "callback"      # run rule.callback(ctx); it may itself raise
 KINDS = (DELAY, DISCONNECT, HTTP_ERROR, ERROR, CALLBACK)
 
+# every fire() site in the tree — the spec grammar's point vocabulary.
+# from_spec validates each rule's point pattern against this list, so a
+# typo'd chaos spec ("worker.resutls:...") fails LOUDLY at install time
+# instead of silently injecting nothing for the whole run
+FIRE_POINTS = (
+    "worker.task_create", "worker.task_info", "worker.results",
+    "worker.status", "worker.task_run",
+    "client.task_create", "client.task_poll", "client.results",
+    "client.announce",
+)
+
 
 class InjectedFault(Exception):
     """Base class for injected failures (classified retryable)."""
@@ -185,6 +196,13 @@ class FaultInjector:
                 raise ValueError(f"bad fault rule {part!r} "
                                  "(want point:kind[:k=v,...])")
             point, kind = pieces[0].strip(), pieces[1].strip()
+            if not any(fnmatch.fnmatch(p, point) for p in FIRE_POINTS):
+                raise ValueError(
+                    f"unknown fault point {point!r}: pattern matches no "
+                    f"fire point (one of {', '.join(FIRE_POINTS)})")
+            if kind not in KINDS:
+                raise ValueError(
+                    f"unknown fault kind {kind!r} (one of {', '.join(KINDS)})")
             kw: Dict[str, object] = {}
             if len(pieces) == 3 and pieces[2].strip():
                 for item in pieces[2].split(","):

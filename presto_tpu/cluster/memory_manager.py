@@ -103,5 +103,7 @@ class ClusterMemoryManager:
 
     @staticmethod
     def _http_status(uri: str) -> Dict:
-        with urllib.request.urlopen(f"{uri}/v1/status", timeout=2.0) as resp:
+        # raise-through by design: poll_once classifies the failure (a dead
+        # node is the failure detector's job, this poll just skips it)
+        with urllib.request.urlopen(f"{uri}/v1/status", timeout=2.0) as resp:  # prestocheck: ignore[retry-discipline]
             return json.loads(resp.read())

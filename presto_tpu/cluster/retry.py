@@ -13,14 +13,16 @@ to decide whether a query attempt may be transparently re-run:
   NONE   — today's behavior: any task failure or node death fails the query.
   QUERY  — the coordinator re-plans and re-executes the whole query on a
            retryable failure, excluding failed nodes from placement.
-  TASK   — QUERY, plus in-place recovery of failed LEAF tasks (no remote
-           sources, not the root fragment) whose consumers have not yet
-           consumed any of their output; anything else escalates to a
-           query-level retry. Mid-stream task retry under a streaming
-           (non-spooled) shuffle is unsound in general — upstream buffers
-           free acked frames — so the sound subset is recovered in place
-           and the rest is escalated, matching the reference's split
-           between pipelined and fault-tolerant (spooled) execution.
+  TASK   — QUERY, plus in-place recovery of failed tasks (leaf AND
+           interior, mid-stream included): upstream buffers spool acked
+           chunks (cluster/buffers.py), so a replacement task re-pulls its
+           inputs from sequence 0 and each consumer re-issues GET from its
+           chunk cursor against the replacement (cluster/exchange_client).
+           The unsound remainder — a replay window retired from a bounded
+           spool (HTTP 410), a nondeterministic multi-driver sink, or a
+           consumer that cannot be rewired — escalates loudly to a
+           query-level retry, matching the reference's split between
+           pipelined and fault-tolerant (spooled) execution.
 """
 from __future__ import annotations
 
@@ -148,6 +150,8 @@ _RETRYABLE_MESSAGE_MARKERS = (
     "unreachable", "was recreated", "connection reset", "connection refused",
     "remote end closed", "timed out", "injected fault", "worker killed",
     "output buffer failed", "task output failed",
+    # spool replay unsound (410): only a full query re-run can help
+    "replay window lost", "cannot replay",
 )
 
 

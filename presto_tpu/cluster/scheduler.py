@@ -16,12 +16,20 @@ Fault tolerance (retry_policy session property, cluster/retry.py):
     Backoff budget; 4xx rejections stay deterministic hard errors
   - TASK policy re-places a task whose create exhausted its budget onto
     another healthy node (consumers are created after producers, so their
-    input_locations simply use the new location), and recovers failed LEAF
-    tasks in place mid-query: replacement on a healthy node under a new
-    attempt id, consumers' PageBufferClient streams rewired through
-    POST /v1/task/{id}/sources (rejected — escalating to a query retry —
-    if any consumer already consumed from the dead task, because upstream
-    buffers free acked frames; see retry.py's taxonomy)
+    input_locations simply use the new location), and recovers failed tasks
+    in place mid-query — leaf AND interior, mid-stream included, now that
+    upstream buffers spool acked chunks (cluster/buffers.py): the
+    replacement re-pulls its inputs from sequence 0, re-produces the same
+    deterministic frame sequence, and every consumer keeps its chunk cursor
+    across the POST /v1/task/{id}/sources rewire (the coordinator's own
+    root pull rewires through register_root_consumer). A stream whose
+    replay window was retired (HTTP 410) escalates to a query retry.
+  - straggler speculation (speculative_execution knob): a task running
+    far past its finished siblings gets a duplicate on another node;
+    first to FINISH wins — the loser is aborted and the decision is
+    journaled `task.speculated`
+  - placement weighs the failure detector's decayed failure ratio
+    (NodeScheduler.select / _pick_node) instead of excluding-or-round-robin
   - check_failures raises NodeDiedError/TaskFailedError with the node id
     so the coordinator can exclude failed nodes from the next attempt
 """
@@ -29,9 +37,11 @@ from __future__ import annotations
 
 import dataclasses
 import http.client
+import statistics
+import time
 import urllib.error
 import urllib.request
-from typing import Dict, List, Optional, Set
+from typing import Dict, List, Optional, Set, Tuple
 
 from ..metadata import Session
 from ..sql.planner.fragmenter import Fragment, SINGLE_PART, SubPlan
@@ -55,6 +65,15 @@ class RemoteTask:
         self.location = f"{node.uri}/v1/task/{task_id}"
         self.info: Optional[TaskInfo] = None
         self.request: Optional[TaskUpdateRequest] = None
+        # wall tracking for straggler speculation: created here, done when a
+        # poll first observes a terminal state
+        self.created_mono = time.monotonic()
+        self.done_mono: Optional[float] = None
+
+    def wall_s(self) -> float:
+        end = self.done_mono if self.done_mono is not None \
+            else time.monotonic()
+        return end - self.created_mono
 
     def create(self, request: TaskUpdateRequest,
                backoff: Optional[Backoff] = None) -> TaskInfo:
@@ -108,6 +127,9 @@ class RemoteTask:
                             node=self.node.node_id):
                 with urllib.request.urlopen(req, timeout=10.0) as resp:
                     self.info = codec.loads(resp.read())
+            if self.info is not None and self.info.state in DONE_STATES \
+                    and self.done_mono is None:
+                self.done_mono = time.monotonic()
             return self.info
         except (urllib.error.URLError, OSError):
             return None  # judged by the failure detector, not one lost poll
@@ -140,16 +162,34 @@ class RemoteTask:
 
 class NodeScheduler:
     """SimpleNodeSelector.java:45 (narrowed): every active node runs one task
-    of each distributed fragment; single-task fragments rotate over nodes by
-    fragment id so consecutive SINGLE stages spread."""
+    of each distributed fragment; single-task fragments rotate by fragment id
+    so consecutive SINGLE stages spread. Retry-aware placement: instead of
+    excluding-or-round-robin, selection WEIGHS the failure detector's decayed
+    failure ratio (discovery.HeartbeatFailureDetector) — a node with a flaky
+    recent history stops receiving single-task fragments before it is sick
+    enough to be expelled from active_nodes()."""
 
     def __init__(self, nodes: List[NodeInfo]):
         assert nodes, "no active worker nodes"
         self.nodes = nodes
 
+    @staticmethod
+    def _bucket(node: NodeInfo) -> int:
+        # quarter-buckets so ordinary heartbeat jitter cannot reshuffle
+        # placement between monitor ticks
+        return int(min(max(node.failure_ratio, 0.0), 1.0) * 4)
+
+    def ranked(self) -> List[NodeInfo]:
+        """Nodes best-first by bucketed decayed failure ratio; the sort is
+        stable, so announce order breaks ties (the pre-detector behavior)."""
+        return sorted(self.nodes, key=self._bucket)
+
     def select(self, fragment: Fragment) -> List[NodeInfo]:
         if fragment.partitioning == SINGLE_PART:
-            return [self.nodes[fragment.id % len(self.nodes)]]
+            ranked = self.ranked()
+            best = self._bucket(ranked[0])
+            healthy = [n for n in ranked if self._bucket(n) == best]
+            return [healthy[fragment.id % len(healthy)]]
         return list(self.nodes)
 
 
@@ -185,7 +225,17 @@ class SqlQueryScheduler:
         # observability (surfaced via QueryResult.stats + /v1/metrics)
         self.task_attempts = 0
         self.task_retries = 0
+        self.task_speculations = 0
         self.backoff_s = 0.0
+        # the coordinator's in-process root consumer (StreamingRemoteSource):
+        # registered so root-task recovery can rewire its cursor directly —
+        # there is no worker-side /sources endpoint for the coordinator
+        self._root_consumer = None
+        # straggler speculation: (fragment id, task index) -> (task_id of the
+        # original at launch, speculative RemoteTask). Spec tasks are NOT in
+        # self.stages, so check_failures never treats their failure as fatal.
+        self._live_spec: Dict[Tuple[int, int], Tuple[str, RemoteTask]] = {}
+        self._spec_done: Set[str] = set()  # base ids speculated once already
 
     def _consumer_task_counts(self) -> Dict[int, int]:
         """fragment id -> number of tasks of its consuming fragment."""
@@ -301,16 +351,26 @@ class SqlQueryScheduler:
                 self.backoff_s += backoff.total_backoff_s
 
     def _pick_node(self, exclude: Set[str]) -> Optional[NodeInfo]:
-        for node in self.selector.nodes:
-            if node.node_id not in exclude \
-                    and node.node_id not in self.excluded_nodes:
-                return node
-        return None
+        candidates = [node for node in self.selector.nodes
+                      if node.node_id not in exclude
+                      and node.node_id not in self.excluded_nodes]
+        if not candidates:
+            return None
+        # weigh the decayed failure ratio: re-place onto the node with the
+        # cleanest recent history (stable min keeps announce order on ties)
+        return min(candidates, key=NodeScheduler._bucket)
 
     # ------------------------------------------------------------ monitoring
 
     def root_task(self) -> RemoteTask:
         return self.stages[self.subplan.root_fragment.id].tasks[0]
+
+    def register_root_consumer(self, source) -> None:
+        """The coordinator's pull thread hands over its StreamingRemoteSource
+        so root-task recovery can rewire the in-process consumer's chunk
+        cursor (workers rewire via POST /sources; the coordinator has no
+        such endpoint — it IS the consumer)."""
+        self._root_consumer = source
 
     def all_tasks(self) -> List[RemoteTask]:
         return [t for s in self.stages.values() for t in s.tasks]
@@ -319,9 +379,11 @@ class SqlQueryScheduler:
                        active_nodes: Optional[List[NodeInfo]] = None,
                        recover: bool = True) -> None:
         """Poll task infos; raise on any FAILED task or dead node. Under TASK
-        policy, first try in-place recovery of the sound subset (leaf
-        fragments nobody consumed from yet); everything else raises a typed
-        error the coordinator's query-retry loop classifies. Pass
+        policy, first try in-place recovery (leaf AND interior, mid-stream:
+        upstream spools + consumer cursors make the replay sound); what
+        recovery cannot heal — a retired replay window, an exhausted attempt
+        budget, a rejected rewire — raises a typed error the coordinator's
+        query-retry loop classifies. Pass
         ``recover=False`` on diagnosis-only calls (an attempt already known
         lost): recovery there would build a replacement task just to throw
         it away, and a successful recovery would swallow the typed error
@@ -352,7 +414,8 @@ class SqlQueryScheduler:
                     continue
                 if recover and self.retry_policy == retry.TASK \
                         and failure.retryable and active_nodes \
-                        and self._recover_task(stage, idx, active_nodes):
+                        and self._recover_task(stage, idx, active_nodes,
+                                               failure):
                     continue
                 from ..utils import events
                 events.emit(
@@ -372,24 +435,33 @@ class SqlQueryScheduler:
             raise pending[0]
 
     def _recover_task(self, stage: StageExecution, idx: int,
-                      active_nodes: List[NodeInfo]) -> bool:
-        """In-place recovery of one failed task. Sound only when the task's
-        fragment re-derives its input from scratch (a LEAF — no remote
-        sources, whose upstream is a re-scannable connector, and not the
-        root the coordinator is consuming) and no consumer has pulled any
-        of its output yet (their PageBufferClient tokens are still 0 — the
-        rewire endpoint verifies and rejects otherwise)."""
+                      active_nodes: List[NodeInfo],
+                      failure: Optional[retry.ClusterExecutionError] = None
+                      ) -> bool:
+        """In-place recovery of one failed task — leaf OR interior, mid-stream
+        included. The replacement re-derives its output deterministically
+        (leaf fragments re-scan the connector; interior fragments re-pull
+        their inputs from sequence 0 against the producers' spools), and
+        every consumer keeps its chunk cursor across the rewire, skipping
+        frames it already delivered. Unsound cases stay loud: a failure whose
+        cause is a retired replay window (HTTP 410) cannot be healed by
+        re-running the SAME stream, and a rejected rewire aborts the
+        replacement — both escalate to the coordinator's query retry."""
         frag = stage.fragment
         old = stage.tasks[idx]
-        if frag is self.subplan.root_fragment:
+        message = str(failure).lower() if failure is not None else ""
+        if "replay window lost" in message or "cannot replay" in message:
+            # the task died because an UPSTREAM spool retired its window;
+            # a replacement would re-pull the same 410
             return False
-        if _remote_source_ids(frag.root):
-            return False  # mid-stage: upstream buffers freed acked frames
+        if frag is self.subplan.root_fragment \
+                and self._root_consumer is None:
+            return False  # nobody registered to rewire the coordinator's pull
         budget = self.session.get("task_retry_attempts")
         if old.attempt >= int(2 if budget is None else budget):
-            # a task that keeps dying with virgin streams would otherwise be
-            # recovered forever (recovery resets nothing the failure reads);
-            # escalate to the BOUNDED query-level retry instead
+            # a task that keeps dying would otherwise be recovered forever
+            # (recovery resets nothing the failure reads); escalate to the
+            # BOUNDED query-level retry instead
             return False
         candidates = [n for n in active_nodes
                       if n.node_id != old.node.node_id
@@ -397,33 +469,16 @@ class SqlQueryScheduler:
             or [n for n in active_nodes if n.node_id != old.node.node_id]
         if not candidates:
             return False
-        node = candidates[0]
+        node = min(candidates, key=NodeScheduler._bucket)
         attempt = old.attempt + 1
         base_id = f"{self.query_id}.{frag.id}.{old.request.worker_index}"
-        new_task = RemoteTask(f"{base_id}.r{attempt}", node, attempt=attempt)
-        self.task_attempts += 1
-        backoff = self._new_backoff()
-        try:
-            new_task.create(
-                dataclasses.replace(old.request, task_id=new_task.task_id),
-                backoff=backoff)
-        except (retry.ClusterExecutionError, RuntimeError):
+        new_task = self._launch_duplicate(
+            frag, old, f"{base_id}.r{attempt}", node, attempt=attempt)
+        if new_task is None:
             return False
-        finally:
-            self.backoff_s += backoff.total_backoff_s
-        # rewire every live consumer's exchange input to the replacement;
-        # any rejection (already-consumed stream) is an unsound rewire —
-        # abort the replacement and escalate
-        for consumer_stage in self.stages.values():
-            if frag.id not in _remote_source_ids(consumer_stage.fragment.root):
-                continue
-            update = SourceUpdateRequest(
-                fragment_id=frag.id, old_location=old.location,
-                new_location=new_task.location)
-            for consumer in consumer_stage.tasks:
-                if not consumer.update_sources(update):
-                    new_task.cancel(abort=True)
-                    return False
+        if not self._rewire_consumers(frag, old, new_task, active_nodes):
+            new_task.cancel(abort=True)
+            return False
         old.cancel(abort=True)
         stage.tasks[idx] = new_task
         METRICS.count("cluster.task_retries")
@@ -436,6 +491,146 @@ class SqlQueryScheduler:
         self.task_retries += 1
         return True
 
+    def _launch_duplicate(self, frag: Fragment, old: RemoteTask,
+                          task_id: str, node: NodeInfo,
+                          attempt: int) -> Optional[RemoteTask]:
+        """Create a copy of ``old`` under ``task_id`` on ``node``, with its
+        remote-source inputs refreshed to the CURRENT producer locations
+        (an earlier recovery in this same sweep may have moved them)."""
+        input_locations = {
+            fid: [t.location for t in self.stages[fid].tasks]
+            for fid in _remote_source_ids(frag.root)}
+        task = RemoteTask(task_id, node, attempt=attempt)
+        self.task_attempts += 1
+        backoff = self._new_backoff()
+        try:
+            task.create(
+                dataclasses.replace(old.request, task_id=task_id,
+                                    input_locations=input_locations),
+                backoff=backoff)
+        except (retry.ClusterExecutionError, RuntimeError):
+            return None
+        finally:
+            self.backoff_s += backoff.total_backoff_s
+        return task
+
+    def _rewire_consumers(self, frag: Fragment, old: RemoteTask,
+                          new_task: RemoteTask,
+                          active_nodes: List[NodeInfo]) -> bool:
+        """Point every live consumer of ``old`` at ``new_task``, cursors
+        preserved. Consumers that are themselves dead or FAILED are skipped —
+        stages iterate bottom-up, so this same check_failures sweep recovers
+        them AFTER their producers, and _launch_duplicate hands the
+        replacement the already-updated producer locations. The root
+        fragment's single consumer is the coordinator's in-process source,
+        rewired directly."""
+        if frag is self.subplan.root_fragment:
+            return bool(self._root_consumer) and \
+                self._root_consumer.reset_location(old.location,
+                                                   new_task.location)
+        active_ids = {n.node_id for n in active_nodes}
+        for consumer_stage in self.stages.values():
+            if frag.id not in _remote_source_ids(consumer_stage.fragment.root):
+                continue
+            update = SourceUpdateRequest(
+                fragment_id=frag.id, old_location=old.location,
+                new_location=new_task.location)
+            for consumer in consumer_stage.tasks:
+                if consumer.node.node_id not in active_ids or (
+                        consumer.info is not None
+                        and consumer.info.state == FAILED):
+                    continue  # recovered later this sweep, with new locations
+                if not consumer.update_sources(update):
+                    return False
+        return True
+
+    # ---------------------------------------------------------- speculation
+
+    def maybe_speculate(self, active_nodes: List[NodeInfo]) -> None:
+        """Straggler speculation (speculative_execution knob): a RUNNING task
+        whose wall exceeds both a floor and a multiple of its finished
+        siblings' median gets a duplicate on the cleanest other node; the
+        first to FINISH wins and the loser is aborted. Losing original ==
+        winning replay: the spool + cursor machinery rewires consumers
+        exactly as in-place recovery does. Every decision is journaled
+        ``task.speculated``."""
+        if not self.session.get("speculative_execution") \
+                or self.retry_policy != retry.TASK:
+            return
+        self._resolve_speculations(active_nodes)
+        min_wall = float(self.session.get("speculation_min_wall_s") or 5.0)
+        multiplier = float(self.session.get("speculation_multiplier") or 2.0)
+        for stage in self.stages.values():
+            frag = stage.fragment
+            for idx, task in enumerate(stage.tasks):
+                key = (frag.id, idx)
+                base = f"{self.query_id}.{frag.id}.{idx}"
+                if key in self._live_spec or base in self._spec_done:
+                    continue
+                info = task.info
+                if info is None or info.state in DONE_STATES:
+                    continue
+                finished = [t.wall_s() for t in stage.tasks
+                            if t.done_mono is not None
+                            and t.info is not None
+                            and t.info.state == FINISHED]
+                if not finished:
+                    continue  # no sibling baseline: nothing says "straggler"
+                threshold = max(min_wall,
+                                multiplier * statistics.median(finished))
+                if task.wall_s() <= threshold:
+                    continue
+                candidates = [n for n in active_nodes
+                              if n.node_id != task.node.node_id
+                              and n.node_id not in self.excluded_nodes]
+                if not candidates:
+                    continue
+                node = min(candidates, key=NodeScheduler._bucket)
+                spec = self._launch_duplicate(
+                    frag, task, f"{base}.s1", node,
+                    attempt=task.attempt + 1)
+                self._spec_done.add(base)
+                if spec is None:
+                    continue
+                self._live_spec[key] = (task.task_id, spec)
+                self.task_speculations += 1
+                METRICS.count("cluster.task_speculations")
+
+    def _resolve_speculations(self, active_nodes: List[NodeInfo]) -> None:
+        from ..utils import events
+        for key, (orig_id, spec) in list(self._live_spec.items()):
+            frag_id, idx = key
+            stage = self.stages[frag_id]
+            original = stage.tasks[idx]
+            spec_info = spec.poll_info()
+            winner = None
+            if original.task_id != orig_id:
+                # recovery replaced the original underneath us: the spec's
+                # inputs/consumers may be stale — drop it
+                winner = "original"
+            elif spec_info is not None and spec_info.state == FAILED:
+                winner = "original"  # spec failures never fail the query
+            elif original.info is not None \
+                    and original.info.state in DONE_STATES:
+                winner = "original"
+            elif spec_info is not None and spec_info.state == FINISHED:
+                if self._rewire_consumers(stage.fragment, original, spec,
+                                          active_nodes):
+                    stage.tasks[idx] = spec
+                    winner = "speculative"
+                else:
+                    winner = "original"  # unsound rewire: keep waiting it out
+            if winner is None:
+                continue
+            del self._live_spec[key]
+            loser = original if winner == "speculative" else spec
+            loser.cancel(abort=True)
+            events.emit("task.speculated", severity=events.INFO,
+                        query_id=self.query_id, task_id=orig_id,
+                        speculative_task_id=spec.task_id, winner=winner,
+                        original_node=original.node.node_id,
+                        speculative_node=spec.node.node_id)
+
     def is_finished(self) -> bool:
         info = self.root_task().info
         return info is not None and info.state == FINISHED
@@ -443,6 +638,9 @@ class SqlQueryScheduler:
     def abort(self) -> None:
         for task in self.all_tasks():
             task.cancel(abort=True)
+        for _, spec in self._live_spec.values():
+            spec.cancel(abort=True)
+        self._live_spec.clear()
 
 
 def _remote_source_ids(node) -> List[int]:

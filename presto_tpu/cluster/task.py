@@ -269,9 +269,18 @@ class TaskOutputOperator(Operator):
         ncols = len(self._types)
         datas = [np.concatenate(self._acc[b][c]) for c in range(ncols)]
         nulls = [np.concatenate(self._acc[b][ncols + c]) for c in range(ncols)]
-        frame = serialize_columns(
-            datas, [n if n.any() else None for n in nulls], self._acc_rows[b])
-        self.output.enqueue(b, frame)
+        # emit in flush_rows-sized chunks: one frame = one replayable chunk
+        # of the exchange protocol, so chunk granularity tracks the
+        # exchange_flush_rows knob even when a single upstream page carries
+        # the whole partition (an AGG flushing everything at finish)
+        total = self._acc_rows[b]
+        for lo in range(0, total, self.flush_rows):
+            hi = min(lo + self.flush_rows, total)
+            sliced = [n[lo:hi] for n in nulls]
+            frame = serialize_columns(
+                [d[lo:hi] for d in datas],
+                [n if n.any() else None for n in sliced], hi - lo)
+            self.output.enqueue(b, frame)
         self._acc[b] = [[] for _ in range(2 * ncols)]
         self._acc_rows[b] = 0
 
@@ -303,6 +312,12 @@ class TaskOutputFactory(OperatorFactory):
             OperatorContext(self.operator_id, self.name, worker=worker),
             self.types, self.output, self.kind, self.key_idx, self.flush_rows)
         self.operators.append(op)
+        if len(self.operators) > 1:
+            # two sink drivers interleave their flushes nondeterministically:
+            # a re-run would produce a DIFFERENT frame sequence, so cursor
+            # replay against this stream would corrupt data — refuse loudly
+            self.output.mark_nonreplayable(
+                "multiple sink drivers (nondeterministic frame order)")
         return op
 
 
@@ -345,11 +360,21 @@ class SqlTask:
         # a TableScan whose input accounting was still in flight
         self._final_stats: Optional[List[dict]] = None
         kind = self._output_kind()
+        # acked frames retire into a bounded spool so consumers (or their
+        # replacements) can replay from a chunk cursor; spooled bytes are
+        # reserved in the worker's process-shared pool under the QUERY id —
+        # admission and the OOM killer see replay state as real footprint
+        from ..memory import shared_general_pool
+        pool = shared_general_pool(
+            int(request.session.get("memory_pool_bytes")))
         self.output = buffers.OutputBuffer(
             buffers.BROADCAST if kind == BROADCAST else
             (buffers.GATHER if request.output_buffers == 1
              else buffers.PARTITIONED),
-            request.output_buffers)
+            request.output_buffers,
+            spool_max_bytes=int(
+                request.session.get("exchange_spool_bytes") or 0),
+            reserve=lambda delta: pool.reserve(request.query_id, delta))
         self.thread = threading.Thread(
             target=self._run, name=f"task-{self.task_id}", daemon=True)
 
@@ -437,7 +462,11 @@ class SqlTask:
                         task_id=self.task_id, error=type(e).__name__,
                         message=str(e)[:500])
             # abandoned drivers must release their pipelines + memory
-            # reservations (the pool is process-shared across queries now)
+            # reservations (the pool is process-shared across queries now).
+            # Set `cancelled` first: it marks the teardown abnormal, so
+            # exchange sources skip their final acks — a failed consumer
+            # must not release producer buffers its replacement still needs
+            self.cancelled.set()
             for d in self._drivers:
                 try:
                     d.close()
@@ -512,8 +541,10 @@ class SqlTask:
             if frag.output_kind == REPARTITION and frag.output_keys:
                 names = [s.name for s in frag.root.outputs()]
                 key_idx = [names.index(k.name) for k in frag.output_keys]
+            flush = self.request.session.get("exchange_flush_rows")
             self._sink = TaskOutputFactory(
-                999, types, self.output, frag.output_kind or GATHER, key_idx)
+                999, types, self.output, frag.output_kind or GATHER, key_idx,
+                flush_rows=int(flush) if flush else 1 << 14)
             return self._sink
         return make
 

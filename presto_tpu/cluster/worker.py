@@ -31,6 +31,7 @@ from typing import Optional
 
 from ..metadata import CatalogManager, MetadataManager
 from . import codec, faults
+from .buffers import ReplayWindowLost
 from .task import (DONE_STATES, SourceUpdateRequest, TaskUpdateRequest,
                    WorkerTaskManager)
 
@@ -150,6 +151,13 @@ class _WorkerHandler(BaseHTTPRequestHandler):
             try:
                 frame, nxt, complete = task.output.get(
                     buffer_id, int(m.group(3)), wait_s=min(wait, 30.0))
+            except ReplayWindowLost as e:
+                # the requested chunk was retired from the replay spool
+                # (overflow / nondeterministic sink / released buffer):
+                # waiting would never produce it. 410 is a HARD error on the
+                # consumer — mid-stream recovery is unsound here and must
+                # escalate loudly to a query-level retry
+                return self._send(str(e).encode(), 410)
             except Exception as e:
                 # failed/poisoned buffer -> 500: consumers treat 5xx as
                 # transient-within-budget, which is what keeps them alive
@@ -177,14 +185,18 @@ class _WorkerHandler(BaseHTTPRequestHandler):
             active = 0
             query_mem = {}
             live_queries = set()
+            spooled = 0
             for t in self.worker.tasks.tasks.values():
                 if t.state in DONE_STATES:
                     continue
                 active += 1
                 qid = t.request.query_id
                 live_queries.add(qid)
+                # unacked output frames; spooled (acked, replayable) bytes
+                # are already reserved in the shared pool under the query id
                 query_mem[qid] = query_mem.get(qid, 0) + \
                     t.output.retained_bytes()
+                spooled += t.output.spooled_bytes()
             # unified footprint: operator state + scan prefetch reserved in
             # the worker's shared pool (cluster/task._query_memory) — the
             # OOM killer must see the WHOLE per-query byte count, not just
@@ -207,6 +219,9 @@ class _WorkerHandler(BaseHTTPRequestHandler):
                 # per-query reserved bytes — the ClusterMemoryManager's feed
                 # (memory/RemoteNodeMemory.java analogue)
                 "queryMemory": query_mem,
+                # acked-frame replay spool across live tasks (also counted
+                # inside queryMemory via the shared pool)
+                "spooledBytes": spooled,
                 "uptime": round(time.monotonic() - self.worker.start_mono, 1),
             }).encode(), 200, [("Content-Type", "application/json")])
         if path.rstrip("/").startswith("/v1/metrics"):

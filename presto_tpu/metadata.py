@@ -172,6 +172,24 @@ class Session:
         # deterministic fault-injection spec (cluster/faults.py); "" = off
         "fault_injection": "",
         "fault_seed": 0,
+        # per-task bound on the acked-frame replay spool (cluster/buffers.py);
+        # spooled bytes are reserved in the shared pool under the query id.
+        # 0 disables spooling — mid-stream TASK recovery then escalates
+        # loudly to a query-level retry (ReplayWindowLost / HTTP 410)
+        "exchange_spool_bytes": 64 << 20,
+        # rows a sink accumulates per partition before flushing one exchange
+        # frame (= one replayable chunk); None = the 16k built-in. Small
+        # values force many-chunk streams (chaos tests, latency-sensitive
+        # pipelines), large values amortize serialization
+        "exchange_flush_rows": None,
+        # --- straggler speculation (cluster/scheduler.py) ---
+        # launch a duplicate of a straggling task on another node; the first
+        # copy to FINISH wins (its consumers rewire from their chunk
+        # cursors), the loser is aborted and journaled `task.speculated`
+        "speculative_execution": False,
+        "speculation_min_wall_s": 5.0,   # never speculate younger tasks
+        # straggler = running wall > multiplier x median FINISHED sibling wall
+        "speculation_multiplier": 2.0,
     }
 
     def get(self, name: str, default=None):

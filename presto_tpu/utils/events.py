@@ -67,6 +67,15 @@ class EventJournal:
             }
             if fields:
                 evt.update(fields)
+            # correlation: the cluster tier stamps internal per-attempt ids
+            # (cq3_...) while the client knows the protocol id (q1_...) the
+            # server bound via exec.progress.query_scope — record the ambient
+            # id as corr_id so one filter finds BOTH id families
+            if "corr_id" not in evt:
+                from ..exec import progress
+                ambient = progress.current_query_id()
+                if ambient and ambient != evt["query_id"]:
+                    evt["corr_id"] = ambient
             with self._lock:
                 if len(self._events) == self._events.maxlen:
                     self.dropped += 1
@@ -86,7 +95,10 @@ class EventJournal:
     def events(self, query_id: Optional[str] = None, since: int = 0,
                kind: Optional[str] = None, limit: int = 1000) -> List[dict]:
         """Events with seq > `since`, optionally filtered by query id and
-        kind prefix, in seq order (what GET /v1/events serves)."""
+        kind prefix, in seq order (what GET /v1/events serves). The query_id
+        filter matches the event's own query_id OR its corr_id — one query
+        over the journal finds protocol-level AND cluster-internal events of
+        the same logical query."""
         with self._lock:
             snap = list(self._events)
         out: List[dict] = []
@@ -96,7 +108,8 @@ class EventJournal:
         for evt in snap:
             if evt["seq"] <= since:
                 continue
-            if query_id and evt.get("query_id") != query_id:
+            if query_id and evt.get("query_id") != query_id \
+                    and evt.get("corr_id") != query_id:
                 continue
             if kind and not str(evt.get("kind", "")).startswith(kind):
                 continue

@@ -293,7 +293,15 @@ def maybe_recorder(session, query_id: str = "") -> Optional[TraceRecorder]:
     """The query's recorder: a FULL one when the session's `query_trace`
     knob is on, else the always-on coarse black-box ring (disable with
     `query_blackbox=False` — what the bench's overhead rung compares
-    against). None only when both are off."""
+    against). None only when both are off.
+
+    The recorder's query_id defaults to the CANONICAL client-visible id the
+    protocol layer bound via exec.progress.query_scope — so forensic dumps,
+    `query.forensic_dumped` events and trace filenames correlate with the
+    id the client knows, instead of a synthetic trace-N counter."""
+    if not query_id:
+        from ..exec import progress
+        query_id = progress.current_query_id() or ""
     if session.get("query_trace"):
         return TraceRecorder(query_id,
                              int(session.get("query_trace_max_events") or 0))

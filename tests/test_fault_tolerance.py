@@ -154,20 +154,23 @@ def test_announcer_warns_on_persistent_failure(capsys):
     assert err.count("failing") == 1
 
 
-def test_streaming_source_rewire_only_while_virgin():
+def test_streaming_source_rewire_preserves_mid_stream_cursor():
     src = StreamingRemoteSource(
         ["http://127.0.0.1:1/v1/task/a", "http://127.0.0.1:1/v1/task/b"],
         0, [BIGINT], [None], 1024)
     assert src.reset_location("http://127.0.0.1:1/v1/task/a",
                               "http://127.0.0.1:1/v1/task/a2")
     assert src.clients[0].location == "http://127.0.0.1:1/v1/task/a2"
-    # consumed stream: rewire must be rejected (replacement restarts at 0)
+    # consumed stream: rewire is allowed (spooled-chunk replay) and the
+    # consumer cursor survives — the replacement serves from token 3 on
     src.clients[1].token = 3
-    assert not src.reset_location("http://127.0.0.1:1/v1/task/b",
-                                  "http://127.0.0.1:1/v1/task/b2")
-    # unknown location
+    assert src.reset_location("http://127.0.0.1:1/v1/task/b",
+                              "http://127.0.0.1:1/v1/task/b2")
+    assert src.clients[1].location == "http://127.0.0.1:1/v1/task/b2"
+    assert src.clients[1].token == 3
+    # unknown location is still a rejection
     assert not src.reset_location("http://127.0.0.1:1/v1/task/zz",
-                                  "http://127.0.0.1:1/v1/task/b2")
+                                  "http://127.0.0.1:1/v1/task/b3")
 
 
 # ---------------------------------------------------------------------------
@@ -541,3 +544,249 @@ def test_oom_killed_query_dumps_forensic_and_journals_decision():
     assert kill["per_node"], kill
     assert any(victim in qmap for qmap in kill["per_node"].values())
     assert kill["victim_bytes"] > kill["limit_bytes"] >= 1 << 30
+
+
+# ---------------------------------------------------------------------------
+# chaos matrix (spooled-exchange PR): mid-stream replay, speculation,
+# spool overflow escalation, concurrent tenants under env chaos
+# ---------------------------------------------------------------------------
+
+# high-cardinality aggregation: the leaf->interior exchange carries many
+# frames (small pages), so a mid-stream kill lands with chunks delivered
+# AND acked while the root stream is still untouched (AGG emits at the end)
+HICARD_SQL = ("select l_orderkey, count(*), sum(l_quantity) "
+              "from lineitem group by l_orderkey")
+
+
+def _leaf_fragment_id(cluster, sql):
+    from presto_tpu.cluster.scheduler import _remote_source_ids
+    sub = cluster.runner.plan_sql(sql)
+    return next(f.id for f in sub.fragments
+                if not _remote_source_ids(f.root)
+                and f.id != sub.root_fragment.id)
+
+
+def test_task_policy_replays_spooled_chunks_after_mid_stream_kill(
+        local_runner):
+    """Tentpole acceptance: a worker killed AFTER its leaf task delivered
+    (and consumers acked) chunks is recovered in place under TASK policy —
+    every consumer re-issues GETs from its chunk cursor, the replacement's
+    spool absorbs the already-delivered prefix, and the query finishes
+    row-identical on attempt 1 with task.retry (never query.retry)
+    journaled."""
+    from presto_tpu.utils.events import JOURNAL
+
+    cluster = _Cluster(properties={"retry_policy": "TASK",
+                                   "exchange_flush_rows": 512,
+                                   "retry_initial_delay_s": 0.01,
+                                   "retry_max_delay_s": 0.05})
+    leaf = _leaf_fragment_id(cluster, HICARD_SQL)
+    # task <leaf>.0 lands on the node_id-sorted-first worker; kill exactly
+    # when a consumer requests token >= 1 of that task's stream — by then
+    # chunk 0 was served and acked client-side, so recovery MUST replay
+    # mid-stream (the old virgin-stream escape hatch cannot apply)
+    victim = min(cluster.workers, key=lambda w: w.node_id)
+    killed = threading.Event()
+
+    def kill(ctx):
+        token = int(ctx["path"].partition("?")[0]
+                    .rstrip("/").rsplit("/", 1)[-1])
+        if token < 1 or killed.is_set():
+            return
+        killed.set()
+        cluster.kill(victim)
+        raise faults.InjectedDisconnect("worker killed")
+
+    inj = faults.FaultInjector(seed=11)
+    inj.add("worker.results", faults.CALLBACK, node_id=victim.node_id,
+            task_re=rf"\.{leaf}\.0$", times=None, callback=kill)
+    faults.install(inj)
+    seq0 = JOURNAL.last_seq()
+    try:
+        got = cluster.runner.execute(HICARD_SQL)
+    finally:
+        cluster.close()
+    assert killed.is_set(), "mid-stream kill never triggered"
+    want = local_runner.execute(HICARD_SQL)
+    assert_rows_equal(got.rows, want.rows, ordered=False)
+    assert got.stats["query_attempts"] == 1, \
+        "mid-stream kill must recover via chunk replay, not a query retry"
+    assert got.stats["task_retries"] >= 1
+    kinds = {e["kind"] for e in JOURNAL.events(since=seq0)}
+    assert "task.retry" in kinds
+    assert "query.retry" not in kinds
+    assert any(e.get("retry_kind") == "in-place-recovery"
+               for e in JOURNAL.events(since=seq0, kind="task.retry"))
+
+
+def test_speculation_duplicates_straggler_and_journals_winner(local_runner):
+    """A leaf task stalled far beyond the speculation threshold gets a
+    duplicate on the other node; the duplicate wins, consumers are rewired
+    to it, the stalled original is aborted, and the whole decision is
+    journaled as task.speculated."""
+    from presto_tpu.utils.events import JOURNAL
+
+    cluster = _Cluster(properties={"retry_policy": "TASK",
+                                   "speculative_execution": True,
+                                   "speculation_min_wall_s": 0.4,
+                                   "speculation_multiplier": 2.0,
+                                   "retry_initial_delay_s": 0.01,
+                                   "retry_max_delay_s": 0.05})
+    leaf = _leaf_fragment_id(cluster, AGG_SQL)
+    inj = faults.FaultInjector(seed=7)
+    # stall ONE leaf task; its .s1 duplicate (unmatched by the task_re)
+    # runs at full speed and must win the race
+    inj.add("worker.task_run", faults.DELAY, delay_s=5.0, times=1,
+            task_re=rf"\.{leaf}\.0$")
+    faults.install(inj)
+    seq0 = JOURNAL.last_seq()
+    try:
+        got = cluster.runner.execute(AGG_SQL)
+    finally:
+        cluster.close()
+    want = local_runner.execute(AGG_SQL)
+    assert_rows_equal(got.rows, want.rows, ordered=False)
+    assert got.stats["query_attempts"] == 1
+    assert got.stats["task_speculations"] >= 1
+    specs = JOURNAL.events(since=seq0, kind="task.speculated")
+    assert specs, "no task.speculated decision journaled"
+    assert specs[-1]["winner"] == "speculative"
+    assert specs[-1]["speculative_task_id"].endswith(".s1")
+    assert specs[-1]["original_node"] != specs[-1]["speculative_node"]
+
+
+def test_spool_overflow_mid_stream_escalates_to_loud_query_retry(
+        local_runner):
+    """exchange_spool_bytes=0 retires every acked chunk immediately. A
+    consumer that crashes mid-stream (cursor past the retired prefix)
+    cannot be recovered in place: the replacement's GET from token 0
+    answers 410, in-place recovery is declined, and the failure escalates
+    to a LOUD query-level retry — never silent row loss."""
+    from presto_tpu.utils.events import JOURNAL
+
+    cluster = _Cluster(properties={"retry_policy": "TASK",
+                                   "exchange_spool_bytes": 0,
+                                   "exchange_flush_rows": 512,
+                                   "retry_initial_delay_s": 0.01,
+                                   "retry_max_delay_s": 0.05})
+    leaf = _leaf_fragment_id(cluster, HICARD_SQL)
+    tripped = threading.Event()
+
+    def crash_consumer(ctx):
+        # fire once the consumer has committed 2 chunks: its GET for token
+        # 1 acked chunk 0 server-side, and the zero-byte spool retired it
+        if ctx.get("token", 0) >= 2 and not tripped.is_set():
+            tripped.set()
+            raise faults.InjectedFault(
+                "injected fault: consumer crashed mid-stream")
+
+    inj = faults.FaultInjector(seed=13)
+    inj.add("client.results", faults.CALLBACK, times=None,
+            location_re=rf"\.{leaf}\.0$", callback=crash_consumer)
+    faults.install(inj)
+    seq0 = JOURNAL.last_seq()
+    try:
+        got = cluster.runner.execute(HICARD_SQL)
+    finally:
+        cluster.close()
+    assert tripped.is_set(), "mid-stream consumer crash never triggered"
+    want = local_runner.execute(HICARD_SQL)
+    assert_rows_equal(got.rows, want.rows, ordered=False)
+    assert got.stats["query_attempts"] >= 2, \
+        "lost replay window must surface as a loud query retry"
+    retries = JOURNAL.events(since=seq0, kind="query.retry")
+    assert retries, "no query.retry journaled"
+
+
+def test_concurrent_tenants_stay_row_correct_under_env_chaos(local_runner):
+    """The PRESTO_TPU_FAULTS path (what worker CLIs parse at start): a
+    transient 5xx storm plus random result delays under concurrent
+    tenants — every query must come back row-correct with the noise
+    absorbed below the query level."""
+    spec = ("worker.results:http_error:code=503,after=2,times=6;"
+            "worker.results:delay:delay_s=0.02,probability=0.25,times=40")
+    inj = faults.install_from_env({"PRESTO_TPU_FAULTS": spec,
+                                   "PRESTO_TPU_FAULT_SEED": "17"})
+    assert inj is not None and faults.active() is inj
+    cluster = _Cluster(properties={"retry_policy": "TASK",
+                                   "retry_initial_delay_s": 0.01,
+                                   "retry_max_delay_s": 0.05})
+    queries = [AGG_SQL,
+               "select count(*) from lineitem",
+               ("select l_returnflag, max(l_extendedprice) from lineitem "
+                "group by l_returnflag")]
+    results = {}
+    errors = []
+
+    def tenant(i, sql):
+        try:
+            results[i] = cluster.runner.execute(sql).rows
+        except BaseException as e:  # noqa: BLE001 - re-raised via assert
+            errors.append((sql, e))
+
+    threads = [threading.Thread(target=tenant, args=(i, sql))
+               for i, sql in enumerate(queries)]
+    try:
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120.0)
+        assert not any(t.is_alive() for t in threads), "tenant wedged"
+    finally:
+        cluster.close()
+    assert not errors, f"tenant failed under chaos: {errors[0]}"
+    assert inj.total_fired >= 1, "env chaos spec never fired"
+    for i, sql in enumerate(queries):
+        assert_rows_equal(results[i], local_runner.execute(sql).rows,
+                          ordered=False)
+
+
+# ---------------------------------------------------------------------------
+# satellites: query-id correlation through the journal, chaos-spec validation
+# ---------------------------------------------------------------------------
+
+def test_journal_correlates_protocol_and_internal_query_ids(local_runner):
+    """One journal query filtered by the PROTOCOL query id finds the
+    cluster-internal events journaled under cq* ids (the ambient progress
+    scope stamps corr_id at emit time), and query-level events journaled
+    with no query_id at all join the same way."""
+    from presto_tpu.exec import progress
+    from presto_tpu.utils.events import JOURNAL
+
+    cluster = _Cluster(properties={"retry_policy": "QUERY",
+                                   "retry_initial_delay_s": 0.02,
+                                   "retry_max_delay_s": 0.1})
+    victim = cluster.workers[0]
+    _kill_rule(cluster, victim)
+    seq0 = JOURNAL.last_seq()
+    try:
+        with progress.query_scope("proto-q-42"):
+            got = cluster.runner.execute(AGG_SQL)
+    finally:
+        cluster.close()
+    assert_rows_equal(got.rows, local_runner.execute(AGG_SQL).rows,
+                      ordered=False)
+    evts = JOURNAL.events(query_id="proto-q-42", since=seq0)
+    kinds = {e["kind"] for e in evts}
+    assert "query.retry" in kinds            # journaled with NO query_id
+    assert "query.attempt_failed" in kinds   # journaled with the cq* id
+    internal = [e for e in evts if e["query_id"].startswith("cq")]
+    assert internal, "internal-id events not correlated to the protocol id"
+    assert all(e.get("corr_id") == "proto-q-42" for e in internal)
+
+
+def test_fault_spec_rejects_unknown_point_and_kind():
+    """A typo'd chaos spec must fail loudly at install time, naming the
+    valid vocabulary — not sit silently inert through a chaos run."""
+    with pytest.raises(ValueError, match="unknown fault point"):
+        faults.FaultInjector.from_spec("worker.resutls:disconnect")
+    # the error names the real fire points
+    with pytest.raises(ValueError, match="worker.results"):
+        faults.FaultInjector.from_spec("worker.resutls:disconnect")
+    with pytest.raises(ValueError, match="unknown fault kind"):
+        faults.FaultInjector.from_spec("worker.results:explode")
+    with pytest.raises(ValueError, match="delay"):
+        faults.FaultInjector.from_spec("worker.results:explode")
+    # glob patterns stay legal as long as they match a real point
+    inj = faults.FaultInjector.from_spec("client.*:disconnect:times=1")
+    assert len(inj.rules) == 1

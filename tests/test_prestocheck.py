@@ -328,6 +328,65 @@ def test_retry_discipline_flags_adhoc_loop_not_backoff(tmp_path):
     assert {f.line for f in findings} == {6, 13}
 
 
+_BOUNDARY_SOURCE = """
+    import urllib.request
+
+    def one_shot(url):
+        return urllib.request.urlopen(url, timeout=5.0).read()
+
+    def classified(url):
+        try:
+            return urllib.request.urlopen(url, timeout=5.0).read()
+        except OSError:
+            return None
+
+    def disciplined(url, backoff):
+        while True:
+            try:
+                return urllib.request.urlopen(url).read()
+            except OSError:
+                if backoff.failure():
+                    raise
+                backoff.wait()
+"""
+
+
+def test_retry_discipline_flags_raw_urlopen_on_cluster_boundary(tmp_path):
+    # the boundary check applies to files under presto_tpu/cluster/: a raw
+    # urlopen with no try and no backoff is a one-shot RPC whose transport
+    # failure propagates unclassified
+    mod = tmp_path / "presto_tpu" / "cluster" / "boundary.py"
+    mod.parent.mkdir(parents=True)
+    mod.write_text(textwrap.dedent(_BOUNDARY_SOURCE))
+    findings = run([str(mod)], select=["retry-discipline"],
+                   baseline_path=None).new_findings
+    assert len(findings) == 1, _messages(findings)
+    assert findings[0].line == 5
+    assert "raw urlopen" in findings[0].message
+
+
+def test_retry_discipline_boundary_scope_and_suppression(tmp_path):
+    # the same module OUTSIDE presto_tpu/cluster/ is not on the
+    # coordinator<->worker boundary: no findings
+    outside = tmp_path / "elsewhere" / "boundary.py"
+    outside.parent.mkdir(parents=True)
+    outside.write_text(textwrap.dedent(_BOUNDARY_SOURCE))
+    assert run([str(outside)], select=["retry-discipline"],
+               baseline_path=None).new_findings == []
+    # an inline justification suppresses the boundary finding
+    mod = tmp_path / "presto_tpu" / "cluster" / "probe.py"
+    mod.parent.mkdir(parents=True)
+    mod.write_text(textwrap.dedent("""
+        import urllib.request
+
+        def probe(url):
+            # raise-through by design: the caller classifies
+            return urllib.request.urlopen(url, timeout=2.0).read()  # prestocheck: ignore[retry-discipline]
+        """))
+    assert run([str(mod)], select=["retry-discipline"],
+               baseline_path=None).new_findings == []
+
+
 # ----------------------------------------------------------------- sleep-poll
 
 def test_sleep_poll_flags_fixed_interval_polling_loop(tmp_path):

@@ -12,10 +12,22 @@ Detection: a ``while`` or ``for`` loop whose body contains BOTH a
 (``urlopen`` / ``requests.*`` / ``socket.*`` / ``.recv``), with no reference
 to a backoff object anywhere in the loop. Loops already driven by a Backoff
 (``self._backoff.wait()``) are exempt by that last clause.
+
+Second check (coordinator<->worker boundary, files under
+``presto_tpu/cluster/``): a raw ``urlopen`` call in a function with NO
+backoff reference and NOT inside any ``try`` is a one-shot RPC whose
+transport failure propagates raw — neither retried under a Backoff budget
+nor classified at the call site. Every boundary RPC must either ride a
+Backoff loop (RemoteTask.create, PageBufferClient.poll) or wrap the call in
+try/except and map the failure to its protocol meaning (update_sources ->
+rejection, cancel -> best-effort). A deliberate raise-through helper earns
+an inline ``# prestocheck: ignore[retry-discipline]`` with its
+justification, not an unexamined exemption.
 """
 from __future__ import annotations
 
 import ast
+import os
 
 from ..core import (Finding, Module, Pass, dotted_name, register,
                     walk_no_nested_functions)
@@ -61,3 +73,54 @@ class RetryDisciplinePass(Pass):
                     f"ad-hoc retry loop ({kind} + time.sleep + try/except "
                     "around I/O) — use cluster/retry.Backoff (jitter, "
                     "budget, stats)")
+        yield from self._check_boundary_calls(module)
+
+    # ------------------------------------------------ boundary one-shot RPCs
+
+    def _check_boundary_calls(self, module: Module):
+        path = os.path.abspath(module.path).replace(os.sep, "/")
+        if "/presto_tpu/cluster/" not in path:
+            return
+        for node in ast.walk(module.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            has_backoff = False
+            for sub in walk_no_nested_functions(node):
+                if isinstance(sub, ast.Name) and "backoff" in sub.id.lower():
+                    has_backoff = True
+                if isinstance(sub, ast.Attribute) and \
+                        "backoff" in sub.attr.lower():
+                    has_backoff = True
+            if has_backoff:
+                continue
+            for call in _unprotected_urlopens(node):
+                yield Finding(
+                    module.path, call.lineno, call.col_offset, self.id,
+                    "raw urlopen on the coordinator<->worker boundary "
+                    "with no Backoff and no try/except — retry under "
+                    "cluster/retry.Backoff or classify the transport "
+                    "failure at the call site")
+
+
+def _unprotected_urlopens(fn: ast.AST):
+    """urlopen calls in `fn` that are not a descendant of any ``try`` (body,
+    handlers or finally — a finally-placed call is rare enough that the
+    coarse containment test beats the complexity of excluding it), skipping
+    nested function definitions (checked as their own functions)."""
+    out = []
+
+    def visit(node: ast.AST, protected: bool) -> None:
+        if isinstance(node, ast.Call):
+            callee = dotted_name(node.func) or ""
+            term = node.func.attr \
+                if isinstance(node.func, ast.Attribute) else callee
+            if term == "urlopen" and not protected:
+                out.append(node)
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            visit(child, protected or isinstance(node, ast.Try))
+
+    for child in ast.iter_child_nodes(fn):
+        visit(child, False)
+    return out
