@@ -27,6 +27,8 @@ Fire points:
   worker.task_create / worker.task_info / worker.results / worker.status
   worker.task_run   (inside SqlTask._run — fails the task itself)
   client.task_create / client.task_poll / client.results / client.announce
+  spill.write / spill.read   (exec/spill.py disk-run I/O — fails the owning
+                              query only; shared pools and tenants survive)
 """
 from __future__ import annotations
 
@@ -58,6 +60,7 @@ FIRE_POINTS = (
     "worker.status", "worker.task_run",
     "client.task_create", "client.task_poll", "client.results",
     "client.announce",
+    "spill.write", "spill.read",
 )
 
 

@@ -7,6 +7,15 @@ bytes stay over the limit for `grace_polls` consecutive polls, the query
 with the LARGEST total reservation across workers is killed — freeing the
 most memory with one victim, exactly the reference policy's choice.
 
+Killing is the LAST rung of the memory ladder, not the first response:
+when workers report revocable bytes (their operators can still move state
+device->host->disk), the manager first journals ``memory.revoke`` and
+requests a revoke round, then waits exactly one more poll — the bounded
+beat that lets spilling land — and only if the cluster is STILL over the
+limit does it select a victim. ``query.oom_killed`` then records whether
+revocation was attempted and how many revocable bytes remained, so a
+post-mortem can tell "nothing left to spill" from "killed too eagerly".
+
 Workers report {query_id: bytes} via /v1/status (see worker.py); the kill
 action is injected so the coordinator wires its own task cancellation and
 tests wire a recorder.
@@ -24,21 +33,29 @@ class ClusterMemoryManager:
                  limit_bytes: int = 32 << 30,
                  poll_period_s: float = 1.0,
                  grace_polls: int = 2,
-                 fetch_status: Optional[Callable[[str], Dict]] = None):
+                 fetch_status: Optional[Callable[[str], Dict]] = None,
+                 request_revoke: Optional[Callable[[], None]] = None):
         """`nodes` provides active_nodes() (DiscoveryNodeManager); a custom
-        `fetch_status(uri)` replaces the HTTP GET in tests."""
+        `fetch_status(uri)` replaces the HTTP GET in tests.
+        `request_revoke` (best-effort, optional) nudges workers to run a
+        revoke round NOW instead of waiting for their own pressure checks;
+        the revoke-before-kill beat happens regardless — operators revoke
+        on their next add_input under pressure either way."""
         self.nodes = nodes
         self.kill_query = kill_query
         self.limit_bytes = limit_bytes
         self.poll_period_s = poll_period_s
         self.grace_polls = grace_polls
         self._fetch = fetch_status or self._http_status
+        self.request_revoke = request_revoke
         self._over_count = 0
+        self._revoke_requested = False
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._loop,
                                         name="cluster-memory", daemon=True)
         self.last_total = 0
         self.last_by_query: Dict[str, int] = {}
+        self.last_revocable: Dict[str, int] = {}
         self.killed: List[str] = []
 
     # ------------------------------------------------------------------ api
@@ -52,7 +69,9 @@ class ClusterMemoryManager:
 
     def poll_once(self) -> Optional[str]:
         """One poll + policy step; returns the killed query id, if any."""
+        from ..utils import events
         by_query: Dict[str, int] = {}
+        revocable: Dict[str, int] = {}
         per_node: Dict[str, Dict[str, int]] = {}
         total = 0
         for node in self.nodes.active_nodes():
@@ -70,25 +89,47 @@ class ClusterMemoryManager:
             for qid, b in node_mem.items():
                 by_query[qid] = by_query.get(qid, 0) + b
                 total += b
+            for qid, b in (status.get("queryRevocable") or {}).items():
+                revocable[qid] = revocable.get(qid, 0) + int(b)
         self.last_total = total
         self.last_by_query = by_query
+        self.last_revocable = revocable
         if total <= self.limit_bytes or not by_query:
             self._over_count = 0
+            self._revoke_requested = False
             return None
         self._over_count += 1
         if self._over_count < self.grace_polls:
             return None  # transient spike: give revocation/spill a chance
+        revocable_total = sum(revocable.values())
+        if revocable_total > 0 and not self._revoke_requested:
+            # kill is the LAST rung: the workers still hold revocable state,
+            # so request a revoke round (device->host->disk) and wait
+            # exactly one more poll for the spill to land before deciding
+            self._revoke_requested = True
+            events.emit("memory.revoke", severity=events.WARN,
+                        requested_bytes=revocable_total, total_bytes=total,
+                        limit_bytes=self.limit_bytes, per_node=per_node)
+            if self.request_revoke is not None:
+                try:
+                    self.request_revoke()
+                except Exception:  # noqa: BLE001 - best-effort nudge only
+                    pass
+            return None
         victim = max(by_query.items(), key=lambda kv: kv[1])[0]
+        revoke_attempted = self._revoke_requested
         self._over_count = 0
+        self._revoke_requested = False
         self.killed.append(victim)
         # journal the DECISION with the evidence that justified it: the
         # per-worker per-query byte snapshot at kill time is exactly what a
         # post-mortem needs and is gone one poll later
-        from ..utils import events
         events.emit("query.oom_killed", severity=events.ERROR,
                     query_id=victim,
                     victim_bytes=by_query[victim], total_bytes=total,
-                    limit_bytes=self.limit_bytes, per_node=per_node)
+                    limit_bytes=self.limit_bytes, per_node=per_node,
+                    revoke_attempted=revoke_attempted,
+                    revocable_bytes=revocable_total)
         try:
             self.kill_query(victim)
         except Exception:  # noqa: BLE001 - kill is best-effort; retried next poll

@@ -353,6 +353,10 @@ class SqlTask:
         # kept after planning so info() can report per-operator stats
         # (reads of the plain-int stat fields race benignly mid-run)
         self._drivers: List[object] = []
+        # this task's disk-spill manager (exec/spill.py), created lazily by
+        # _query_memory when the session enables the disk tier; closed in
+        # _run's finally so spill files never outlive the task
+        self._spill = None
         # final-state stats snapshot, frozen BEFORE the terminal transition:
         # any TaskInfo that reports a DONE state carries COMPLETE operator
         # stats — a roll-up (distributed EXPLAIN ANALYZE) that polled the
@@ -386,7 +390,12 @@ class SqlTask:
         """This task's memory root in the worker's process-shared pool,
         keyed by QUERY id — every task of one query aggregates into one
         reservation the OOM killer can weigh (runner._query_memory's shape,
-        worker-side)."""
+        worker-side). The task's disk tier rides along as `memory.spill`:
+        PER TASK (concurrent tasks of one query spill into distinct
+        directories), but charged to the pool's spill ledger under the
+        QUERY id; `_run`'s ``finally`` closes it, releasing exactly this
+        task's files and bytes."""
+        from ..exec.spill import SpillManager
         from ..memory import QueryContextMemory, shared_general_pool
 
         req = self.request
@@ -396,6 +405,13 @@ class SqlTask:
             req.query_id, pool,
             int(req.session.get("query_max_memory_bytes")))
         target = float(req.session.get("revoke_target_fraction"))
+        if self._spill is None and bool(req.session.get("spill_to_disk")):
+            self._spill = SpillManager(
+                req.query_id, pool,
+                spill_dir=str(req.session.get("spill_dir") or ""),
+                max_bytes=int(req.session.get("spill_max_bytes") or 0),
+                tag=str(self.task_id))
+        qmem.memory.spill = self._spill
 
         def over_target() -> bool:
             # pool-wide pressure, or this query alone over its session's
@@ -473,6 +489,12 @@ class SqlTask:
                 except Exception:  # noqa: BLE001 - teardown best effort
                     pass
             self.output.fail(str(e))
+        finally:
+            # spill files must not outlive the task no matter how it ended
+            # (close is idempotent and releases only THIS task's ledger
+            # bytes — sibling tasks of the query keep theirs)
+            if self._spill is not None:
+                self._spill.close()
 
     def _snapshot_final_stats(self) -> None:
         from ..exec.explain import driver_stats

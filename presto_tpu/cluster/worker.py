@@ -203,9 +203,16 @@ class _WorkerHandler(BaseHTTPRequestHandler):
             # output buffers. Done queries' residue is excluded.
             from ..memory import shared_general_pool
             pool = shared_general_pool()
-            for qid, b in pool.by_query().items():
+            by_query = pool.by_query()
+            revocable = pool.revocable_by_query()
+            spill = pool.spill_by_query()
+            # GC walks the UNION of the pool's ledgers: a dead query may
+            # leave residue in only the spill (or revocable) ledger
+            for qid in set(by_query) | set(revocable) | set(spill):
                 if qid in live_queries:
-                    query_mem[qid] = query_mem.get(qid, 0) + int(b)
+                    if qid in by_query:
+                        query_mem[qid] = query_mem.get(qid, 0) \
+                            + int(by_query[qid])
                 else:
                     # no live task of this query remains on the worker: any
                     # leftover reservation is a failed-teardown leak — clear
@@ -219,6 +226,16 @@ class _WorkerHandler(BaseHTTPRequestHandler):
                 # per-query reserved bytes — the ClusterMemoryManager's feed
                 # (memory/RemoteNodeMemory.java analogue)
                 "queryMemory": query_mem,
+                # the revocable slice of queryMemory: what a revoke round
+                # could move down the ladder (device->host->disk) instead of
+                # killing — the manager's revoke-before-kill evidence
+                "queryRevocable": {q: int(b) for q, b in revocable.items()
+                                   if q in live_queries},
+                # per-query on-disk spill bytes (exec/spill.py runs): the
+                # disk rung, charged outside queryMemory so spilling
+                # relieves reported pressure but stays observable
+                "querySpill": {q: int(b) for q, b in spill.items()
+                               if q in live_queries},
                 # acked-frame replay spool across live tasks (also counted
                 # inside queryMemory via the shared pool)
                 "spooledBytes": spooled,

@@ -376,12 +376,18 @@ class LocalExecutionPlanner:
 
     # ------------------------------------------------------------------ api
 
-    def attach_memory(self, memory, revoke_check=None) -> None:
-        """Wire a query-level MemoryTrackingContext (+ pressure probe) into
-        every planned factory — operators then account bytes into the query's
-        pool and self-revoke under pressure."""
+    def attach_memory(self, memory, revoke_check=None, spill=None) -> None:
+        """Wire a query-level MemoryTrackingContext (+ pressure probe, + the
+        query's disk-tier SpillManager) into every planned factory —
+        operators then account bytes into the query's pool and self-revoke
+        under pressure, escalating host state to disk when `spill` is set.
+        The runner hangs the manager off the memory context (`memory.spill`)
+        so existing call sites that splat (memory, revoke_check) pick up the
+        disk tier without a signature change."""
         self._memory_ctx = memory
         self._revoke_check = revoke_check
+        self._spill = spill if spill is not None \
+            else getattr(memory, "spill", None)
 
     def plan(self, root: OutputNode, sink_factory=None) -> LocalExecutionPlan:
         """`sink_factory`: optional callable (types, dicts) -> OperatorFactory
@@ -407,10 +413,12 @@ class LocalExecutionPlanner:
         mem = getattr(self, "_memory_ctx", None)
         if mem is not None:
             check = getattr(self, "_revoke_check", None)
+            spill = getattr(self, "_spill", None)
             for pipeline in self.pipelines:
                 for fac in pipeline:
                     fac.memory_ctx = mem
                     fac.revoke_check = check
+                    fac.spill_manager = spill
         for pipeline in self.pipelines:
             for fac in pipeline:
                 if isinstance(fac, TableScanOperatorFactory):

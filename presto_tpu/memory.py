@@ -8,8 +8,11 @@ reference, accounting here is *advisory metadata driving scheduling decisions*
 (admission, spill-to-host triggers, OOM-kill policies), not an allocator. The shape is
 kept: operator-local contexts aggregate into task/query contexts which draw from a
 per-chip pool (GENERAL/RESERVED), and a revocation scheduler asks operators to release
-revocable bytes (execution/MemoryRevokingScheduler.java:46) by spilling device state to
-host RAM (the disk-spill analogue).
+revocable bytes (execution/MemoryRevokingScheduler.java:46) by walking the spill ladder
+device HBM -> host RAM -> disk (exec/spill.py writes PCOL runs, the
+FileSingleStreamSpiller analogue). Disk bytes are tracked in a separate pool ledger
+(`reserve_spill`/`spill_by_query`) so the true footprint stays visible while spilling
+still *relieves* memory pressure rather than re-creating it on another axis.
 """
 from __future__ import annotations
 
@@ -106,6 +109,13 @@ class MemoryPool:
         self.max_bytes = max_bytes
         self._reserved: Dict[str, int] = {}  # query_id -> bytes
         self._revocable: Dict[str, int] = {}
+        # disk-spill ledger: bytes a query holds in on-disk runs
+        # (exec/spill.py). Deliberately EXCLUDED from reserved_bytes()/
+        # free_bytes()/query_bytes(): spilling to disk must relieve memory
+        # pressure, not keep the revoker and OOM killer latched on bytes
+        # that no longer occupy RAM — but the footprint stays visible to
+        # status/admission via spill_by_query().
+        self._spill: Dict[str, int] = {}
         self._lock = threading.Lock()
 
     def reserve(self, query_id: str, delta: int, revocable: bool = False) -> None:
@@ -115,6 +125,13 @@ class MemoryPool:
             if d[query_id] <= 0:
                 d.pop(query_id)
 
+    def reserve_spill(self, query_id: str, delta: int) -> None:
+        """Charge (or, with a negative delta, release) disk-spill bytes."""
+        with self._lock:
+            self._spill[query_id] = self._spill.get(query_id, 0) + delta
+            if self._spill[query_id] <= 0:
+                self._spill.pop(query_id)
+
     def clear_query(self, query_id: str) -> None:
         """Drop every reservation of one query — the end-of-query backstop
         for the SHARED pool: an operator path that failed to release (error
@@ -123,6 +140,7 @@ class MemoryPool:
         with self._lock:
             self._reserved.pop(query_id, None)
             self._revocable.pop(query_id, None)
+            self._spill.pop(query_id, None)
 
     def by_query(self) -> Dict[str, int]:
         """{query_id: total bytes} — what /v1/status ships to the cluster
@@ -133,11 +151,28 @@ class MemoryPool:
                 totals[q] = totals.get(q, 0) + b
             return totals
 
+    def revocable_by_query(self) -> Dict[str, int]:
+        """{query_id: revocable bytes} — what /v1/status ships so the
+        cluster OOM killer can tell a spillable query from a doomed one."""
+        with self._lock:
+            return dict(self._revocable)
+
+    def spill_by_query(self) -> Dict[str, int]:
+        """{query_id: on-disk spill bytes} — the disk rung of the ladder."""
+        with self._lock:
+            return dict(self._spill)
+
     def reserved_bytes(self) -> int:
         return sum(self._reserved.values()) + sum(self._revocable.values())
 
     def revocable_bytes(self) -> int:
         return sum(self._revocable.values())
+
+    def spilled_bytes(self) -> int:
+        return sum(self._spill.values())
+
+    def spill_bytes(self, query_id: str) -> int:
+        return self._spill.get(query_id, 0)
 
     def free_bytes(self) -> int:
         return self.max_bytes - self.reserved_bytes()
@@ -216,7 +251,13 @@ class QueryContextMemory:
 
 class MemoryRevoker:
     """Asks operators to spill when the pool is over target
-    (execution/MemoryRevokingScheduler.java:46,168-205)."""
+    (execution/MemoryRevokingScheduler.java:46,168-205).
+
+    Each registered operator's `start_memory_revoke` walks the full ladder
+    itself: device HBM -> host RAM, then host RAM -> disk when a
+    SpillManager is attached (exec/spill.py) — so one revoke round here
+    escalates as far down the hierarchy as the operator can go, and the
+    cluster OOM killer only fires after this has been given a beat."""
 
     def __init__(self, pool: MemoryPool, target_fraction: float = 0.9):
         self.pool = pool

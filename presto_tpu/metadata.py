@@ -41,11 +41,19 @@ class Session:
         "broadcast_join_threshold_rows": 1 << 15,
         "join_reordering_strategy": "AUTOMATIC",  # NONE | AUTOMATIC
         "max_groups": 1 << 20,
-        # memory/spill (advisory accounting over XLA's allocator; "spill" moves
-        # device state to host RAM — the TPU's disk analogue)
+        # memory/spill (advisory accounting over XLA's allocator). Under
+        # pressure, revocation walks the full ladder: device HBM -> host RAM
+        # -> disk (exec/spill.py writes PCOL runs; the reference's
+        # FileSingleStreamSpiller). OOM kill is the LAST rung, after the
+        # ladder has been attempted.
         "memory_pool_bytes": 8 << 30,
         "query_max_memory_bytes": 4 << 30,
         "revoke_target_fraction": 0.9,
+        # disk tier: on by default; spill_dir "" = <tempdir>/presto-tpu-spill;
+        # spill_max_bytes 0 = unlimited on-disk bytes per query
+        "spill_to_disk": True,
+        "spill_dir": "",
+        "spill_max_bytes": 0,
         # grouped (lifespan) execution over co-bucketed tables: run the plan
         # once per bucket so join/agg state is bounded by one bucket's data
         # (execution/Lifespan.java + StageExecutionDescriptor analogue)

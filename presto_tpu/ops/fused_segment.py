@@ -113,6 +113,7 @@ class FusedSegmentOperatorFactory(OperatorFactory):
             # the segment's only revocable state
             tf.memory_ctx = self.memory_ctx
             tf.revoke_check = self.revoke_check
+            tf.spill_manager = self.spill_manager
         return FusedSegmentOperator(self.context(worker), self, worker)
 
     def note_pages(self, n: int) -> None:

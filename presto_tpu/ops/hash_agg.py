@@ -300,6 +300,34 @@ def sort_group_reduce(keys: Tuple[jnp.ndarray, ...], mask: jnp.ndarray,
 # builders
 # ---------------------------------------------------------------------------
 
+# disk-spill partitioning: target bytes per partition (the merge-on-read
+# working set) — the partition count adapts so each partition's merge fits
+# comfortably in host RAM
+_DISK_PARTITION_TARGET_BYTES = 64 << 20
+_DISK_MAX_PARTITIONS = 256
+
+
+def _key_row_hash(keys) -> np.ndarray:
+    """Deterministic per-row uint64 hash over the interleaved key columns —
+    the disk-spill partitioner. VALUE-cast (not bit-cast) to int64 so float
+    +0.0/-0.0 (equal keys) hash equal; NULL lanes are already canonical
+    (zeroed value + flag, _null_safe_keys). Must agree between write time
+    and merge-on-read: it only sees numpy values, which round-trip pcol
+    bit-exactly."""
+    n = len(keys[0])
+    h = np.full(n, 0x9E3779B97F4A7C15, dtype=np.uint64)
+    for k in keys:
+        with np.errstate(invalid="ignore"):
+            v = np.asarray(k).astype(np.int64, copy=False).view(np.uint64)
+        h = h ^ v
+        # splitmix64 finalizer (wraps mod 2^64; numpy uint64 arrays wrap
+        # silently)
+        h = (h ^ (h >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
+        h = (h ^ (h >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
+        h = h ^ (h >> np.uint64(31))
+    return h
+
+
 class GroupedAggregationBuilder:
     """Sort-strategy accumulator (InMemoryHashAggregationBuilder analogue)."""
 
@@ -336,11 +364,22 @@ class GroupedAggregationBuilder:
         # installed lazily (set_channels runs after __init__) via the global
         # kernel cache so equal-config builders across queries share one compile
         self._page_kernel = None
-        # spilled partial tables on HOST RAM (numpy) — the TPU analogue of the
-        # reference's disk spill (SpillableHashAggregationBuilder): device HBM
-        # holds at most max_groups live groups; overflow and revocation move
+        # spilled partial tables on HOST RAM (numpy) — rung 2 of the ladder
+        # (SpillableHashAggregationBuilder analogue): device HBM holds at
+        # most max_groups live groups; overflow and revocation move
         # compacted partials to host, merged exactly at finish()
         self._spilled: List = []    # list of (np keys tuple, np states tuple, np valid)
+        # rung 3, DISK: under sustained pressure the operator calls
+        # spill_to_disk() and the host partials become hash-partitioned,
+        # sorted, partially-reduced PCOL runs (exec/spill.py). The partition
+        # count adapts to the OBSERVED group cardinality as runs accumulate
+        # (the dynamic hybrid-hash-join design: commit to a partition count
+        # at runtime, not up front) — merge-on-read at finish() then works
+        # one partition at a time, so peak host RAM is bounded by the
+        # largest partition, not the whole group table.
+        self._spill_mgr = None      # exec/spill.SpillManager (attach_spill)
+        self._disk_runs: List = []  # SpillRun list, meta={"P","part","nk"}
+        self._disk_parts = 1        # pow2 partition count; grows, never shrinks
         # adaptive compact-table size: starts at the first fold's true group count
         # (rounded up to a power of two) and grows on demand — the rehash analogue
         # of MultiChannelGroupByHash.java:363-409, but table growth here re-runs one
@@ -689,7 +728,8 @@ class GroupedAggregationBuilder:
     def _merge_spilled(self):
         """Exact host-side merge of spilled partials + device table: sort rows
         by key tuple, segment boundaries, per-kind reduceat. Unbounded group
-        counts are fine here — host RAM is the spill medium."""
+        counts are fine here — host RAM is the spill medium. When disk runs
+        exist, the merge goes partition-at-a-time instead (_merge_disk)."""
         parts = list(self._spilled)
         self._spilled = []
         if self._acc is not None:
@@ -697,16 +737,30 @@ class GroupedAggregationBuilder:
                           tuple(np.asarray(s) for s in self._acc[1]),
                           np.asarray(self._acc[2])))
             self._acc = None
+        if self._disk_runs:
+            return self._merge_disk(parts)
+        keys, states = self._host_merge_parts(parts)
+        n = len(keys[0]) if keys else 0
+        if n == 0:
+            z = tuple(jnp.zeros(0, dtype=t.np_dtype) for t in self.key_types)
+            return z, _empty_state(self.widths), jnp.zeros(0, dtype=jnp.bool_)
+        return tuple(keys), tuple(states), np.ones(n, dtype=bool)
+
+    def _host_merge_parts(self, parts):
+        """Merge (keys, states, valid) numpy triples exactly: filter valid,
+        lexsort by key tuple, reduceat per kind -> ([key col...], [state
+        col...]) with ONE row per distinct key, sorted. The shared core of
+        the host-RAM merge and the per-partition disk merge."""
         nk = len(self.key_types)
-        keys = [np.concatenate([p[0][i] for p in parts]) for i in range(nk)]
-        states = [np.concatenate([p[1][i] for p in parts])
+        keys = [np.concatenate([np.asarray(p[0][i]) for p in parts])
+                for i in range(nk)]
+        states = [np.concatenate([np.asarray(p[1][i]) for p in parts])
                   for i in range(len(self.kinds))]
-        valid = np.concatenate([p[2] for p in parts])
+        valid = np.concatenate([np.asarray(p[2]) for p in parts])
         keys = [k[valid] for k in keys]
         states = [s[valid] for s in states]
         if len(keys[0]) == 0:
-            z = tuple(jnp.zeros(0, dtype=t.np_dtype) for t in self.key_types)
-            return z, _empty_state(self.widths), jnp.zeros(0, dtype=jnp.bool_)
+            return keys, states
         order = np.lexsort(tuple(reversed(keys)))
         keys = [k[order] for k in keys]
         states = [s[order] for s in states]
@@ -717,7 +771,7 @@ class GroupedAggregationBuilder:
         starts = np.flatnonzero(boundary)
         # stay on HOST: the merged table can exceed device capacity (that is
         # why it spilled); _build_result pages it out page-capacity at a time
-        out_keys = tuple(k[starts] for k in keys)
+        out_keys = [k[starts] for k in keys]
         out_states = []
         i = 0
         nrows = len(keys[0])
@@ -742,20 +796,170 @@ class GroupedAggregationBuilder:
             red = {SUM: np.add, MIN: np.minimum, MAX: np.maximum}[kind]
             out_states.append(red.reduceat(s, starts))
             i += 1
-        n = len(starts)
-        return out_keys, tuple(out_states), np.ones(n, dtype=bool)
+        return out_keys, out_states
+
+    # --- disk tier (host RAM -> PCOL runs; exec/spill.py) ------------------
+
+    def attach_spill(self, mgr) -> None:
+        """Wire the query's SpillManager (or None) — done once per operator
+        from its OperatorContext."""
+        self._spill_mgr = mgr
+
+    def disk_eligible(self) -> bool:
+        # wide (vector/sketch) states scatter into 2-D tables pcol does not
+        # speak; they stay on the host rung. Dtype eligibility is checked
+        # per flush (spill_to_disk declines, never raises).
+        return self._spill_mgr is not None and self._wide_cap is None
+
+    def host_spill_bytes(self) -> int:
+        """Host-RAM bytes held by spilled partials — the disk-flushable
+        rung the operator reports as revocable when disk is attached."""
+        total = 0
+        for p in self._spilled:
+            for a in p[0]:
+                total += np.asarray(a).nbytes
+            for a in p[1]:
+                total += np.asarray(a).nbytes
+            total += np.asarray(p[2]).nbytes
+        return total
+
+    def _adapt_disk_parts(self, new_rows: int, row_bytes: int) -> None:
+        """Grow the pow2 partition count from OBSERVED cardinality: distinct
+        rows seen so far (disk runs are an upper bound — duplicates across
+        runs merge away) sized so one partition's merge stays near the
+        target working set. Grow-only: a run written at P=4 is still
+        addressable when later runs use P=16 (part = hash & (P-1), so the
+        coarse index is a suffix of the fine one)."""
+        est_rows = new_rows + sum(r.rows for r in self._disk_runs)
+        want = _pow2_count(
+            max(1, (est_rows * max(row_bytes, 1)
+                    + _DISK_PARTITION_TARGET_BYTES - 1)
+                // _DISK_PARTITION_TARGET_BYTES))
+        self._disk_parts = min(max(self._disk_parts, want),
+                               _DISK_MAX_PARTITIONS)
+
+    def spill_to_disk(self) -> int:
+        """Flush the host-RAM partials as hash-partitioned, sorted,
+        partially-reduced PCOL runs; returns bytes written (0 = declined:
+        no manager, wide states, or a dtype pcol cannot store — the state
+        simply stays in host RAM; disk is an optimisation rung, never a
+        correctness requirement)."""
+        mgr = self._spill_mgr
+        if mgr is None or self._wide_cap is not None or not self._spilled:
+            return 0
+        from ..exec.spill import storage_type_for
+        sample = self._spilled[0]
+        probe = [np.asarray(a) for a in sample[0]] + \
+                [np.asarray(a) for a in sample[1]]
+        if any(a.ndim != 1 or storage_type_for(a.dtype) is None
+               for a in probe):
+            return 0
+        parts = self._spilled
+        self._spilled = []
+        keys, states = self._host_merge_parts(parts)
+        n = len(keys[0]) if keys else 0
+        if n == 0:
+            return 0
+        row_bytes = sum(a.dtype.itemsize for a in keys) + \
+            sum(a.dtype.itemsize for a in states)
+        self._adapt_disk_parts(n, row_bytes)
+        P = self._disk_parts
+        part = (_key_row_hash(keys) & np.uint64(P - 1)).astype(np.int64)
+        names = [f"k{i}" for i in range(len(keys))] + \
+                [f"s{i}" for i in range(len(states))]
+        written = 0
+        for p in range(P):
+            sel = part == p
+            if not sel.any():
+                continue
+            # boolean selection preserves order: each partition stays
+            # sorted by key tuple — a sorted, partially-reduced run
+            cols = [a[sel] for a in keys] + [a[sel] for a in states]
+            run = mgr.write_columns(
+                names, cols, kind="agg",
+                meta={"P": P, "part": p, "nk": len(keys)})
+            self._disk_runs.append(run)
+            written += run.nbytes
+        return written
+
+    def _merge_disk(self, resident_parts):
+        """Exact merge-on-read over the disk runs + the in-RAM residual,
+        one finest-granularity partition at a time. Runs written at a
+        coarser P contribute rows to every fine partition that refines
+        theirs — the recomputed hash filter keeps the merge exact across
+        mixed granularities. Peak host RAM is one partition's rows, not
+        the whole group table."""
+        mgr = self._spill_mgr
+        runs = self._disk_runs
+        self._disk_runs = []
+        res_keys, res_states = (self._host_merge_parts(resident_parts)
+                                if resident_parts else ([], []))
+        have_res = bool(res_keys) and len(res_keys[0]) > 0
+        p_max = self._disk_parts
+        res_part = None
+        if have_res:
+            res_part = (_key_row_hash(res_keys)
+                        & np.uint64(p_max - 1)).astype(np.int64)
+        out_keys: List[List[np.ndarray]] = [[] for _ in self.key_types]
+        out_states: List[List[np.ndarray]] = [[] for _ in self.kinds]
+        total = 0
+        for f in range(p_max):
+            chunk_parts = []
+            for run in runs:
+                if run.meta["part"] != (f & (run.meta["P"] - 1)):
+                    continue
+                cols = mgr.read_columns(run)
+                nk = run.meta["nk"]
+                rkeys = [c[0] for c in cols[:nk]]
+                rstates = [c[0] for c in cols[nk:]]
+                if run.meta["P"] < p_max:
+                    sel = (_key_row_hash(rkeys)
+                           & np.uint64(p_max - 1)).astype(np.int64) == f
+                    rkeys = [k[sel] for k in rkeys]
+                    rstates = [s[sel] for s in rstates]
+                if len(rkeys[0]) == 0:
+                    continue
+                chunk_parts.append(
+                    (tuple(rkeys), tuple(rstates),
+                     np.ones(len(rkeys[0]), dtype=bool)))
+            if have_res:
+                sel = res_part == f
+                if sel.any():
+                    chunk_parts.append(
+                        (tuple(k[sel] for k in res_keys),
+                         tuple(s[sel] for s in res_states),
+                         np.ones(int(sel.sum()), dtype=bool)))
+            if not chunk_parts:
+                continue
+            mk, ms = self._host_merge_parts(chunk_parts)
+            if not mk or len(mk[0]) == 0:
+                continue
+            for i, k in enumerate(mk):
+                out_keys[i].append(k)
+            for i, s in enumerate(ms):
+                out_states[i].append(s)
+            total += len(mk[0])
+        for run in runs:
+            mgr.release(run)
+        if total == 0:
+            z = tuple(jnp.zeros(0, dtype=t.np_dtype) for t in self.key_types)
+            return z, _empty_state(self.widths), jnp.zeros(0, dtype=jnp.bool_)
+        return (tuple(np.concatenate(c) for c in out_keys),
+                tuple(np.concatenate(c) for c in out_states),
+                np.ones(total, dtype=bool))
 
     def finish(self):
         """-> (keys, states, valid) on device, compact."""
         if self._pending or self._acc is None:
-            if not self._pending and self._acc is None and not self._spilled:
+            if not self._pending and self._acc is None \
+                    and not self._spilled and not self._disk_runs:
                 # empty input: zero groups
                 z = tuple(jnp.zeros(0, dtype=t.np_dtype) for t in self.key_types)
                 return z, _empty_state(self.widths), \
                     jnp.zeros(0, dtype=jnp.bool_)
             if self._pending:
                 self._fold(final=True)
-        if self._spilled:
+        if self._spilled or self._disk_runs:
             out = self._merge_spilled()
         else:
             out = self._acc
@@ -1029,6 +1233,12 @@ class HashAggregationOperator(Operator):
                  step: str, output_capacity: int):
         super().__init__(context)
         self.builder = builder.set_channels(key_channels)
+        # disk tier: hand the builder the query's SpillManager so revocation
+        # can walk host partials down to PCOL runs (grouped builder only —
+        # global/direct builders have no spillable state)
+        attach = getattr(self.builder, "attach_spill", None)
+        if attach is not None:
+            attach(context.spill)
         self.key_types = key_types
         self.key_dicts = key_dicts
         self.calls = calls
@@ -1050,21 +1260,35 @@ class HashAggregationOperator(Operator):
     def add_input(self, page: Page) -> None:
         self.context.record_input(page, page.capacity)
         self.builder.add_page(page)
-        b = getattr(self.builder, "memory_bytes", None)
-        if b is not None:
-            self.context.update_revocable(b(), self.start_memory_revoke)
+        if getattr(self.builder, "memory_bytes", None) is not None:
+            self.context.update_revocable(self.revocable_bytes(),
+                                          self.start_memory_revoke)
 
-    # spill protocol: the revoker asks; the builder moves its device table to
-    # host RAM (operator/Operator.java:68 startMemoryRevoke analogue)
+    # spill protocol (operator/Operator.java:68 startMemoryRevoke analogue):
+    # the revoker asks; ONE revoke call walks the whole ladder — the builder
+    # moves its device table to host RAM, then (when the query has a disk
+    # tier and the state shape is disk-eligible) flushes the host partials
+    # to PCOL runs. With no disk tier the host partials stay (the pre-disk
+    # behavior) and only device bytes count as revocable.
+    def _disk_capable(self) -> bool:
+        eligible = getattr(self.builder, "disk_eligible", None)
+        return self.context.spill is not None and eligible is not None \
+            and eligible()
+
     def revocable_bytes(self) -> int:
         b = getattr(self.builder, "memory_bytes", None)
-        return b() if b is not None else 0
+        total = b() if b is not None else 0
+        if self._disk_capable():
+            total += self.builder.host_spill_bytes()
+        return total
 
     def start_memory_revoke(self) -> None:
         spill = getattr(self.builder, "spill", None)
         if spill is not None:
             spill()
-            self.context.revocable_memory.set_bytes(0)
+            if self._disk_capable():
+                self.builder.spill_to_disk()
+            self.context.revocable_memory.set_bytes(self.revocable_bytes())
 
     @timed("get_output_ns")
     def get_output(self) -> Optional[Page]:

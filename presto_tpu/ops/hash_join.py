@@ -45,6 +45,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..block import Block, Dictionary, Page
+from ..exec.spill import storage_type_for
 from ..types import BIGINT, Type
 from .operator import Operator, OperatorContext, OperatorFactory, timed
 
@@ -171,6 +172,7 @@ class JoinBuildOperator(Operator):
         self.f = factory
         self._pages: List[Page] = []       # device-resident
         self._host_pages: List[Page] = []  # spilled to host RAM (numpy)
+        self._disk_runs: List = []         # spilled to disk (exec/spill.py runs)
         self._null_key_pages: List[Page] = []  # FULL join: unmatched-by-construction
         self._saw_null_key = None  # device bool accumulator, synced once at build
 
@@ -204,10 +206,14 @@ class JoinBuildOperator(Operator):
         self.context.update_revocable(self.revocable_bytes(),
                                       self.start_memory_revoke)
 
-    # spill protocol: accumulated build pages offload to host RAM; _build's
-    # jnp.concatenate re-uploads them (HashBuilderOperator spill states
-    # :155-180 analogue — here "disk" is host memory). Only device-resident
-    # pages count as revocable — spilled pages are already host RAM.
+    # spill protocol: one revoke walks the whole ladder (HashBuilderOperator
+    # spill states :155-180 analogue). Rung 1 offloads accumulated device
+    # pages to host RAM; rung 2 (when the query has a disk tier attached)
+    # compacts host pages into PCOL runs via exec/spill.py — _build re-admits
+    # disk runs to host and host pages to device before the fused build.
+    # Revocable = device pages + disk-eligible host pages; host pages whose
+    # dtypes have no pcol storage type stay in RAM (disk is an optimisation
+    # rung, never a correctness requirement) and stop counting as revocable.
     def revocable_bytes(self) -> int:
         total = 0
         for p in self._pages + self._null_key_pages:
@@ -219,6 +225,10 @@ class JoinBuildOperator(Operator):
                 total += rows * np.dtype(b.data.dtype).itemsize
                 if b.nulls is not None:
                     total += rows
+        if self.context.spill is not None:
+            for p in self._host_pages:
+                if _page_disk_eligible(p):
+                    total += _host_page_bytes(p)
         return total
 
     def start_memory_revoke(self) -> None:
@@ -227,7 +237,36 @@ class JoinBuildOperator(Operator):
         self._null_key_pages = [p if isinstance(p.mask, np.ndarray)
                                 else jax.device_get(p)
                                 for p in self._null_key_pages]
-        self.context.revocable_memory.set_bytes(0)
+        if self.context.spill is not None:
+            self._spill_host_to_disk()
+        self.context.revocable_memory.set_bytes(self.revocable_bytes())
+
+    def _spill_host_to_disk(self) -> None:
+        """Rung 2: host pages -> compacted on-disk PCOL runs. Dictionary
+        blocks write their code arrays; the Dictionary objects (small,
+        shared) ride along in run.meta so the read side rebuilds bit-exact
+        Blocks. Ineligible pages are kept in host RAM."""
+        mgr = self.context.spill
+        keep: List[Page] = []
+        for p in self._host_pages:
+            if not _page_disk_eligible(p):
+                keep.append(p)
+                continue
+            live = np.flatnonzero(np.asarray(p.mask))
+            if len(live) == 0:
+                continue  # nothing to rebuild — drop the page
+            names, cols, specs = [], [], []
+            for i, b in enumerate(p.blocks):
+                names.append(f"c{i}")
+                cols.append(np.ascontiguousarray(np.asarray(b.data)[live]))
+                if b.nulls is not None:
+                    names.append(f"n{i}")
+                    cols.append(np.ascontiguousarray(
+                        np.asarray(b.nulls)[live]))
+                specs.append((b.type, b.dictionary, b.nulls is not None))
+            self._disk_runs.append(mgr.write_columns(
+                names, cols, kind="join", meta={"blocks": specs}))
+        self._host_pages = keep
 
     def get_output(self) -> Optional[Page]:
         return None
@@ -247,6 +286,12 @@ class JoinBuildOperator(Operator):
 
     def _build(self) -> LookupSource:
         kc = len(self.f.key_channels)
+        if self._disk_runs:  # re-admit disk runs first (disk -> host RAM)
+            runs, self._disk_runs = self._disk_runs, []
+            mgr = self.context.spill
+            for run in runs:
+                self._host_pages.append(_page_from_run(mgr, run))
+                mgr.release(run)
         if self._host_pages:  # re-admit spilled pages (host -> device upload)
             self._pages = self._host_pages + self._pages
             self._host_pages = []
@@ -380,6 +425,43 @@ class JoinBuildOperator(Operator):
 
     def is_finished(self) -> bool:
         return self._finishing
+
+
+def _page_disk_eligible(page: Page) -> bool:
+    """Can this host-resident page round-trip through a pcol spill run?
+    Every block's storage array must be 1-D with a mapped storage type."""
+    for b in page.blocks:
+        a = np.asarray(b.data)
+        if a.ndim != 1 or storage_type_for(a.dtype) is None:
+            return False
+    return True
+
+
+def _host_page_bytes(page: Page) -> int:
+    rows = page.capacity
+    total = rows  # mask
+    for b in page.blocks:
+        total += rows * np.dtype(b.data.dtype).itemsize
+        if b.nulls is not None:
+            total += rows
+    return total
+
+
+def _page_from_run(mgr, run) -> Page:
+    """Rebuild a compacted host page from a spill run written by
+    JoinBuildOperator._spill_host_to_disk (all-true mask; null masks were
+    stored as bool columns, dictionaries rode along in run.meta)."""
+    cols = mgr.read_columns(run)
+    blocks, i = [], 0
+    for (btype, bdict, has_nulls) in run.meta["blocks"]:
+        data = cols[i][0]
+        i += 1
+        nulls = None
+        if has_nulls:
+            nulls = cols[i][0]
+            i += 1
+        blocks.append(Block(btype, data, nulls, bdict))
+    return Page(tuple(blocks), np.ones(run.rows, dtype=bool))
 
 
 def _compact_for_build(page: Page, key_channels: Tuple[int, ...],
@@ -591,12 +673,14 @@ class JoinBuildOperatorFactory(OperatorFactory):
         for o in siblings:
             op._pages.extend(o._pages)
             op._host_pages.extend(o._host_pages)
+            op._disk_runs.extend(o._disk_runs)
             op._null_key_pages.extend(o._null_key_pages)
             if o._saw_null_key is not None:
                 op._saw_null_key = o._saw_null_key \
                     if op._saw_null_key is None \
                     else (op._saw_null_key | o._saw_null_key)
             o._pages, o._host_pages, o._null_key_pages = [], [], []
+            o._disk_runs = []
         self.lookup_factory.set(op._build(), w)
         op._pages = []  # consumed into the lookup source
 

@@ -62,15 +62,21 @@ class OperatorContext:
     def __init__(self, operator_id: int, name: str,
                  memory: Optional[MemoryTrackingContext] = None,
                  worker: int = 0,
-                 revoke_check: Optional[Callable[[], bool]] = None):
+                 revoke_check: Optional[Callable[[], bool]] = None,
+                 spill=None):
         self.worker = worker
         self.stats = OperatorStats(operator_id, name)
         self.memory = memory or MemoryTrackingContext(
             AggregatedMemoryContext(), AggregatedMemoryContext(), AggregatedMemoryContext())
         # memory-pressure probe: operators self-revoke (spill device state to
-        # host) from their own thread when this fires — thread-safe where an
-        # external revoker thread mutating operator state would not be
+        # host, then host to disk when `spill` is attached) from their own
+        # thread when this fires — thread-safe where an external revoker
+        # thread mutating operator state would not be
         self._revoke_check = revoke_check
+        # the query's disk tier (exec/spill.SpillManager) or None: operators
+        # that can persist host-resident state use it as the ladder's last
+        # revocation rung before the OOM killer would fire
+        self.spill = spill
         self.user_memory = self.memory.user.new_local_memory_context(name)
         self.revocable_memory = self.memory.revocable.new_local_memory_context(name)
 
@@ -174,6 +180,7 @@ class OperatorFactory(abc.ABC):
         # wired by the local planner when the query has a memory context:
         self.memory_ctx = None        # MemoryTrackingContext (query-level)
         self.revoke_check = None      # () -> bool: pool over revoke target?
+        self.spill_manager = None     # exec/spill.SpillManager (disk tier)
 
     @abc.abstractmethod
     def create_operator(self, worker: int = 0) -> Operator:
@@ -182,7 +189,8 @@ class OperatorFactory(abc.ABC):
     def context(self, worker: int = 0) -> "OperatorContext":
         mem = self.memory_ctx.fork() if self.memory_ctx is not None else None
         return OperatorContext(self.operator_id, self.name, memory=mem,
-                               worker=worker, revoke_check=self.revoke_check)
+                               worker=worker, revoke_check=self.revoke_check,
+                               spill=self.spill_manager)
 
     def no_more_operators(self) -> None:
         pass

@@ -650,7 +650,14 @@ class LocalQueryRunner:
         and a tenant configuring a small budget must still get pressure
         revocation even while the process pool has room; `release` clears
         this query's reservations at end of query so failed teardowns never
-        leak phantom pressure into later tenants."""
+        leak phantom pressure into later tenants.
+
+        The query's disk tier rides along as `memory.spill` (a
+        SpillManager, or None when `spill_to_disk` is off): attach_memory
+        lifts it into the factories, and `release` closes it — spill files
+        are deleted and their ledger bytes freed in the same ``finally``
+        that clears the RAM reservations."""
+        from .exec.spill import SpillManager
         from .memory import QueryContextMemory, shared_general_pool
 
         session_bytes = int(self.session.get("memory_pool_bytes"))
@@ -659,11 +666,19 @@ class LocalQueryRunner:
         qmem = QueryContextMemory(
             qid, pool, int(self.session.get("query_max_memory_bytes")))
         target = float(self.session.get("revoke_target_fraction"))
+        spill = None
+        if bool(self.session.get("spill_to_disk")):
+            spill = SpillManager(
+                qid, pool, spill_dir=str(self.session.get("spill_dir") or ""),
+                max_bytes=int(self.session.get("spill_max_bytes") or 0))
+        qmem.memory.spill = spill
 
         def over_target() -> bool:
             return (pool.reserved_bytes() > pool.max_bytes * target
                     or pool.query_bytes(qid) > session_bytes * target)
 
         def release() -> None:
+            if spill is not None:
+                spill.close()
             pool.clear_query(qid)
         return qmem.memory, over_target, release

@@ -316,15 +316,26 @@ def maybe_recorder(session, query_id: str = "") -> Optional[TraceRecorder]:
 
 def export(recorder: TraceRecorder, session, suffix: str = "") -> str:
     """Write the Chrome trace JSON under `query_trace_dir` (tempdir default)
-    and return the path (what QueryResult.trace_path carries)."""
+    and return the path (what QueryResult.trace_path carries).
+
+    The filename carries the CLIENT-VISIBLE query id whenever one is known:
+    when the recorder was created before the protocol layer bound its scope,
+    its own id is a synthetic trace-N counter — useless for correlating a
+    forensic dump with a cluster query — so the ambient corr_id from
+    exec.progress is appended alongside it."""
     import tempfile
 
     directory = str(session.get("query_trace_dir") or "") or \
         tempfile.gettempdir()
     os.makedirs(directory, exist_ok=True)
+    from ..exec import progress
+    corr = progress.current_query_id() or ""
+    qid = recorder.query_id
+    if corr and corr != qid:
+        qid = f"{qid}-{corr}"
     path = os.path.join(
         directory,
-        f"presto-trace-{os.getpid()}-{recorder.query_id}{suffix}.json")
+        f"presto-trace-{os.getpid()}-{qid}{suffix}.json")
     return recorder.write(path)
 
 

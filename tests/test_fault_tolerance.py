@@ -790,3 +790,35 @@ def test_fault_spec_rejects_unknown_point_and_kind():
     # glob patterns stay legal as long as they match a real point
     inj = faults.FaultInjector.from_spec("client.*:disconnect:times=1")
     assert len(inj.rules) == 1
+
+
+def test_fault_spec_spill_points_deterministic():
+    """The chaos vocabulary includes the disk-spill I/O points; a typo'd
+    point is rejected with the spill names in the message, and a seeded
+    probability rule fires identically across same-seed injectors."""
+    inj = faults.FaultInjector.from_spec(
+        "spill.write:error:times=1;spill.read:error:times=1", seed=11)
+    assert len(inj.rules) == 2
+    with pytest.raises(faults.InjectedFault):
+        inj.fire("spill.write", query_id="q", location="/tmp/x.pcol")
+    inj.fire("spill.write")                      # times exhausted
+    with pytest.raises(faults.InjectedFault):
+        inj.fire("spill.read")
+    # rejection names the new points in the vocabulary it prints
+    with pytest.raises(ValueError, match="spill.write"):
+        faults.FaultInjector.from_spec("spill.wrote:error")
+
+    def firing_pattern(seed):
+        pat = []
+        p = faults.FaultInjector.from_spec(
+            "spill.write:error:probability=0.5,times=100", seed=seed)
+        for _ in range(40):
+            try:
+                p.fire("spill.write")
+                pat.append(0)
+            except faults.InjectedFault:
+                pat.append(1)
+        return pat
+
+    a, b = firing_pattern(23), firing_pattern(23)
+    assert a == b and 0 < sum(a) < 40  # same seed, same chaos, not all/none

@@ -24,7 +24,7 @@ EXPECTED_PASSES = {"undefined-name", "tracer-safety", "lock-discipline",
                    "mutable-default-args", "sleep-poll", "host-sync",
                    "unbounded-cache", "wallclock-duration",
                    "shared-state-race", "thread-lifecycle",
-                   "print-hygiene"}
+                   "print-hygiene", "tempfile-hygiene"}
 
 
 def _scan(tmp_path, source, select=None, name="mod.py"):
@@ -1316,6 +1316,72 @@ def test_print_hygiene_exempts_cli_tools_and_main(tmp_path):
     findings = run([str(flagged)], select=["print-hygiene"],
                    baseline_path=None).new_findings
     assert len(findings) == 1
+
+
+# -------------------------------------------------------- tempfile-hygiene
+
+def test_tempfile_hygiene_flags_unowned_creation(tmp_path):
+    findings = _scan(tmp_path, """
+        import os
+        import tempfile
+
+        def leak_file():
+            fd, path = tempfile.mkstemp()
+            return path
+
+        def leak_dir():
+            return tempfile.mkdtemp()
+
+        def leak_named():
+            return tempfile.NamedTemporaryFile(delete=False)
+
+        def leak_open():
+            fh = open(os.path.join(tempfile.gettempdir(), "x.tmp"), "wb")
+            fh.write(b"x")
+        """, select=["tempfile-hygiene"])
+    assert len(findings) == 4
+    assert all(f.pass_id == "tempfile-hygiene" for f in findings)
+
+
+def test_tempfile_hygiene_accepts_cleanup_owners(tmp_path):
+    # finally-cleanup (acquire-before-try included), owner class with
+    # close(), with-managed NamedTemporaryFile: all sanctioned shapes
+    findings = _scan(tmp_path, """
+        import os
+        import tempfile
+
+        def finally_guarded():
+            fd, path = tempfile.mkstemp()
+            try:
+                os.write(fd, b"x")
+            finally:
+                os.close(fd)
+                os.remove(path)
+
+        class Owner:
+            def make(self):
+                self.path = tempfile.mkdtemp()
+
+            def close(self):
+                import shutil
+                shutil.rmtree(self.path)
+
+        def managed():
+            with tempfile.NamedTemporaryFile() as f:
+                f.write(b"x")
+        """, select=["tempfile-hygiene"])
+    assert findings == []
+
+
+def test_tempfile_hygiene_suppression(tmp_path):
+    findings = _scan(tmp_path, """
+        import tempfile
+
+        def forensic_dump():
+            fd, path = tempfile.mkstemp()  # prestocheck: ignore[tempfile-hygiene] - user-facing artifact
+            return path
+        """, select=["tempfile-hygiene"])
+    assert findings == []
 
 
 # ------------------------------------------------------------- tier-1 gate

@@ -239,6 +239,102 @@ def test_cluster_memory_manager_kills_biggest_query():
     assert killed == ["q2"]
 
 
+def test_memory_manager_revoke_beat_before_kill():
+    """Kill ordering regression: a revocable-heavy cluster first gets a
+    `memory.revoke` journal + revoke request and ONE more poll for spilling
+    to land; when the spill relieves the pressure, nothing is killed."""
+    from presto_tpu.utils.events import JOURNAL
+
+    state = {"spilled": False}
+
+    def fetch(uri):
+        if not state["spilled"]:
+            return {"queryMemory": {"q1": 100 << 20},
+                    "queryRevocable": {"q1": 90 << 20}}
+        # post-revoke: state moved to the disk ledger, RAM pressure gone
+        return {"queryMemory": {"q1": 10 << 20},
+                "querySpill": {"q1": 90 << 20}}
+
+    killed, revoke_calls = [], []
+    mgr = ClusterMemoryManager(
+        _Nodes(["w1"]), kill_query=killed.append, limit_bytes=50 << 20,
+        grace_polls=2, fetch_status=fetch,
+        request_revoke=lambda: revoke_calls.append(1))
+    assert mgr.poll_once() is None              # over, inside grace
+    seq_before = JOURNAL.last_seq()
+    assert mgr.poll_once() is None              # grace up -> revoke beat
+    assert revoke_calls == [1]
+    revokes = [e for e in JOURNAL.events(since=seq_before,
+                                         kind="memory.revoke")]
+    assert revokes and revokes[-1]["requested_bytes"] == 90 << 20
+    state["spilled"] = True                     # the beat let spilling land
+    assert mgr.poll_once() is None
+    assert killed == [], "revocable-heavy query was killed instead of spilled"
+
+
+def test_memory_manager_kills_after_unhelpful_revoke_with_evidence():
+    """When the revoke beat does NOT relieve pressure, the NEXT poll kills —
+    and the `query.oom_killed` record says revocation was attempted and how
+    many revocable bytes remained (post-mortem: 'killed too eagerly' vs
+    'nothing left to spill')."""
+    from presto_tpu.utils.events import JOURNAL
+
+    def fetch(uri):
+        return {"queryMemory": {"q1": 100 << 20, "q2": 30 << 20},
+                "queryRevocable": {"q1": 40 << 20}}
+
+    killed = []
+    mgr = ClusterMemoryManager(
+        _Nodes(["w1"]), kill_query=killed.append, limit_bytes=50 << 20,
+        grace_polls=2, fetch_status=fetch)
+    assert mgr.poll_once() is None              # grace
+    assert mgr.poll_once() is None              # revoke beat (no killer yet)
+    assert killed == []
+    assert mgr.poll_once() == "q1"              # still over -> largest dies
+    assert killed == ["q1"]
+    kill = JOURNAL.events(kind="query.oom_killed")[-1]
+    assert kill["revoke_attempted"] is True
+    assert kill["revocable_bytes"] == 40 << 20
+
+
+def test_worker_status_ships_spill_ledgers_and_gcs_residue():
+    """/v1/status carries the queryRevocable + querySpill ledgers (the
+    revoke-before-kill evidence and the disk rung), and its GC sweep walks
+    the UNION of the pool's ledgers — spill-only residue of a dead query is
+    cleared on the next poll."""
+    import json as _json
+    import urllib.request as _rq
+
+    from presto_tpu.cluster.worker import WorkerServer
+    from presto_tpu.memory import shared_general_pool
+
+    w = WorkerServer(port=0).start()
+    try:
+        pool = shared_general_pool()
+        pool.reserve_spill("q_dead_spill", 4096)  # no live task owns this
+        with _rq.urlopen(f"{w.uri}/v1/status", timeout=2.0) as resp:
+            st = _json.loads(resp.read())
+        assert "querySpill" in st and "queryRevocable" in st
+        assert "q_dead_spill" not in st["querySpill"]
+        assert pool.spill_bytes("q_dead_spill") == 0, \
+            "spill-only residue survived the status-poll GC"
+    finally:
+        w.stop()
+
+
+def test_memory_manager_legacy_status_kills_at_grace():
+    """Workers that report no queryRevocable (or none left) keep the
+    original policy: kill as soon as grace expires — no wasted beat."""
+    killed = []
+    mgr = ClusterMemoryManager(
+        _Nodes(["w1"]), kill_query=killed.append, limit_bytes=50,
+        grace_polls=2,
+        fetch_status=lambda uri: {"queryMemory": {"q1": 100}})
+    assert mgr.poll_once() is None
+    assert mgr.poll_once() == "q1"
+    assert killed == ["q1"]
+
+
 def test_memory_manager_tolerates_dead_worker():
     def fetch(uri):
         if uri == "dead":

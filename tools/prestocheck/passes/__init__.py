@@ -12,3 +12,4 @@ from . import wallclock_duration  # noqa: F401
 from . import shared_state_race  # noqa: F401
 from . import thread_lifecycle  # noqa: F401
 from . import print_hygiene  # noqa: F401
+from . import tempfile_hygiene  # noqa: F401

@@ -1,0 +1,130 @@
+"""tempfile-hygiene: temp/spill file creation without a cleanup owner.
+
+The disk-spill PR made temp-file lifetime a correctness property: every
+spill run the engine writes must be deleted in the query-release
+``finally`` (exec/spill.SpillManager.close) or it becomes unaccounted
+disk residue that only a process restart's GC sweep reclaims. This pass
+keeps the discipline general: creating a temp file or directory is only
+OK when something owns its deletion.
+
+Detection — a call that creates an on-disk temp artifact:
+
+- ``tempfile.mkstemp(...)`` / ``tempfile.mkdtemp(...)`` (the raw,
+  nothing-cleans-this-up primitives),
+- ``tempfile.NamedTemporaryFile(..., delete=False)`` (the flag that opts
+  OUT of the class's own cleanup),
+- write-mode ``open(...)`` whose path expression is derived from
+  ``tempfile.gettempdir()`` in the same expression.
+
+Exempt when a cleanup owner is syntactically in scope:
+
+- the call is a ``with`` statement's context expression (the context
+  manager deletes on exit),
+- the enclosing function contains a ``try`` whose ``finally`` mentions a
+  cleanup call (``remove`` / ``rmtree`` / ``unlink`` / ``close`` /
+  ``cleanup`` / ``release``) — covering both the in-``try`` and the
+  idiomatic acquire-before-``try`` shapes,
+- the call sits inside a class that defines ``close``/``cleanup``/
+  ``__exit__``/``__del__`` — the owner-object pattern (SpillManager:
+  files accrue across calls, one ``close()`` in the query ``finally``
+  deletes them all).
+
+A deliberately persistent artifact (e.g. a forensic dump the user is
+meant to pick up) is what the justified
+``# prestocheck: ignore[tempfile-hygiene]`` is for.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..core import Finding, Module, Pass, dotted_name, register
+
+_CLEANUP_TOKENS = ("remove", "rmtree", "unlink", "close", "cleanup",
+                   "release")
+_OWNER_METHODS = ("close", "cleanup", "__exit__", "__del__")
+
+
+def _creates_temp_artifact(call: ast.Call):
+    """Message describing why `call` creates an unowned temp artifact, or
+    None when it doesn't."""
+    name = dotted_name(call.func) or ""
+    short = name.rsplit(".", 1)[-1]
+    if short in ("mkstemp", "mkdtemp"):
+        return (f"{short}() creates a temp {'file' if short == 'mkstemp' else 'directory'} "
+                "nothing deletes")
+    if short == "NamedTemporaryFile":
+        for kw in call.keywords:
+            if kw.arg == "delete" and \
+                    isinstance(kw.value, ast.Constant) and kw.value.value is False:
+                return "NamedTemporaryFile(delete=False) opts out of its own cleanup"
+        return None
+    if short == "open" and name == "open" and call.args:
+        mode = call.args[1] if len(call.args) > 1 else None
+        for kw in call.keywords:
+            if kw.arg == "mode":
+                mode = kw.value
+        if not (isinstance(mode, ast.Constant) and isinstance(mode.value, str)
+                and any(m in mode.value for m in "wxa")):
+            return None
+        for sub in ast.walk(call.args[0]):
+            if isinstance(sub, ast.Call) and \
+                    (dotted_name(sub.func) or "").endswith("gettempdir"):
+                return "write-mode open() of a tempdir-derived path"
+    return None
+
+
+def _finally_cleans(try_node: ast.Try) -> bool:
+    for stmt in try_node.finalbody:
+        for sub in ast.walk(stmt):
+            token = sub.attr if isinstance(sub, ast.Attribute) else \
+                sub.id if isinstance(sub, ast.Name) else ""
+            if any(t in token for t in _CLEANUP_TOKENS):
+                return True
+    return False
+
+
+@register
+class TempfileHygienePass(Pass):
+    id = "tempfile-hygiene"
+    description = ("temp/spill file creation without a cleanup owner — "
+                   "guard with `with`, a cleaning `finally`, or an owner "
+                   "class exposing close()")
+
+    def check_module(self, module: Module):
+        # parent chain for each node: guards look OUTWARD from the call
+        parents = {}
+        for node in ast.walk(module.tree):
+            for child in ast.iter_child_nodes(node):
+                parents[child] = node
+        for call in ast.walk(module.tree):
+            if not isinstance(call, ast.Call):
+                continue
+            why = _creates_temp_artifact(call)
+            if why is None:
+                continue
+            guarded = False
+            node = call
+            while node is not None and not guarded:
+                parent = parents.get(node)
+                if isinstance(parent, ast.withitem) and \
+                        parent.context_expr is node:
+                    guarded = True  # context manager owns the cleanup
+                if isinstance(parent, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef)) and \
+                        any(isinstance(t, ast.Try) and _finally_cleans(t)
+                            for t in ast.walk(parent)):
+                    guarded = True  # cleanup finally in the same function
+                    # (acquire-before-try included)
+                if isinstance(parent, ast.ClassDef) and \
+                        any(isinstance(m, (ast.FunctionDef,
+                                           ast.AsyncFunctionDef))
+                            and m.name in _OWNER_METHODS
+                            for m in parent.body):
+                    guarded = True  # owner object: its close() deletes
+                node = parent
+            if guarded:
+                continue
+            yield Finding(
+                module.path, call.lineno, call.col_offset, self.id,
+                f"{why} — guard with `with`, a `finally` that removes it, "
+                "or an owner class exposing close()")
