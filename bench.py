@@ -1165,6 +1165,19 @@ def main():
             pass
     DETAIL["locksan"] = False
 
+    # same hygiene for the leak sanitizer: its method wrappers tax every
+    # reservation/spill call on the hot path — never benchmark them
+    if os.environ.pop("PRESTO_TPU_LEAKSAN", None):
+        print("bench: PRESTO_TPU_LEAKSAN was set — leak sanitizer disabled "
+              "for benchmarking (instrumented lifecycles would skew the "
+              "numbers)", file=sys.stderr)
+        try:
+            from presto_tpu.utils import leaksan
+            leaksan.uninstall()
+        except Exception:  # noqa: BLE001 - presto_tpu not imported yet: env strip suffices
+            pass
+    DETAIL["leaksan"] = False
+
     if args.platform:
         os.environ["JAX_PLATFORMS"] = args.platform
         import jax
@@ -1320,6 +1333,7 @@ def main():
     # stamp AFTER the TPU-record fallback merge: whatever detail dict wins,
     # the emitted record must say the numbers came from uninstrumented locks
     result["detail"]["locksan"] = False
+    result["detail"]["leaksan"] = False
     print(json.dumps(result))
 
     if args.compare:

@@ -72,4 +72,14 @@ from .block import Block, Dictionary, Page, page_from_arrays, page_from_pylists 
 # into the analyzer + expression-compiler registries on import
 from . import functions as _functions  # noqa: E402,F401
 
+# Runtime leak sanitizer: PRESTO_TPU_LEAKSAN=1 instruments pool
+# reservations, shared-pool clients, spill managers, trace recorders and
+# repo-started threads with allocation-site capture; residue at query
+# release / process exit becomes findings. Installed LAST: leaksan
+# patches engine classes, so they must be importable first — and unlike
+# locksan nothing it tracks can exist before the first query runs.
+from .utils import leaksan as _leaksan  # noqa: E402
+
+_leaksan.install_from_env()
+
 __version__ = "0.1.0"

@@ -176,15 +176,18 @@ class ClusterQueryRunner:
 
         from ..exec import progress
 
+        scope = None
+        t0 = _time.perf_counter()
         rec = trace.maybe_recorder(session)
         installed = rec is not None and trace.install(rec)
-        scope = None
-        if rec is not None and rec.query_id \
-                and progress.current_query_id() is None:
-            scope = progress.query_scope(rec.query_id)
-            scope.__enter__()
-        t0 = _time.perf_counter()
         try:
+            if rec is not None and rec.query_id \
+                    and progress.current_query_id() is None:
+                # bind scope only after a successful __enter__: the finally
+                # below must not __exit__ a scope that was never entered
+                s = progress.query_scope(rec.query_id)
+                s.__enter__()
+                scope = s
             if installed:
                 with rec.span(trace.LIFECYCLE, "query"):
                     result = run()

@@ -421,6 +421,10 @@ class MergingRemoteSource(ConnectorPageSource):
     def close(self) -> None:
         # release producer-side buffers promptly on cancellation: an
         # unclosed stream would leave producers parked in OutputBuffer
-        # backpressure until its timeout
+        # backpressure until its timeout. Best-effort per stream — one
+        # unreachable worker must not strand the remaining producers
         for src in self._inner:
-            src.close()
+            try:
+                src.close()
+            except Exception:
+                pass  # close of the remaining streams is best-effort

@@ -161,10 +161,12 @@ class FileMetadata(ConnectorMetadata):
         rows = 0
         for f in files:
             pf = PcolFile(f)
-            headers.append(pf.header)
-            by_path[f] = pf.header
-            rows += pf.rows
-            pf.close()
+            try:
+                headers.append(pf.header)
+                by_path[f] = pf.header
+                rows += pf.rows
+            finally:
+                pf.close()
         # schema from the first file; dictionaries UNION across files so
         # every file's codes can remap into one table-wide dictionary
         from ...formats.pcol import _type_from_tag
@@ -203,35 +205,41 @@ class FileMetadata(ConnectorMetadata):
         string_values: Dict[str, set] = {}
         for f in files:
             pf = _ExternalFile(f)
-            if schema is None:
-                schema = pf.schema
-            rows += pf.num_rows
-            str_cols = [n for n, t in pf.schema if is_string(t)]
-            for n in str_cols:
-                vals_set = string_values.setdefault(n, set())
-                # cheap path: union the files' own dictionary pages/streams
-                distinct = pf.column_distinct_strings(n)
-                if distinct is not None:
-                    vals_set.update(distinct)
-                    continue
-                # direct-encoded fallback: decode the column once, with a
-                # hard cardinality bound — an unbounded high-cardinality
-                # column would materialize every distinct string in memory
-                # at PLAN time; fail with a clear message instead of an OOM
-                for gi in range(pf.n_chunks):
-                    if pf.chunk_rows(gi) == 0:
+            try:
+                if schema is None:
+                    schema = pf.schema
+                rows += pf.num_rows
+                str_cols = [n for n, t in pf.schema if is_string(t)]
+                for n in str_cols:
+                    vals_set = string_values.setdefault(n, set())
+                    # cheap path: union the files' own dictionary
+                    # pages/streams
+                    distinct = pf.column_distinct_strings(n)
+                    if distinct is not None:
+                        vals_set.update(distinct)
                         continue
-                    vals, nulls = pf.read_chunk(gi, [n])[n]
-                    if nulls is not None:
-                        vals = vals[~nulls]
-                    vals_set.update(np.unique(vals.astype(str)).tolist())
-                    if len(vals_set) > MAX_VARCHAR_DICTIONARY:
-                        raise ValueError(
-                            f"varchar column {n!r} of {name} exceeds "
-                            f"{MAX_VARCHAR_DICTIONARY} distinct values; "
-                            "re-encode the files with dictionary "
-                            "encoding (or drop the column from the table)")
-            pf.close()
+                    # direct-encoded fallback: decode the column once, with
+                    # a hard cardinality bound — an unbounded
+                    # high-cardinality column would materialize every
+                    # distinct string in memory at PLAN time; fail with a
+                    # clear message instead of an OOM
+                    for gi in range(pf.n_chunks):
+                        if pf.chunk_rows(gi) == 0:
+                            continue
+                        vals, nulls = pf.read_chunk(gi, [n])[n]
+                        if nulls is not None:
+                            vals = vals[~nulls]
+                        vals_set.update(
+                            np.unique(vals.astype(str)).tolist())
+                        if len(vals_set) > MAX_VARCHAR_DICTIONARY:
+                            raise ValueError(
+                                f"varchar column {n!r} of {name} exceeds "
+                                f"{MAX_VARCHAR_DICTIONARY} distinct "
+                                "values; re-encode the files with "
+                                "dictionary encoding (or drop the column "
+                                "from the table)")
+            finally:
+                pf.close()
         cols = tuple(
             ColumnMetadata(
                 n, t,
@@ -490,20 +498,23 @@ class FileSplitManager(ConnectorSplitManager):
         splits = []
         for b, f in enumerate(info.files):
             pf = PcolFile(f)
-            keep = pf.rows > 0
-            if keep and constraint.domains:
-                for col, dom in constraint.domains.items():
-                    if col not in pf.columns:
-                        continue
-                    lo, hi = dom if isinstance(dom, tuple) else (None, None)
-                    mn, mx = pf.column_stats(col)
-                    if mn is None:
-                        continue
-                    if (hi is not None and mn > hi) or \
-                            (lo is not None and mx < lo):
-                        keep = False
-                        break
-            pf.close()
+            try:
+                keep = pf.rows > 0
+                if keep and constraint.domains:
+                    for col, dom in constraint.domains.items():
+                        if col not in pf.columns:
+                            continue
+                        lo, hi = dom if isinstance(dom, tuple) \
+                            else (None, None)
+                        mn, mx = pf.column_stats(col)
+                        if mn is None:
+                            continue
+                        if (hi is not None and mn > hi) or \
+                                (lo is not None and mx < lo):
+                            keep = False
+                            break
+            finally:
+                pf.close()
             if keep:
                 splits.append(Split(self.connector_id,
                                     payload=(table.schema_table, f),

@@ -310,31 +310,35 @@ class HiveMetadata(ConnectorMetadata):
             for f in part.files:
                 if f.endswith(".pcol"):
                     pf = PcolFile(f)
-                    rows += pf.rows
-                    for n in str_data:
-                        e = pf.columns.get(n)
-                        if e is not None and "dict" in e:
+                    try:
+                        rows += pf.rows
+                        for n in str_data:
+                            e = pf.columns.get(n)
+                            if e is not None and "dict" in e:
+                                seen = file_dicts.setdefault(n, {})
+                                order = file_order.setdefault(n, [])
+                                for v in e["dict"]:
+                                    if v not in seen:
+                                        seen[v] = len(order)
+                                        order.append(v)
+                    finally:
+                        pf.close()
+                else:
+                    xf = _ExternalFile(f)
+                    try:
+                        rows += xf.num_rows
+                        for n in str_data:
+                            distinct = xf.column_distinct_strings(n)
+                            if distinct is None:
+                                continue
                             seen = file_dicts.setdefault(n, {})
                             order = file_order.setdefault(n, [])
-                            for v in e["dict"]:
+                            for v in distinct:
                                 if v not in seen:
                                     seen[v] = len(order)
                                     order.append(v)
-                    pf.close()
-                else:
-                    xf = _ExternalFile(f)
-                    rows += xf.num_rows
-                    for n in str_data:
-                        distinct = xf.column_distinct_strings(n)
-                        if distinct is None:
-                            continue
-                        seen = file_dicts.setdefault(n, {})
-                        order = file_order.setdefault(n, [])
-                        for v in distinct:
-                            if v not in seen:
-                                seen[v] = len(order)
-                                order.append(v)
-                    xf.close()
+                    finally:
+                        xf.close()
         cols = []
         pidx = {p: i for i, p in enumerate(desc.partitioned_by)}
         for n, t in desc.columns:

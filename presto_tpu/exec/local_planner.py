@@ -201,8 +201,12 @@ class _ConcatPageSource(ConnectorPageSource):
         return out
 
     def close(self) -> None:
+        # best-effort per source: a raising close must not skip the rest
         for s in self.sources:
-            s.close()
+            try:
+                s.close()
+            except Exception:
+                pass  # close of the remaining sources is best-effort
 
 
 @dataclasses.dataclass

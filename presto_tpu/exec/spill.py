@@ -19,7 +19,11 @@ by the runner's `_query_memory` and closed in the query-release ``finally``
 charged bytes released, no matter how the query ended. Crash leftovers
 (a SIGKILLed process never runs its ``finally``) are GC'd at the first
 manager construction of a later process: any sibling directory whose
-leading pid is dead is removed.
+leading pid is dead is removed. That dead-pid GC is the BACKSTOP, not the
+gate: a manager alive at ``clear_query`` is already a bug, and under
+``PRESTO_TPU_LEAKSAN=1`` (utils/leaksan.py) it becomes a ``spill-residue``
+finding carrying the stack that created it — the GC only mops up after
+processes that died too abruptly to be told.
 
 Fault injection: ``spill.write`` / ``spill.read`` fire points
 (cluster/faults.py) wrap the run I/O. An injected (or real) I/O failure

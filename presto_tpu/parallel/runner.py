@@ -141,9 +141,9 @@ class DistributedQueryRunner:
                 return self._execute_streaming(sub, book, frag_drivers)
             return self._execute_barrier(sub, book, frag_drivers)
 
+        t0 = _time.perf_counter()
         rec = trace.maybe_recorder(self.session)
         installed = rec is not None and trace.install(rec)
-        t0 = _time.perf_counter()
         try:
             # span only on THIS query's recorder: an untraced query running
             # concurrently with a traced one must not write a full-wall

@@ -241,9 +241,9 @@ class LocalQueryRunner:
         dumps the ring as a forensic trace pinned to the exception."""
         import time as _time
 
+        t0 = _time.perf_counter()
         rec = trace.maybe_recorder(self.session)
         installed = rec is not None and trace.install(rec)
-        t0 = _time.perf_counter()
         try:
             if installed:
                 with rec.span(trace.LIFECYCLE, "query"):

@@ -391,10 +391,13 @@ class PrestoTpuServer:
         return t
 
     def stop(self) -> None:
-        self.httpd.shutdown()
-        self.httpd.server_close()
-        # after the listener is down: no new submissions can race the join
-        self.manager.close()
+        try:
+            self.httpd.shutdown()
+            self.httpd.server_close()
+        finally:
+            # after the listener is down: no new submissions can race the
+            # join — and a raising socket teardown must not skip it
+            self.manager.close()
 
 
 def main(argv=None) -> None:

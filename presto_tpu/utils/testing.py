@@ -76,6 +76,31 @@ def drive_operators(operators: List[Operator]) -> None:
     Driver(operators).run_to_completion()
 
 
+def assert_no_residue(pool, query_id: Optional[str] = None) -> None:
+    """Shared zero-residue gate (replaces the tests' hand-rolled ledger
+    asserts): with `query_id`, that query must hold zero RAM and zero
+    spill bytes in `pool`; without, the pool's whole spill ledger must be
+    empty (RAM is deliberately NOT asserted pool-wide — the shared pool
+    outlives any one test, and a concurrent tenant's live reservation is
+    not this test's residue). When the runtime leak sanitizer is
+    installed, its findings must be empty too — a leak the ledger math
+    happens to cancel out still fails, with the allocation stack."""
+    if query_id is not None:
+        held = pool.query_bytes(query_id)
+        assert held == 0, \
+            f"query {query_id!r} left {held} reserved byte(s) in the pool"
+        spilled = pool.spill_bytes(query_id)
+        assert spilled == 0, \
+            f"query {query_id!r} left {spilled} spill byte(s) charged"
+    else:
+        ledger = pool.spill_by_query()
+        assert ledger == {}, f"spill ledger residue: {ledger}"
+    from . import leaksan
+
+    if leaksan.enabled():
+        leaksan.SANITIZER.assert_clean()
+
+
 # ---------------------------------------------------------------------------
 # sqlite oracle
 # ---------------------------------------------------------------------------

@@ -24,7 +24,8 @@ EXPECTED_PASSES = {"undefined-name", "tracer-safety", "lock-discipline",
                    "mutable-default-args", "sleep-poll", "host-sync",
                    "unbounded-cache", "wallclock-duration",
                    "shared-state-race", "thread-lifecycle",
-                   "print-hygiene", "tempfile-hygiene"}
+                   "print-hygiene", "tempfile-hygiene",
+                   "resource-discipline", "close-propagation"}
 
 
 def _scan(tmp_path, source, select=None, name="mod.py"):
@@ -1384,6 +1385,260 @@ def test_tempfile_hygiene_suppression(tmp_path):
     assert findings == []
 
 
+# ------------------------------------------------------- resource-discipline
+
+def test_resource_discipline_flags_happy_path_only_release(tmp_path):
+    findings = _scan(tmp_path, """
+        class Conn:
+            def close(self):
+                pass
+
+        def happy_path_only():
+            c = Conn()
+            c.execute("select 1")   # can raise: the close below never runs
+            c.close()
+        """, select=["resource-discipline"])
+    msgs = "\n".join(_messages(findings))
+    assert len(findings) == 1
+    assert "`c` (Conn) is released only on the happy path" in msgs
+
+
+def test_resource_discipline_flags_unreleased_and_discarded(tmp_path):
+    findings = _scan(tmp_path, """
+        class Conn:
+            def close(self):
+                pass
+
+        def never_released():
+            c = Conn()
+            c.execute("select 1")
+
+        def discarded():
+            Conn()
+        """, select=["resource-discipline"])
+    msgs = "\n".join(_messages(findings))
+    assert "`c` (Conn) is acquired but never released on any path" in msgs
+    assert "result of Conn acquire is discarded" in msgs
+    assert len(findings) == 2
+
+
+def test_resource_discipline_learns_producers_through_singletons(tmp_path):
+    # Pool.client() returns a fresh Conn, so a POOL.client() call is an
+    # acquire even though no constructor appears at the call site.
+    findings = _scan(tmp_path, """
+        class Conn:
+            def close(self):
+                pass
+
+        class Pool:
+            def client(self):
+                return Conn()
+
+        POOL = Pool()
+
+        def leaky_client():
+            h = POOL.client()
+            h.execute("select 1")
+        """, select=["resource-discipline"])
+    msgs = "\n".join(_messages(findings))
+    assert "`h` (Conn) is acquired but never released" in msgs
+
+
+def test_resource_discipline_clean_shapes(tmp_path):
+    # finally-release, with-managed, ownership transfer by return, and a
+    # one-level helper that releases its parameter: all sanctioned.
+    findings = _scan(tmp_path, """
+        class Conn:
+            def close(self):
+                pass
+
+        def _shutdown(conn):
+            conn.close()
+
+        def finally_guarded():
+            c = Conn()
+            try:
+                c.execute("select 1")
+            finally:
+                c.close()
+
+        def with_managed():
+            c = Conn()
+            with c:
+                c.execute("select 1")
+
+        def transferred():
+            c = Conn()
+            c.prepare()
+            return c            # ownership moves to the caller
+
+        def helper_released():
+            c = Conn()
+            try:
+                c.execute("select 1")
+            finally:
+                _shutdown(c)
+        """, select=["resource-discipline"])
+    assert findings == []
+
+
+def test_resource_discipline_ledger_pair_needs_finally(tmp_path):
+    findings = _scan(tmp_path, """
+        def ledger_unprotected(pool, qid):
+            pool.reserve(qid, 4096)
+            run_query(qid)
+            pool.clear_query(qid)
+
+        def ledger_guarded(pool, qid):
+            pool.reserve(qid, 4096)
+            try:
+                run_query(qid)
+            finally:
+                pool.clear_query(qid)
+        """, select=["resource-discipline"])
+    msgs = "\n".join(_messages(findings))
+    assert len(findings) == 1
+    assert "`pool.clear_query()` paired with `pool.reserve()`" in msgs
+    assert findings[0].line == 5    # anchored at the unprotected release
+
+
+def test_resource_discipline_suppression(tmp_path):
+    findings = _scan(tmp_path, """
+        class Conn:
+            def close(self):
+                pass
+
+        def deliberate():
+            c = Conn()  # prestocheck: ignore[resource-discipline] - process-lifetime handle
+            c.execute("select 1")
+        """, select=["resource-discipline"])
+    assert findings == []
+
+
+# -------------------------------------------------------- close-propagation
+
+def test_close_propagation_flags_owner_without_teardown(tmp_path):
+    findings = _scan(tmp_path, """
+        class Conn:
+            def close(self):
+                pass
+
+        class NoTeardown:
+            def __init__(self):
+                self._conn = Conn()
+        """, select=["close-propagation"])
+    msgs = "\n".join(_messages(findings))
+    assert len(findings) == 1
+    assert "class `NoTeardown` acquires closeable `self._conn` (Conn)" in msgs
+    assert "defines no close()/teardown method" in msgs
+
+
+def test_close_propagation_flags_attr_missed_by_teardown(tmp_path):
+    findings = _scan(tmp_path, """
+        class Conn:
+            def close(self):
+                pass
+
+        class Forgetful:
+            def __init__(self):
+                self._conn = Conn()
+                self._log = Conn()
+
+            def close(self):
+                try:
+                    self._conn.close()
+                except Exception:
+                    pass
+        """, select=["close-propagation"])
+    msgs = "\n".join(_messages(findings))
+    assert len(findings) == 1
+    assert "`self._log` (Conn) acquired by `Forgetful` is never closed" in msgs
+
+
+def test_close_propagation_flags_sibling_and_loop_skips(tmp_path):
+    findings = _scan(tmp_path, """
+        class Conn:
+            def close(self):
+                pass
+
+        class TwoHandles:
+            def __init__(self):
+                self._a = Conn()
+                self._b = Conn()
+
+            def close(self):
+                self._a.close()
+                self._b.close()
+
+        class Many:
+            def __init__(self):
+                self._conns = []
+
+            def close(self):
+                for c in self._conns:
+                    c.close()
+        """, select=["close-propagation"])
+    msgs = "\n".join(_messages(findings))
+    assert "close of `_b` in close() is skipped when the earlier close " \
+           "of `_a` raises" in msgs
+    assert "close of `c` inside a loop in close()" in msgs
+    assert len(findings) == 2
+
+
+def test_close_propagation_clean_owners(tmp_path):
+    # protected sibling closes, delegation to a helper call, a borrowed
+    # (parameter-bound) attribute, and a one-level self-helper: all clean.
+    findings = _scan(tmp_path, """
+        class Conn:
+            def close(self):
+                pass
+
+        class Careful:
+            def __init__(self, outer):
+                self._borrowed = outer      # borrowed: caller releases
+                self._a = Conn()
+                self._b = Conn()
+
+            def close(self):
+                try:
+                    self._a.close()
+                except Exception:
+                    pass
+                self._b.close()
+
+        class Delegating:
+            def __init__(self):
+                self._tmp = Conn()
+
+            def close(self):
+                dispose(self._tmp)
+
+        class Indirect:
+            def __init__(self):
+                self._conn = Conn()
+
+            def _teardown_conn(self):
+                self._conn.close()
+
+            def close(self):
+                self._teardown_conn()
+        """, select=["close-propagation"])
+    assert findings == []
+
+
+def test_close_propagation_suppression(tmp_path):
+    findings = _scan(tmp_path, """
+        class Conn:
+            def close(self):
+                pass
+
+        class Pinned:
+            def __init__(self):
+                self._conn = Conn()  # prestocheck: ignore[close-propagation] - released by registry atexit
+        """, select=["close-propagation"])
+    assert findings == []
+
+
 # ------------------------------------------------------------- tier-1 gate
 
 def test_whole_tree_has_no_new_findings():
@@ -1574,3 +1829,44 @@ def test_cli_partial_update_baseline_keeps_other_passes(tmp_path):
          "--baseline", str(baseline), str(bad)],
         capture_output=True, text=True, cwd=REPO, env=env)
     assert full.returncode == 0, full.stdout + full.stderr
+
+
+def test_cli_sarif_round_trips_with_json(tmp_path):
+    """--format sarif carries exactly the findings --format json reports,
+    with 1-based columns, rule metadata for every pass, and baselineState."""
+    env = dict(os.environ, PYTHONPATH=REPO)
+    bad = tmp_path / "bad.py"
+    bad.write_text("def f(xs=[]):\n    return unknown_name\n")
+
+    jout = subprocess.run(
+        [sys.executable, "-m", "tools.prestocheck",
+         "--format", "json", str(bad)],
+        capture_output=True, text=True, cwd=REPO, env=env)
+    sout = subprocess.run(
+        [sys.executable, "-m", "tools.prestocheck",
+         "--format", "sarif", str(bad)],
+        capture_output=True, text=True, cwd=REPO, env=env)
+    assert jout.returncode == 1 and sout.returncode == 1
+
+    jdoc = json.loads(jout.stdout)
+    sdoc = json.loads(sout.stdout)
+    assert sdoc["version"] == "2.1.0"
+    assert "sarif-schema-2.1.0" in sdoc["$schema"]
+    (run_,) = sdoc["runs"]
+    rules = {r["id"] for r in run_["tool"]["driver"]["rules"]}
+    assert EXPECTED_PASSES <= rules
+    assert "SRCROOT" in run_["originalUriBaseIds"]
+
+    jkeys = {(f["pass"], f["file"], f["line"], f["col"], f["message"])
+             for f in jdoc["new"]}
+    skeys = set()
+    for r in run_["results"]:
+        assert r["level"] == "warning"
+        assert r["baselineState"] == "new"
+        (loc,) = r["locations"]
+        phys = loc["physicalLocation"]
+        skeys.add((r["ruleId"], phys["artifactLocation"]["uri"],
+                   phys["region"]["startLine"],
+                   phys["region"]["startColumn"],
+                   r["message"]["text"]))
+    assert skeys == jkeys and len(skeys) == 2

@@ -27,6 +27,7 @@ from presto_tpu.models.tpch_sql import QUERIES
 from presto_tpu.ops.scan_pipeline import HostChunk, ScanPipeline
 from presto_tpu.runner import LocalQueryRunner
 from presto_tpu.types import BIGINT
+from presto_tpu.utils.testing import assert_no_residue
 
 MIX = [1, 3, 6]
 
@@ -224,7 +225,7 @@ class TestMemoryAccounting:
             time.sleep(0.005)
         assert seen > 0, "prefetch bytes never appeared in the pool"
         pipe.close()
-        assert pool.query_bytes("q-prefetch") == 0
+        assert_no_residue(pool, "q-prefetch")
 
     def test_exchange_inflight_bytes_reserved_in_query_pool(self):
         jax = pytest.importorskip("jax")
@@ -247,7 +248,7 @@ class TestMemoryAccounting:
         ex.add_page(0, page)  # staged, pump not started: bytes stay in-flight
         assert pool.query_bytes("q-exchange") > 0
         ex.close()
-        assert pool.query_bytes("q-exchange") == 0
+        assert_no_residue(pool, "q-exchange")
 
     def test_over_budget_query_killed_not_wedged(self):
         """A query whose scan prefetch blows its per-query budget FAILS with
@@ -263,7 +264,7 @@ class TestMemoryAccounting:
             while pipe.next() is not None:
                 pass
         pipe.close()
-        assert pool.query_bytes("q-oom") == 0
+        assert_no_residue(pool, "q-oom")
 
     def test_shared_pool_release_clears_query(self):
         from presto_tpu.memory import shared_general_pool
@@ -272,7 +273,7 @@ class TestMemoryAccounting:
         pool.reserve("q-leak-test", 12345)
         assert pool.query_bytes("q-leak-test") == 12345
         pool.clear_query("q-leak-test")
-        assert pool.query_bytes("q-leak-test") == 0
+        assert_no_residue(pool, "q-leak-test")
 
 
 # ---------------------------------------------------------------------------
@@ -313,7 +314,7 @@ class TestMemoryAwareAdmission:
                                    memory_limit_bytes=1 << 60)
         ticket = mgr.submit("q1")
         mgr.finish(ticket)
-        assert pool.reserved_bytes() >= 0  # probe wired without error
+        assert_no_residue(pool)  # probe wired without residue
 
 
 # ---------------------------------------------------------------------------

@@ -17,7 +17,8 @@ import pytest
 
 from presto_tpu.metadata import Session
 from presto_tpu.runner import LocalQueryRunner
-from presto_tpu.utils.testing import SqliteOracle, assert_rows_equal
+from presto_tpu.utils.testing import (SqliteOracle, assert_no_residue,
+                                      assert_rows_equal)
 
 
 @pytest.fixture(scope="module")
@@ -179,7 +180,7 @@ def test_spill_manager_accounting_and_lifecycle(tmp_path):
     np.testing.assert_array_equal(data, col)
     mgr.close()
     mgr.close()  # idempotent
-    assert pool.spill_by_query() == {}
+    assert_no_residue(pool, "q_acct")
     assert not os.path.exists(run.path)
 
 
@@ -192,7 +193,7 @@ def test_spill_max_bytes_fails_query_like_a_memory_limit(tmp_path):
     with pytest.raises(ExceededMemoryLimitException):
         mgr.write_columns(["k"], [np.arange(4096, dtype=np.int64)])
     mgr.close()
-    assert pool.spill_by_query() == {}  # over-limit run was released
+    assert_no_residue(pool, "q_cap")  # over-limit run was released
 
 
 def test_multi_tenant_spill_independent_and_residue_free(oracle):
@@ -220,7 +221,7 @@ def test_multi_tenant_spill_independent_and_residue_free(oracle):
         t.join(timeout=120.0)
     assert not errors, errors
     assert all(rows == want for rows in results.values())
-    assert shared_general_pool().spilled_bytes() == 0, "spill ledger residue"
+    assert_no_residue(shared_general_pool())
     assert not _own_spill_dirs(), "spill directories left behind"
 
 

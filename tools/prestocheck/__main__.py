@@ -16,6 +16,54 @@ from .core import (REPO_ROOT, git_changed_files, load_modules, make_passes,
                    run_passes, save_baseline)
 
 
+SARIF_SCHEMA = ("https://raw.githubusercontent.com/oasis-tcs/sarif-spec/"
+                "master/Schemata/sarif-schema-2.1.0.json")
+
+
+def to_sarif(new_findings, baselined=()) -> dict:
+    """Findings -> one SARIF 2.1.0 run (what code-scanning UIs ingest).
+    Rules come from the registered pass descriptions; grandfathered
+    findings ride along with ``baselineState: "unchanged"`` so a SARIF
+    consumer can filter them the way the text output does."""
+    rules = [{"id": p.id,
+              "shortDescription": {"text": p.description}}
+             for p in make_passes()]
+
+    def result(f, state: str) -> dict:
+        return {
+            "ruleId": f.pass_id,
+            "level": "warning",
+            "message": {"text": f.message},
+            "baselineState": state,
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {"uri": f.relpath(),
+                                         "uriBaseId": "SRCROOT"},
+                    # col is 0-based internally; SARIF columns are 1-based
+                    # (same shift to_json/render apply)
+                    "region": {"startLine": f.line,
+                               "startColumn": f.col + 1},
+                }}],
+        }
+
+    return {
+        "$schema": SARIF_SCHEMA,
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "prestocheck",
+                "informationUri":
+                    "https://github.com/presto-tpu/presto-tpu",
+                "rules": rules,
+            }},
+            "originalUriBaseIds": {
+                "SRCROOT": {"uri": "file://" + REPO_ROOT.rstrip("/") + "/"}},
+            "results": [result(f, "new") for f in new_findings]
+                       + [result(f, "unchanged") for f in baselined],
+        }],
+    }
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m tools.prestocheck",
@@ -23,7 +71,13 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument("paths", nargs="*", default=None,
                         help="files/dirs to scan (default: presto_tpu tools)")
     parser.add_argument("--json", action="store_true", dest="as_json",
-                        help="emit findings as a JSON document")
+                        help="emit findings as a JSON document "
+                             "(same as --format json)")
+    parser.add_argument("--format", choices=("text", "json", "sarif"),
+                        default=None,
+                        help="output format: text (default), json, or "
+                             "sarif (SARIF 2.1.0 — what code-scanning "
+                             "UIs ingest)")
     parser.add_argument("--list-passes", action="store_true",
                         help="list registered pass ids and exit")
     parser.add_argument("--select", metavar="IDS",
@@ -48,7 +102,16 @@ def main(argv: Optional[List[str]] = None) -> int:
                              "runtime acquisition-order edges against the "
                              "static lock-discipline graph and report the "
                              "edges the static resolver missed")
+    parser.add_argument("--leak-diff", metavar="DUMP_JSON",
+                        help="map a leaksan SANITIZER.dump() file's "
+                             "runtime residue findings onto the static "
+                             "resource-discipline acquire sites and "
+                             "report the static pass's blind spots")
     args = parser.parse_args(argv)
+    if args.as_json and args.format is None:
+        args.format = "json"
+    args.as_json = args.format == "json"
+    args.format = args.format or "text"
 
     if args.list_passes:
         passes = make_passes()
@@ -95,6 +158,37 @@ def main(argv: Optional[List[str]] = None) -> int:
               f"{len(diff['unmapped'])} unmapped sites", file=sys.stderr)
         # informational (exit 0): missing edges become static-pass fixtures,
         # they are not CI failures by themselves
+        return 0
+    if args.leak_diff:
+        from .leakdiff import diff_dump_path as leak_diff_dump_path
+
+        try:
+            diff = leak_diff_dump_path(args.leak_diff, paths)
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"prestocheck: cannot read leak dump: {e}",
+                  file=sys.stderr)
+            return 2
+        if args.as_json:
+            print(json.dumps(diff, indent=1))
+        else:
+            for m in diff["matched"]:
+                print(f"residue confirmed by both halves: [{m['kind']}] "
+                      f"{m['resource']} acquired at {m['frame']}")
+            for m in diff["missing"]:
+                print(f"residue the static pass judged safe: "
+                      f"[{m['kind']}] {m['resource']} acquired at "
+                      f"{m['frame']} — candidate fixture")
+            for u in diff["unmapped"]:
+                print(f"unmapped residue: [{u['kind']}] at {u['site']}")
+        print(f"prestocheck: leak diff — "
+              f"{diff['runtime_findings']} runtime finding(s), "
+              f"{len(diff['matched'])} matched, "
+              f"{len(diff['missing'])} missing, "
+              f"{len(diff['unmapped'])} unmapped "
+              f"({diff['acquire_sites']} static acquire sites)",
+              file=sys.stderr)
+        # informational (exit 0): like the lock-graph diff, the output's
+        # job is to turn runtime residue into static-pass fixtures
         return 0
     if args.changed_only and args.update_baseline:
         # the update would rewrite the baseline from only the changed files,
@@ -161,6 +255,9 @@ def main(argv: Optional[List[str]] = None) -> int:
             "baselined": [f.to_json() for f in result.baselined],
             "pass_wall_s": result.pass_wall_s,
         }, indent=1))
+    elif args.format == "sarif":
+        print(json.dumps(to_sarif(result.new_findings, result.baselined),
+                         indent=1))
     else:
         for f in result.new_findings:
             print(f.render())

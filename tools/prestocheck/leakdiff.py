@@ -1,0 +1,148 @@
+"""Runtime -> static leak diff.
+
+The runtime leak sanitizer (presto_tpu/utils/leaksan.py) reports residue —
+resources still held at query release or process exit — with the REAL
+allocation stack. The static ``resource-discipline`` pass reasons about
+the same acquire/release pairs from the AST. This module closes the loop:
+
+    python -m tools.prestocheck --leak-diff dump.json [paths...]
+
+where ``dump.json`` is :meth:`LeakSanitizer.dump` output. Every runtime
+finding's allocation stack is resolved against an AST scan for acquire
+sites (the same ``_acquire_of`` resolution the static pass uses, plus the
+ledger acquires ``reserve`` / ``reserve_spill`` / ``install``):
+
+- **matched**: the residue's allocation site is a known acquire AND the
+  static pass also flags that file — the two halves agree; fix the code.
+- **missing**: the residue maps to a known acquire the static pass judged
+  safe — a static-resolver blind spot (dynamic dispatch, callback-held
+  resources); each one is a candidate fixture/extension for the pass.
+- **unmapped**: no stack frame resolves to a known acquire site (the
+  allocation happened outside the scanned roots, or through a surface the
+  registry has not learned).
+
+Informational, exit 0 — like ``--lock-graph-diff``, the diff's job is to
+turn runtime evidence into static-pass fixtures, not to gate CI itself.
+"""
+from __future__ import annotations
+
+import ast
+import json
+import os
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from .core import Module, load_modules
+from .passes.resource_discipline import (_LEDGER_PAIRS,
+                                         ResourceDisciplinePass,
+                                         _walk_own, build_registry,
+                                         iter_functions, res_facts)
+
+_LEDGER_ACQUIRES = frozenset(a for a, _r in _LEDGER_PAIRS)
+
+
+class _SiteMap:
+    """(relpath, lineno) -> resource label for every acquire expression."""
+
+    def __init__(self):
+        # path -> [(lo_line, hi_line, resource label)]
+        self.ranges: Dict[str, List[Tuple[int, int, str]]] = {}
+
+    def add(self, path: str, lo: int, hi: int, label: str) -> None:
+        self.ranges.setdefault(path, []).append((lo, hi, label))
+
+    def resolve_site(self, site: str) -> Optional[str]:
+        """'presto_tpu/exec/spill.py:163' -> resource label, or None."""
+        path, _, lineno = site.rpartition(":")
+        try:
+            line = int(lineno)
+        except ValueError:
+            return None
+        for lo, hi, label in self.ranges.get(path.replace(os.sep, "/"), ()):
+            if lo <= line <= hi:
+                return label
+        return None
+
+
+def _scan_acquires(modules: Sequence[Module]) -> _SiteMap:
+    """Map every statement containing an acquire expression (constructor,
+    producer call, write-mode open, ledger reserve) to its resource."""
+    from .core import REPO_ROOT
+
+    rd = ResourceDisciplinePass()
+    reg = build_registry(modules)
+    smap = _SiteMap()
+    for module in modules:
+        if module.tree is None:
+            continue
+        facts = res_facts(module)
+        rel = os.path.relpath(os.path.abspath(module.path), REPO_ROOT)
+        rel = rel.replace(os.sep, "/")
+        for fn, cls in iter_functions(module.tree):
+            for node in _walk_own(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                acq = rd._acquire_of(node, facts, reg, cls)
+                if acq is not None:
+                    smap.add(rel, node.lineno,
+                             getattr(node, "end_lineno", node.lineno),
+                             acq[0])
+                elif isinstance(node.func, ast.Attribute) and \
+                        node.func.attr in _LEDGER_ACQUIRES:
+                    smap.add(rel, node.lineno,
+                             getattr(node, "end_lineno", node.lineno),
+                             f"ledger:{node.func.attr}")
+    return smap
+
+
+def diff_dump(dump: dict, paths: Sequence[str]) -> dict:
+    """Compare a leaksan SANITIZER.dump() document's residue findings
+    against the static resource-discipline analysis over `paths`.
+
+    -> {"runtime_findings", "matched": [...], "missing": [...],
+        "unmapped": [...]} where `missing` lists residue whose acquire the
+    static pass considered safe (its blind spots — candidate fixtures)
+    and `unmapped` lists findings no stack frame could be attributed."""
+    from .core import REPO_ROOT
+
+    modules = load_modules(paths)
+    smap = _scan_acquires(modules)
+    rd = ResourceDisciplinePass()
+    for m in modules:
+        rd.check_module(m)
+    static_files = set()
+    for f in rd.finish(modules):
+        static_files.add(os.path.relpath(
+            os.path.abspath(f.file), REPO_ROOT).replace(os.sep, "/"))
+
+    matched: List[dict] = []
+    missing: List[dict] = []
+    unmapped: List[dict] = []
+    findings = dump.get("findings", [])
+    for f in findings:
+        frames = [f.get("site", "")] + list(f.get("stack", []))
+        hit = None
+        for frame in frames:
+            label = smap.resolve_site(frame)
+            if label is not None:
+                hit = {"kind": f.get("kind", ""), "frame": frame,
+                       "resource": label, "query_id": f.get("query_id", ""),
+                       "message": f.get("message", "")}
+                break
+        if hit is None:
+            unmapped.append({"kind": f.get("kind", ""),
+                             "site": f.get("site", ""),
+                             "stack": list(f.get("stack", []))})
+        elif hit["frame"].rpartition(":")[0] in static_files:
+            matched.append(hit)
+        else:
+            missing.append(hit)
+    return {"runtime_findings": len(findings),
+            "acquire_sites": sum(len(v) for v in smap.ranges.values()),
+            "matched": matched,
+            "missing": missing,
+            "unmapped": unmapped}
+
+
+def diff_dump_path(dump_path: str, paths: Sequence[str]) -> dict:
+    with open(dump_path, "r", encoding="utf-8") as f:
+        return diff_dump(json.load(f), paths)

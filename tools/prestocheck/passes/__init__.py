@@ -13,3 +13,5 @@ from . import shared_state_race  # noqa: F401
 from . import thread_lifecycle  # noqa: F401
 from . import print_hygiene  # noqa: F401
 from . import tempfile_hygiene  # noqa: F401
+from . import resource_discipline  # noqa: F401
+from . import close_propagation  # noqa: F401

@@ -1,0 +1,257 @@
+"""close-propagation: owners of closeables must close them — all of them.
+
+The other half of resource-discipline: when an acquire ESCAPES into an
+attribute (``self._spill = SpillManager(...)``) the ownership moved onto the
+object, so the object's own teardown inherits the release obligation. Two
+checks, sharing resource-discipline's learned registry:
+
+1. **unclosed owned attribute**: a class whose ``__init__``/setup method
+   binds an attribute to a fresh acquire (a learned resource-class
+   constructor, a producer call, ``open(..., "w")``, a tempfile factory)
+   must release it from some teardown method (``close``/``stop``/
+   ``__exit__``/...), directly, through a one-level ``self``-helper, or by
+   handing it to any call inside the teardown (benefit of the doubt —
+   teardown code that forwards a resource is delegating its cleanup). A
+   class with owned closeables and NO teardown method at all is flagged
+   once per attribute. Attributes bound from parameters are borrowed, not
+   owned — the caller keeps the release obligation (resource-discipline's
+   beat), so they are exempt.
+
+2. **sibling skip**: inside a teardown method, a close call that raises
+   aborts the rest of the teardown — every sibling closeable after it
+   leaks. Flagged for sequential close calls in one block and for close
+   calls under a ``for`` loop (one raising element skips the remaining
+   elements) unless the earlier close is exception-protected
+   (``try``/``except``, ``contextlib.suppress``, or a callee the registry
+   knows never raises is still flagged — wrap it; the wrapper documents
+   the invariant).
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from ..core import Finding, Module, Pass, register, terminal_attr
+from .resource_discipline import (_RELEASE_CALL_NAMES, _SETUP_METHODS,
+                                  _TEARDOWN_METHODS, Registry,
+                                  ResourceDisciplinePass, _walk_own,
+                                  build_registry, res_facts)
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    """'x' for a `self.x` / `cls.x` expression, else None."""
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and \
+            node.value.id in ("self", "cls"):
+        return node.attr
+    return None
+
+
+def _protected(stmt: ast.AST, method: ast.AST) -> bool:
+    """Is `stmt` inside a try/except, a try whose finally continues the
+    cleanup, or a `with suppress(...)` — i.e. can a raise in it NOT abort
+    the rest of the teardown?"""
+    for node in ast.walk(method):
+        if isinstance(node, ast.Try) and node.handlers:
+            if any(stmt is n or stmt in ast.walk(n) for n in node.body):
+                return True
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            names = {terminal_attr(item.context_expr.func)
+                     for item in node.items
+                     if isinstance(item.context_expr, ast.Call)}
+            if "suppress" in names and \
+                    any(stmt is n or stmt in ast.walk(n)
+                        for n in node.body):
+                return True
+    return False
+
+
+@register
+class ClosePropagationPass(Pass):
+    id = "close-propagation"
+    description = ("closeable attribute never closed by its owner's "
+                   "teardown; close() that skips a sibling when an earlier "
+                   "close raises")
+
+    def check_module(self, module: Module):
+        res_facts(module)
+        return ()
+
+    # ---------------------------------------------------------------- helpers
+
+    def _owned_attrs(self, cls_node: ast.ClassDef, module: Module,
+                     reg: Registry) -> List[Tuple[str, ast.AST, str]]:
+        """[(attr, assign stmt, resource class)] for fresh acquires stored
+        on self in a setup method."""
+        facts = res_facts(module)
+        rd = ResourceDisciplinePass()
+        owned: List[Tuple[str, ast.AST, str]] = []
+        seen: Set[str] = set()
+        for m in cls_node.body:
+            if not isinstance(m, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    or m.name not in _SETUP_METHODS:
+                continue
+            for node in _walk_own(m):
+                if not isinstance(node, ast.Assign):
+                    continue
+                for t in node.targets:
+                    attr = _self_attr(t)
+                    if attr is None or attr in seen:
+                        continue
+                    acq = rd._acquire_of(node.value, facts, reg,
+                                         cls_node.name)
+                    if acq is not None:
+                        seen.add(attr)
+                        owned.append((attr, node, acq[0]))
+        return owned
+
+    def _released_attrs(self, cls_node: ast.ClassDef,
+                        methods: Dict[str, ast.AST],
+                        teardowns: List[str]) -> Set[str]:
+        released: Set[str] = set()
+        visited: Set[str] = set()
+        queue = list(teardowns)
+        while queue:
+            name = queue.pop()
+            if name in visited:
+                continue
+            visited.add(name)
+            m = methods.get(name)
+            if m is None:
+                continue
+            aliases: Dict[str, str] = {}   # local name -> attr it aliases
+            for node in _walk_own(m):
+                if isinstance(node, ast.Assign):
+                    attr = _self_attr(node.value)
+                    if attr:
+                        for t in node.targets:
+                            if isinstance(t, ast.Name):
+                                aliases[t.id] = attr
+                    # `self.x, old = None, self.x` swap form
+                    if isinstance(node.value, ast.Tuple) and \
+                            len(node.targets) == 1 and \
+                            isinstance(node.targets[0], ast.Tuple):
+                        for t, v in zip(node.targets[0].elts,
+                                        node.value.elts):
+                            a = _self_attr(v)
+                            if a and isinstance(t, ast.Name):
+                                aliases[t.id] = a
+                elif isinstance(node, ast.Call):
+                    f = node.func
+                    if isinstance(f, ast.Attribute):
+                        attr = _self_attr(f.value)
+                        if attr and f.attr in _RELEASE_CALL_NAMES:
+                            released.add(attr)
+                        elif isinstance(f.value, ast.Name) and \
+                                f.value.id in aliases and \
+                                f.attr in _RELEASE_CALL_NAMES:
+                            released.add(aliases[f.value.id])
+                        elif attr is None and \
+                                isinstance(f.value, ast.Name) and \
+                                f.value.id in ("self", "cls"):
+                            queue.append(f.attr)   # one-level self helper
+                    # resource handed to ANY call inside a teardown:
+                    # delegation, count as released (precision over recall)
+                    for arg in list(node.args) + \
+                            [kw.value for kw in node.keywords]:
+                        a = _self_attr(arg)
+                        if a:
+                            released.add(a)
+                        elif isinstance(arg, ast.Name) and arg.id in aliases:
+                            released.add(aliases[arg.id])
+        return released
+
+    def _sibling_skips(self, m: ast.AST, module: Module,
+                       findings: List[Finding]) -> None:
+        """Sequential unprotected close calls: the earlier raising skips
+        the later sibling (and a raising close in a `for` loop skips the
+        remaining elements)."""
+
+        def close_stmt_attr(stmt: ast.AST) -> Optional[Tuple[str, ast.AST]]:
+            if isinstance(stmt, ast.Expr) and \
+                    isinstance(stmt.value, ast.Call) and \
+                    isinstance(stmt.value.func, ast.Attribute) and \
+                    stmt.value.func.attr in _RELEASE_CALL_NAMES:
+                recv = stmt.value.func.value
+                attr = _self_attr(recv)
+                if attr:
+                    return attr, stmt
+                if isinstance(recv, ast.Name):
+                    return recv.id, stmt
+            return None
+
+        def scan_block(block: List[ast.AST]) -> None:
+            closes: List[Tuple[str, ast.AST]] = []
+            for stmt in block:
+                hit = close_stmt_attr(stmt)
+                if hit is not None:
+                    closes.append(hit)
+                for fname in ("body", "orelse", "finalbody"):
+                    sub = getattr(stmt, fname, None)
+                    if isinstance(sub, list):
+                        scan_block(sub)
+                for h in getattr(stmt, "handlers", []) or []:
+                    scan_block(h.body)
+                if isinstance(stmt, (ast.For, ast.AsyncFor, ast.While)):
+                    loop_hit = None
+                    for s in stmt.body:
+                        loop_hit = loop_hit or close_stmt_attr(s)
+                    if loop_hit is not None and \
+                            not _protected(loop_hit[1], m):
+                        findings.append(Finding(
+                            module.path, loop_hit[1].lineno,
+                            loop_hit[1].col_offset, self.id,
+                            f"close of `{loop_hit[0]}` inside a loop in "
+                            f"{m.name}() aborts the loop if it raises, "
+                            "skipping the remaining closeables — wrap it "
+                            "in try/except"))
+            for i in range(1, len(closes)):
+                prev_attr, prev_stmt = closes[i - 1]
+                attr, stmt = closes[i]
+                if prev_attr != attr and not _protected(prev_stmt, m):
+                    findings.append(Finding(
+                        module.path, stmt.lineno, stmt.col_offset, self.id,
+                        f"close of `{attr}` in {m.name}() is skipped when "
+                        f"the earlier close of `{prev_attr}` raises — "
+                        "wrap each sibling close (try/except or finally)"))
+
+        scan_block(list(m.body))
+
+    # ------------------------------------------------------------------ drive
+
+    def finish(self, modules: Sequence[Module]):
+        reg = build_registry(modules)
+        findings: List[Finding] = []
+        for module in modules:
+            if module.tree is None:
+                continue
+            for node in ast.walk(module.tree):
+                if not isinstance(node, ast.ClassDef):
+                    continue
+                methods = {n.name: n for n in node.body
+                           if isinstance(n, (ast.FunctionDef,
+                                             ast.AsyncFunctionDef))}
+                teardowns = [t for t in _TEARDOWN_METHODS if t in methods]
+                owned = self._owned_attrs(node, module, reg)
+                if owned and not teardowns:
+                    for attr, stmt, rescls in owned:
+                        findings.append(Finding(
+                            module.path, stmt.lineno, stmt.col_offset,
+                            self.id,
+                            f"class `{node.name}` acquires closeable "
+                            f"`self.{attr}` ({rescls}) but defines no "
+                            "close()/teardown method to release it"))
+                elif owned:
+                    released = self._released_attrs(node, methods, teardowns)
+                    for attr, stmt, rescls in owned:
+                        if attr not in released:
+                            findings.append(Finding(
+                                module.path, stmt.lineno, stmt.col_offset,
+                                self.id,
+                                f"`self.{attr}` ({rescls}) acquired by "
+                                f"`{node.name}` is never closed in its "
+                                f"teardown ({', '.join(teardowns)}) — the "
+                                "owner's close() must propagate"))
+                for t in teardowns:
+                    self._sibling_skips(methods[t], module, findings)
+        return findings

@@ -1,0 +1,616 @@
+"""resource-discipline: an acquire must be released on every exception edge.
+
+The registry of acquire/release pairs is LEARNED from the scanned tree, not
+hardcoded: any class defining a release-like method (``close``, ``release``,
+``cleanup``, ``shutdown``, ...) is a resource class, and any function or
+method whose body returns a fresh instance of one is an acquire producer
+(``SharedWorkerPool.client`` -> ``PoolClient``). Built-in handle factories
+(``open(..., "w")``, ``tempfile.NamedTemporaryFile``) seed the registry for
+types defined outside the tree.
+
+A local variable bound to an acquire is then checked along the enclosing
+function's exception edges (the CFG facts AST structure gives us:
+try/finally, with, return/raise ordering):
+
+- released under ``with`` or in a ``finally`` whose try region covers the
+  risky statements -> clean;
+- released only on the straight-line path with statements that can raise in
+  between -> finding (an exception between acquire and release leaks it);
+- never released and never escaping -> finding;
+- escaping (returned, yielded, stored on an object, handed to an unresolved
+  call) -> ownership transferred, no finding here; the `close-propagation`
+  pass audits owners that store closeables.
+
+Ledger-style pairs with no handle object (``pool.reserve_spill`` ↔
+``pool.clear_query``, ``trace.install`` ↔ ``trace.uninstall``) are checked
+whenever both ends appear on the same receiver in one function: the release
+end must be exception-protected. Interprocedural one level deep, sharing
+lock-discipline's resolution style (self-methods, module singletons, import
+aliases): a helper called with the resource as an argument counts as a
+release if its body releases that parameter; an unresolved callee is
+treated as an ownership transfer (precision over recall — this pass gates
+tier-1). The runtime half of this check is presto_tpu/utils/leaksan.py;
+tools/prestocheck/leakdiff.py maps its residue onto these findings.
+"""
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from ..core import Finding, Module, Pass, dotted_name, register, terminal_attr
+from .lock_discipline import _module_name
+
+# method names whose presence makes the defining class a *resource class*
+# (narrow on purpose: `clear_query`-style ledger methods do not make their
+# owner a closeable — constructing a MemoryPool acquires nothing)
+_CLASS_RELEASE_NAMES = ("close", "release", "cleanup", "shutdown",
+                        "terminate", "__exit__")
+# names accepted as a release *call* on an already-acquired resource
+_RELEASE_CALL_NAMES = frozenset(_CLASS_RELEASE_NAMES) | {"stop", "uninstall"}
+
+# ledger-style acquire/release name pairs (no handle object to track);
+# matched per function on a textually identical receiver
+_LEDGER_PAIRS = (("reserve", "clear_query"),
+                 ("reserve_spill", "clear_query"),
+                 ("install", "uninstall"))
+
+# handle factories from outside the tree: callee -> release method names
+_TEMPFILE_FACTORIES = {"NamedTemporaryFile": ("close",),
+                       "TemporaryFile": ("close",),
+                       "TemporaryDirectory": ("cleanup",)}
+_WRITE_MODE_CHARS = frozenset("wax+")
+
+_SETUP_METHODS = ("__init__", "__enter__", "open", "start", "setup")
+_TEARDOWN_METHODS = ("close", "release", "cleanup", "shutdown", "stop",
+                     "terminate", "teardown", "__exit__", "__del__")
+
+
+def _is_classish(name: Optional[str]) -> bool:
+    return bool(name) and name.lstrip("_")[:1].isupper()
+
+
+@dataclass
+class _ResFacts:
+    """Per-module registry facts, cached on the Module object so the two
+    resource passes (and leakdiff) share one extraction."""
+
+    modname: str
+    imports: Dict[str, str] = field(default_factory=dict)   # alias -> module
+    instances: Dict[str, str] = field(default_factory=dict)  # NAME -> Class
+    # class name -> method names it defines (ClassDefs in this module)
+    classes: Dict[str, Set[str]] = field(default_factory=dict)
+    # class name -> True when the class looks like an Exception subtype
+    exceptionish: Set[str] = field(default_factory=set)
+    # (cls or "", fn) -> set of class names a `return` hands back freshly
+    # constructed (producer candidates; filtered against the global
+    # resource-class set in finish)
+    returns_new: Dict[Tuple[str, str], Set[str]] = field(default_factory=dict)
+    # (cls or "", fn) -> def node, for one-level helper resolution
+    functions: Dict[Tuple[str, str], ast.AST] = field(default_factory=dict)
+
+
+def res_facts(module: Module) -> _ResFacts:
+    cached = getattr(module, "_res_facts", None)
+    if cached is not None:
+        return cached
+    facts = _ResFacts(_module_name(module.path))
+    mod_parts = facts.modname.split(".")
+    for node in ast.walk(module.tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                facts.imports[alias.asname
+                              or alias.name.split(".")[0]] = alias.name
+        elif isinstance(node, ast.ImportFrom):
+            if node.level:
+                if node.level > len(mod_parts):
+                    continue
+                base = mod_parts[:len(mod_parts) - node.level]
+                src = ".".join(base + (node.module.split(".")
+                                       if node.module else []))
+            else:
+                src = node.module or ""
+            if not src:
+                continue
+            for alias in node.names:
+                full = (f"{src}.{alias.name}"
+                        if node.module is None else src)
+                facts.imports[alias.asname or alias.name] = full
+    for stmt in module.tree.body:
+        if isinstance(stmt, ast.Assign) and isinstance(stmt.value, ast.Call):
+            cls = terminal_attr(stmt.value.func)
+            if _is_classish(cls):
+                for t in stmt.targets:
+                    if isinstance(t, ast.Name):
+                        facts.instances[t.id] = cls
+
+    def scan_fn(cls: str, fn: ast.AST) -> None:
+        facts.functions[(cls, fn.name)] = fn
+        fresh: Set[str] = set()        # locals assigned a fresh instance
+        returned: Set[str] = set()
+        for node in _walk_own(fn):
+            if isinstance(node, ast.Assign) and \
+                    isinstance(node.value, ast.Call):
+                c = terminal_attr(node.value.func)
+                if _is_classish(c):
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            fresh.add(t.id + ":" + c)
+            elif isinstance(node, ast.Return) and node.value is not None:
+                v = node.value
+                if isinstance(v, ast.Call):
+                    c = terminal_attr(v.func)
+                    if _is_classish(c):
+                        returned.add(c)
+                elif isinstance(v, ast.Name):
+                    for entry in fresh:
+                        name, _, c = entry.partition(":")
+                        if name == v.id:
+                            returned.add(c)
+        if returned:
+            facts.returns_new[(cls, fn.name)] = returned
+
+    for node in ast.walk(module.tree):
+        if isinstance(node, ast.ClassDef):
+            methods = {n.name for n in node.body
+                       if isinstance(n, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef))}
+            facts.classes[node.name] = methods
+            basenames = {terminal_attr(b) or "" for b in node.bases}
+            if any(b.endswith(("Error", "Exception")) for b in basenames):
+                facts.exceptionish.add(node.name)
+            for n in node.body:
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    scan_fn(node.name, n)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # module-level only; methods are scanned with their class above
+            pass
+    for stmt in module.tree.body:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            scan_fn("", stmt)
+    module._res_facts = facts
+    return facts
+
+
+class Registry:
+    """The tree-wide learned acquire/release registry."""
+
+    def __init__(self):
+        # class name -> release method names it exposes
+        self.resource_classes: Dict[str, Tuple[str, ...]] = {}
+        # (class, method) -> resource class the method hands back
+        self.method_producers: Dict[Tuple[str, str], str] = {}
+        # (module, function) -> resource class
+        self.modfn_producers: Dict[Tuple[str, str], str] = {}
+        # module-level singleton NAME -> class, merged tree-wide (SCAN_POOL
+        # is imported into the modules that call .client() on it)
+        self.instances: Dict[str, str] = {}
+
+
+def build_registry(modules: Sequence[Module]) -> Registry:
+    reg = Registry()
+    all_facts = [res_facts(m) for m in modules if m.tree is not None]
+    for facts in all_facts:
+        reg.instances.update(facts.instances)
+        for cls, methods in facts.classes.items():
+            if cls in facts.exceptionish:
+                continue
+            rels = tuple(r for r in _CLASS_RELEASE_NAMES if r in methods)
+            if rels:
+                reg.resource_classes[cls] = rels
+    for facts in all_facts:
+        for (cls, fn), returned in facts.returns_new.items():
+            for c in returned:
+                if c in reg.resource_classes:
+                    if cls:
+                        reg.method_producers[(cls, fn)] = c
+                    else:
+                        reg.modfn_producers[(facts.modname, fn)] = c
+                    break
+    return reg
+
+
+# --------------------------------------------------------------- AST helpers
+
+def _walk_own(fn: ast.AST) -> Iterable[ast.AST]:
+    """Walk a function body without entering nested function/class bodies
+    (their statements run in a different dynamic extent)."""
+    stack = list(fn.body) if hasattr(fn, "body") else []
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda, ast.ClassDef)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _parents_own(fn: ast.AST) -> Dict[ast.AST, ast.AST]:
+    parents: Dict[ast.AST, ast.AST] = {}
+    stack = [fn]
+    while stack:
+        node = stack.pop()
+        if node is not fn and isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda,
+                       ast.ClassDef)):
+            continue
+        for child in ast.iter_child_nodes(node):
+            parents[child] = node
+            stack.append(child)
+    return parents
+
+
+def _stmt_of(node: ast.AST, parents: Dict[ast.AST, ast.AST]) -> ast.AST:
+    cur = node
+    while cur in parents and not isinstance(cur, ast.stmt):
+        cur = parents[cur]
+    return cur
+
+
+def _block_of(stmt: ast.AST, parents: Dict[ast.AST, ast.AST]
+              ) -> Tuple[Optional[ast.AST], Optional[list]]:
+    """(parent node, the statement list that contains `stmt`)."""
+    parent = parents.get(stmt)
+    if parent is None:
+        return None, None
+    for fname in ("body", "orelse", "finalbody", "handlers"):
+        block = getattr(parent, fname, None)
+        if isinstance(block, list) and stmt in block:
+            return parent, block
+    return parent, None
+
+
+def _handler_nodes(fn: ast.AST) -> Set[int]:
+    """ids of every node inside an except-handler body (own walk)."""
+    out: Set[int] = set()
+    for node in _walk_own(fn):
+        if isinstance(node, ast.ExceptHandler):
+            stack = list(node.body)
+            while stack:
+                n = stack.pop()
+                out.add(id(n))
+                if not isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                      ast.Lambda, ast.ClassDef)):
+                    stack.extend(ast.iter_child_nodes(n))
+    return out
+
+
+def _releases_param(fn: ast.AST, idx: int) -> bool:
+    """Does helper `fn` release its idx-th positional parameter? (The one
+    interprocedural level the ISSUE budget buys.)"""
+    args = getattr(fn, "args", None)
+    if args is None:
+        return False
+    params = [a.arg for a in args.args]
+    if params and params[0] in ("self", "cls"):
+        idx += 1
+    if idx >= len(params):
+        return False
+    pname = params[idx]
+    for node in _walk_own(fn):
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr in _RELEASE_CALL_NAMES and \
+                isinstance(node.func.value, ast.Name) and \
+                node.func.value.id == pname:
+            return True
+    return False
+
+
+# ------------------------------------------------------------------ the pass
+
+@dataclass
+class _Acquire:
+    var: str
+    stmt: ast.Assign
+    rescls: str
+    releases: Tuple[str, ...]
+
+
+@register
+class ResourceDisciplinePass(Pass):
+    id = "resource-discipline"
+    description = ("acquired resource (learned acquire/release registry) "
+                   "not released on every exception edge")
+
+    def check_module(self, module: Module):
+        res_facts(module)     # build + cache registry facts; findings in
+        return ()             # finish() once the tree-wide registry exists
+
+    # ------------------------------------------------------------- resolution
+
+    def _acquire_of(self, value: ast.AST, facts: _ResFacts, reg: Registry,
+                    cls: str) -> Optional[Tuple[str, Tuple[str, ...]]]:
+        """(resource class, release names) for an acquiring expression."""
+        if isinstance(value, ast.IfExp):
+            return (self._acquire_of(value.body, facts, reg, cls)
+                    or self._acquire_of(value.orelse, facts, reg, cls))
+        if not isinstance(value, ast.Call):
+            return None
+        f = value.func
+        callee = dotted_name(f)
+        term = terminal_attr(f)
+        # builtin handle factories ---------------------------------------
+        if isinstance(f, ast.Name) and f.id == "open" or callee == "os.fdopen":
+            mode = None
+            if len(value.args) >= 2 and isinstance(value.args[1],
+                                                   ast.Constant):
+                mode = value.args[1].value
+            for kw in value.keywords:
+                if kw.arg == "mode" and isinstance(kw.value, ast.Constant):
+                    mode = kw.value.value
+            if isinstance(mode, str) and set(mode) & _WRITE_MODE_CHARS:
+                return ("file handle", ("close",))
+            return None
+        if term in _TEMPFILE_FACTORIES:
+            src = None
+            if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name):
+                src = facts.imports.get(f.value.id)
+            elif isinstance(f, ast.Name):
+                src = facts.imports.get(f.id)
+            if src == "tempfile":
+                return (f"tempfile.{term}", _TEMPFILE_FACTORIES[term])
+        # learned constructors -------------------------------------------
+        if term in reg.resource_classes and _is_classish(term):
+            return (term, reg.resource_classes[term])
+        # learned producers (lock-discipline's resolution kinds) ---------
+        produced: Optional[str] = None
+        if isinstance(f, ast.Name):
+            produced = reg.modfn_producers.get((facts.modname, f.id))
+            if produced is None and f.id in facts.imports:
+                src = facts.imports[f.id]
+                for (mod, fn), c in reg.modfn_producers.items():
+                    if fn == f.id and (mod == src
+                                       or mod.endswith("." + src)):
+                        produced = c
+                        break
+        elif isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name):
+            recv = f.value.id
+            if recv in ("self", "cls") and cls:
+                produced = reg.method_producers.get((cls, f.attr))
+            else:
+                recv_cls = facts.instances.get(recv) or \
+                    reg.instances.get(recv)
+                if recv_cls:
+                    produced = reg.method_producers.get((recv_cls, f.attr))
+                elif recv in facts.imports:
+                    src = facts.imports[recv]
+                    for (mod, fn), c in reg.modfn_producers.items():
+                        if fn == f.attr and (mod == src
+                                             or mod.endswith("." + src)):
+                            produced = c
+                            break
+        if produced:
+            return (produced, reg.resource_classes[produced])
+        return None
+
+    # --------------------------------------------------------------- analysis
+
+    def _guaranteed(self, rel_node: ast.AST, acq_stmt: ast.AST,
+                    parents: Dict[ast.AST, ast.AST], fn: ast.AST,
+                    handler_ids: Set[int]) -> bool:
+        """Is this release reached on every exception edge out of the
+        acquire's risky region? True for `with` items and for releases in a
+        `finally` whose try covers — or follows with nothing that can raise
+        in between — the acquire. Except-handler bodies between the two do
+        not count as risky: before the acquire completes there is nothing
+        to leak, and a raising statement after it is counted where it
+        lexically sits (the try body)."""
+        if isinstance(rel_node, ast.withitem):
+            return True
+        rel_stmt = _stmt_of(rel_node, parents)
+        acq_end = getattr(acq_stmt, "end_lineno", acq_stmt.lineno)
+        cur: Optional[ast.AST] = rel_stmt
+        while cur is not None:
+            parent = parents.get(cur)
+            if isinstance(parent, ast.Try) and cur in parent.finalbody:
+                # case (a): acquire inside this try's body
+                probe: Optional[ast.AST] = acq_stmt
+                while probe is not None:
+                    if probe is parent:
+                        return True
+                    probe = parents.get(probe)
+                # case (b): the try starts after the acquire with nothing
+                # risky in between (the acquire may sit inside a preceding
+                # try/except-reraise of its own)
+                if parent.lineno >= acq_stmt.lineno and not any(
+                        isinstance(n, (ast.Call, ast.Raise, ast.Assert,
+                                       ast.Await))
+                        and acq_end < n.lineno < parent.lineno
+                        and id(n) not in handler_ids
+                        for n in _walk_own(fn)):
+                    return True
+            cur = parent
+        return False
+
+    def _check_function(self, fn: ast.AST, module: Module, facts: _ResFacts,
+                        reg: Registry, cls: str,
+                        findings: List[Finding]) -> None:
+        parents = _parents_own(fn)
+        acquires: List[_Acquire] = []
+        for node in _walk_own(fn):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                target = node.targets[0]
+                acq = self._acquire_of(node.value, facts, reg, cls)
+                if acq is None:
+                    continue
+                rescls, rels = acq
+                if isinstance(target, ast.Name):
+                    acquires.append(_Acquire(target.id, node, rescls, rels))
+            elif isinstance(node, ast.Expr) and \
+                    isinstance(node.value, ast.Call):
+                acq = self._acquire_of(node.value, facts, reg, cls)
+                if acq is not None:
+                    findings.append(Finding(
+                        module.path, node.lineno, node.col_offset, self.id,
+                        f"result of {acq[0]} acquire is discarded — the "
+                        "resource can never be released"))
+        handler_ids = _handler_nodes(fn)
+        for a in acquires:
+            self._check_acquire(a, fn, module, facts, cls, parents,
+                                handler_ids, findings)
+        self._check_ledger_pairs(fn, module, parents, handler_ids, findings)
+
+    def _check_acquire(self, a: _Acquire, fn: ast.AST, module: Module,
+                       facts: _ResFacts, cls: str,
+                       parents: Dict[ast.AST, ast.AST],
+                       handler_ids: Set[int],
+                       findings: List[Finding]) -> None:
+        rel_names = set(a.releases) | {"close", "release"}
+        releases: List[ast.AST] = []
+        escaped = False
+        for node in _walk_own(fn):
+            if not (isinstance(node, ast.Name) and node.id == a.var):
+                continue
+            if isinstance(node.ctx, ast.Store):
+                if _stmt_of(node, parents) is not a.stmt:
+                    escaped = True    # rebinding: lifetime leaves our sight
+                continue
+            if node.lineno < a.stmt.lineno:
+                continue
+            parent = parents.get(node)
+            # v.rel() --------------------------------------------------
+            if isinstance(parent, ast.Attribute):
+                gp = parents.get(parent)
+                if isinstance(gp, ast.Call) and gp.func is parent and \
+                        parent.attr in rel_names:
+                    releases.append(gp)
+                continue     # any other v.m() use: owned, risky, fine
+            # with v: / with closing(v) as f: -------------------------
+            if isinstance(parent, ast.withitem) and \
+                    parent.context_expr is node:
+                releases.append(parent)
+                continue
+            if isinstance(parent, ast.Call) and node in parent.args:
+                f = parent.func
+                closingish = terminal_attr(f) in ("closing", "ExitStack",
+                                                  "suppress")
+                gp = parents.get(parent)
+                if closingish and isinstance(gp, ast.withitem):
+                    releases.append(gp)
+                    continue
+                helper = None
+                if isinstance(f, ast.Name):
+                    helper = facts.functions.get(("", f.id))
+                elif isinstance(f, ast.Attribute) and \
+                        isinstance(f.value, ast.Name) and \
+                        f.value.id in ("self", "cls") and cls:
+                    helper = facts.functions.get((cls, f.attr))
+                if helper is not None and _releases_param(
+                        helper, parent.args.index(node)):
+                    releases.append(parent)
+                    continue
+                escaped = True    # handed to a call we can't see through
+                continue
+            # return v / yield v / stored somewhere -> ownership moves
+            cur = parent
+            while cur is not None and not isinstance(cur, ast.stmt):
+                cur = parents.get(cur)
+            if isinstance(cur, (ast.Return, ast.Expr)) and \
+                    isinstance(getattr(cur, "value", None),
+                               (ast.Yield, ast.YieldFrom)):
+                escaped = True
+            elif isinstance(cur, ast.Return):
+                escaped = True
+            elif isinstance(cur, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                escaped = True
+            elif isinstance(parent, (ast.Tuple, ast.List, ast.Set, ast.Dict,
+                                     ast.Yield, ast.YieldFrom)):
+                escaped = True
+        if escaped:
+            return
+        if not releases:
+            findings.append(Finding(
+                module.path, a.stmt.lineno, a.stmt.col_offset, self.id,
+                f"`{a.var}` ({a.rescls}) is acquired but never released on "
+                "any path, and never escapes this function"))
+            return
+        if any(self._guaranteed(r, a.stmt, parents, fn, handler_ids)
+               for r in releases):
+            return
+        first = min(releases, key=lambda r: getattr(r, "lineno", 10 ** 9))
+        lo = getattr(a.stmt, "end_lineno", a.stmt.lineno)
+        hi = getattr(first, "lineno", lo)
+        risky = None
+        for node in _walk_own(fn):
+            if isinstance(node, (ast.Call, ast.Raise, ast.Assert,
+                                 ast.Await)) and \
+                    lo < node.lineno < hi and node not in releases and \
+                    id(node) not in handler_ids:
+                risky = node
+                break
+        if risky is not None:
+            findings.append(Finding(
+                module.path, a.stmt.lineno, a.stmt.col_offset, self.id,
+                f"`{a.var}` ({a.rescls}) is released only on the happy "
+                "path — an exception before the release leaks it; move "
+                "the release into `finally` or use `with`"))
+
+    def _check_ledger_pairs(self, fn: ast.AST, module: Module,
+                            parents: Dict[ast.AST, ast.AST],
+                            handler_ids: Set[int],
+                            findings: List[Finding]) -> None:
+        calls: Dict[Tuple[str, str], List[ast.Call]] = {}
+        for node in _walk_own(fn):
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute):
+                recv = dotted_name(node.func.value)
+                if recv:
+                    calls.setdefault((recv, node.func.attr),
+                                     []).append(node)
+        for acq_name, rel_name in _LEDGER_PAIRS:
+            for (recv, name), acq_nodes in list(calls.items()):
+                if name != acq_name:
+                    continue
+                rel_nodes = calls.get((recv, rel_name))
+                if not rel_nodes:
+                    continue
+                acq = min(acq_nodes, key=lambda n: n.lineno)
+                if any(self._guaranteed(r, _stmt_of(acq, parents), parents,
+                                        fn, handler_ids)
+                       for r in rel_nodes):
+                    continue
+                first = min(rel_nodes, key=lambda n: n.lineno)
+                risky = any(
+                    isinstance(n, (ast.Call, ast.Raise, ast.Assert,
+                                   ast.Await))
+                    and acq.lineno < n.lineno < first.lineno
+                    and n not in rel_nodes
+                    and id(n) not in handler_ids
+                    for n in _walk_own(fn))
+                if risky:
+                    findings.append(Finding(
+                        module.path, first.lineno, first.col_offset,
+                        self.id,
+                        f"`{recv}.{rel_name}()` paired with "
+                        f"`{recv}.{acq_name}()` is not exception-protected "
+                        "— a raise between them leaks the accounting; move "
+                        f"the {rel_name}() into `finally`"))
+
+    # ------------------------------------------------------------------ drive
+
+    def finish(self, modules: Sequence[Module]):
+        reg = build_registry(modules)
+        findings: List[Finding] = []
+        for module in modules:
+            if module.tree is None:
+                continue
+            facts = res_facts(module)
+            for fn, cls in iter_functions(module.tree):
+                self._check_function(fn, module, facts, reg, cls, findings)
+        return findings
+
+
+def iter_functions(tree: ast.AST) -> Iterable[Tuple[ast.AST, str]]:
+    """Every function/method def (nested ones included — a release closure
+    is a function too), paired with its enclosing class name or ''."""
+    stack = [(n, "") for n in tree.body]
+    while stack:
+        node, cls = stack.pop()
+        if isinstance(node, ast.ClassDef):
+            stack.extend((c, node.name) for c in node.body)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node, cls
+            stack.extend((c, cls) for c in node.body)
+        else:
+            stack.extend((c, cls) for c in ast.iter_child_nodes(node))
