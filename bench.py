@@ -790,6 +790,151 @@ def bench_chaos() -> dict:
     return out
 
 
+def bench_churn(schema: str = "tiny") -> dict:
+    """Membership-churn rung (reported, never gated): a high-cardinality
+    aggregation on an in-process 2-worker HTTP cluster, run (a) clean and
+    (b) with the membership changing mid-query — once the query is
+    mid-stream (a consumer has acked chunk 0 of the victim's leaf output)
+    a THIRD worker joins AND the victim is gracefully drained. Unlike the
+    chaos rung's kill, a planned drain must be invisible: the victim's
+    tasks are handed to replacements via the exactly-once replay splice,
+    so rows stay identical AND `query_attempts == 1` — no query-level
+    retry, no 410. Reports recovery overhead vs clean, the drain handoff
+    summary, and the peak spooled bytes (overall + inside the drain
+    window, where the pinned spools do the replaying)."""
+    import threading as _th
+    import urllib.request as _rq
+
+    from presto_tpu.cluster import faults
+    from presto_tpu.cluster.coordinator import ClusterQueryRunner
+    from presto_tpu.cluster.scheduler import _remote_source_ids
+    from presto_tpu.cluster.worker import WorkerServer
+    from presto_tpu.metadata import Session
+    from presto_tpu.runner import LocalQueryRunner
+
+    sql = ("select l_suppkey, count(*), sum(l_quantity) "
+           "from lineitem group by l_suppkey")
+    want_rows = sorted(LocalQueryRunner(
+        session=Session(catalog="tpch", schema=schema)).execute(sql).rows)
+
+    def run_mode(mode: str) -> dict:
+        props = {"retry_policy": "TASK",
+                 "exchange_flush_rows": 512,
+                 "retry_initial_delay_s": 0.01,
+                 "retry_max_delay_s": 0.05}
+        runner = ClusterQueryRunner(
+            session=Session(catalog="tpch", schema=schema, properties=props),
+            min_workers=2, worker_wait_s=10.0)
+        workers = [WorkerServer(port=0).start() for _ in range(2)]
+        stop = _th.Event()
+        for w in workers:
+            runner.nodes.announce(w.node_id, w.uri)
+
+        def keep_alive():
+            # re-announce while ACTIVE or DRAINING (a draining node still
+            # serves its streams); stop at DRAINED — drain_worker removed it
+            # from discovery and announcing again would resurrect it
+            while not stop.wait(0.5):
+                for w in list(workers):
+                    if w.state in ("ACTIVE", "DRAINING"):
+                        runner.nodes.announce(w.node_id, w.uri)
+
+        _th.Thread(target=keep_alive, daemon=True).start()
+        sub = runner.plan_sql(sql)
+        leaf = next(f.id for f in sub.fragments
+                    if not _remote_source_ids(f.root)
+                    and f.id != sub.root_fragment.id)
+        drain_result: dict = {}
+        drain_window = [0.0, 0.0]
+        churned = _th.Event()
+        if mode == "churn":
+            victim = min(workers, key=lambda w: w.node_id)
+
+            def churn_async():
+                # membership change off the handler thread: ADD a worker,
+                # then gracefully DRAIN the victim mid-stream. drain_worker
+                # re-places the victim's tasks through the replay splice
+                # and deregisters the node once it reports DRAINED.
+                drain_window[0] = time.time()
+                joiner = WorkerServer(port=0).start()
+                workers.append(joiner)
+                runner.nodes.announce(joiner.node_id, joiner.uri)
+                drain_result.update(runner.drain_worker(
+                    victim.node_id, signal={"trigger": "churn-rung"}))
+                drain_window[1] = time.time()
+
+            def trigger(ctx):
+                token = int(ctx["path"].partition("?")[0]
+                            .rstrip("/").rsplit("/", 1)[-1])
+                if token < 1 or churned.is_set():
+                    return
+                churned.set()
+                _th.Thread(target=churn_async, daemon=True).start()
+
+            # fire only once a consumer asks for token >= 1 of the victim's
+            # leaf stream: chunk 0 was delivered AND acked by then, so the
+            # drain handoff must splice mid-stream from the pinned spool.
+            # The callback raises nothing — it only triggers the churn.
+            inj = faults.FaultInjector(seed=29)
+            inj.add("worker.results", faults.CALLBACK,
+                    node_id=victim.node_id, task_re=rf"\.{leaf}\.0$",
+                    times=None, callback=trigger)
+            faults.install(inj)
+
+        spool_peak = [0, 0]  # overall, inside the drain window
+        mon_stop = _th.Event()
+
+        def spool_monitor():
+            while not mon_stop.wait(0.05):
+                now = time.time()
+                for w in list(workers):
+                    if w.state == "SHUT_DOWN":
+                        continue
+                    try:
+                        with _rq.urlopen(f"{w.uri}/v1/status",
+                                         timeout=1.0) as r:
+                            st = json.loads(r.read())
+                        b = int(st.get("spooledBytes") or 0)
+                        spool_peak[0] = max(spool_peak[0], b)
+                        if drain_window[0] and now >= drain_window[0] \
+                                and not drain_window[1]:
+                            spool_peak[1] = max(spool_peak[1], b)
+                    except Exception:  # noqa: BLE001 - monitor is best-effort
+                        pass
+
+        _th.Thread(target=spool_monitor, daemon=True).start()
+        t0 = time.time()
+        try:
+            got = runner.execute(sql)
+            wall = time.time() - t0
+        finally:
+            mon_stop.set()
+            stop.set()
+            faults.clear()
+            runner.detector.stop()
+            for w in list(workers):
+                w.stop()
+        entry = {"wall_s": round(wall, 3),
+                 "rows_match": sorted(got.rows) == want_rows,
+                 "query_attempts": got.stats.get("query_attempts"),
+                 "task_retries": got.stats.get("task_retries"),
+                 "spooled_bytes_peak": spool_peak[0]}
+        if mode == "churn":
+            entry["churn_fired"] = churned.is_set()
+            entry["drain"] = drain_result or None
+            entry["spooled_bytes_peak_drain_window"] = spool_peak[1]
+        return entry
+
+    out = {"schema": schema}
+    for mode in ("clean", "churn"):
+        out[mode] = run_mode(mode)
+    clean = out["clean"].get("wall_s")
+    churn_wall = out["churn"].get("wall_s")
+    if clean and churn_wall:
+        out["recovery_overhead_x"] = round(churn_wall / clean, 3)
+    return out
+
+
 def bench_spill(quick: bool = False) -> dict:
     """Spill rung (reported, never gated): TPC-H Q1 and Q3 run uncapped,
     then under a `memory_pool_bytes` cap far smaller than their live hash
@@ -1071,6 +1216,13 @@ def compare_benches(prev: dict, cur: dict,
         p = (pd.get("chaos") or {}).get(key) or {}
         c = (cd.get("chaos") or {}).get(key) or {}
         record(f"chaos.{key}", p, c, gate=False)
+    # churn rung: the churn wall includes a live drain handoff and the
+    # clean/churn pair is the signal — reported for trend-watching, never
+    # gated
+    for key in ("clean", "churn"):
+        p = (pd.get("churn") or {}).get(key) or {}
+        c = (cd.get("churn") or {}).get(key) or {}
+        record(f"churn.{key}", p, c, gate=False)
     # spill rung: capped walls are dominated by spill I/O and revocation
     # cadence, not engine speed — reported for trend-watching, never gated
     for key in ("q1", "q3"):
@@ -1240,6 +1392,14 @@ def main():
         detail["chaos"] = bench_chaos()
     except Exception as e:
         detail["chaos"] = {"error": repr(e)[:300]}
+
+    # churn rung: mid-query membership change (worker joins + graceful
+    # drain of a serving worker) — the planned-drain counterpart of the
+    # chaos kill; must hold query_attempts == 1 (reported, never gated)
+    try:
+        detail["churn"] = bench_churn()
+    except Exception as e:
+        detail["churn"] = {"error": repr(e)[:300]}
 
     # spill rung: Q1+Q3 under a memory cap must complete via the disk tier
     # with identical rows — capped walls and spill traffic ride along with

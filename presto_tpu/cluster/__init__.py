@@ -10,7 +10,8 @@ TPU slice, fragments execute as shard_map collectives over ICI; ACROSS hosts,
 this package ships serialized page frames over HTTP — the reference's
 HTTP+LZ4 data plane (operator/ExchangeClient.java) mapped onto the DCN tier,
 where XLA collectives are not available."""
-__all__ = ["ClusterQueryRunner", "WorkerServer", "Backoff", "FaultInjector"]
+__all__ = ["ClusterQueryRunner", "WorkerServer", "Backoff", "FaultInjector",
+           "WorkerPoolAutoscaler"]
 
 
 def __getattr__(name):  # lazy: `python -m presto_tpu.cluster.worker` must not
@@ -26,4 +27,7 @@ def __getattr__(name):  # lazy: `python -m presto_tpu.cluster.worker` must not
     if name == "FaultInjector":
         from .faults import FaultInjector
         return FaultInjector
+    if name == "WorkerPoolAutoscaler":
+        from .autoscaler import WorkerPoolAutoscaler
+        return WorkerPoolAutoscaler
     raise AttributeError(name)

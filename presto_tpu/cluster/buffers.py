@@ -189,6 +189,7 @@ class OutputBuffer:
         self._no_more = False
         self._failed: Optional[str] = None
         self._nonreplayable: Optional[str] = None
+        self._spool_pinned = False  # drain: never retire the replay window
 
     # ------------------------------------------------------------- producer
 
@@ -272,9 +273,39 @@ class OutputBuffer:
             except Exception:  # noqa: BLE001 - accounting must not poison I/O
                 pass
 
+    def pin_spool(self) -> None:
+        """Drain support: stop retiring acked frames even when the spool is
+        over its bound, so every live stream's replay window stays COMPLETE
+        while consumers are handed to a replacement task. The window is
+        short (the drain re-places producers within seconds) and the bytes
+        stay accounted in the shared pool, so the overshoot is observable."""
+        with self._cv:
+            self._spool_pinned = True
+
+    def output_drained(self) -> bool:
+        """No live consumer depends on FUTURE pulls from this buffer: every
+        stream was fully delivered and acked (complete streams keep their
+        spool for replay, which nobody will need) or explicitly released.
+        The drain machine's per-task gate — a FINISHED task still serving
+        chunks pins its node in DRAINING until consumers catch up or are
+        handed to replacements."""
+        with self._cv:
+            return all(b._aborted or (b._no_more and b._ack >= b._next_token)
+                       for b in self._buffers)
+
+    def replayable_all(self) -> bool:
+        """Every stream of this buffer can still replay from token 0 — the
+        per-task drain-progress signal (a handoff is exactly-once only while
+        this holds)."""
+        with self._cv:
+            return not self._nonreplayable and \
+                all(not b.replay_lost and b._floor == 0 for b in self._buffers)
+
     def _trim_spool_locked(self) -> None:
         """Retire oldest-acked frames until the spool fits its bound, biggest
         spooler first (deterministic tie-break by buffer index)."""
+        if self._spool_pinned:
+            return
         while self._spool_bytes > self._spool_max:
             victim = max(self._buffers, key=lambda b: b.spooled_bytes_locked())
             freed = victim.drop_oldest_spooled_locked()

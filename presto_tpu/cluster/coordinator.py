@@ -25,8 +25,10 @@ cluster.task_retries, cluster.faults_injected, cluster.backoff_seconds)."""
 from __future__ import annotations
 
 import itertools
+import json
 import threading
 import time
+import urllib.request
 from typing import Dict, List, Optional, Set
 
 from ..metadata import CatalogManager, Session
@@ -77,6 +79,77 @@ class ClusterQueryRunner:
         if sched is not None:
             sched.abort()
 
+    # ------------------------------------------------------- cluster lifecycle
+
+    def drain_worker(self, node_id: str, signal: Optional[dict] = None,
+                     wait_s: float = 60.0) -> dict:
+        """Gracefully remove one worker with zero queries lost: mark it
+        unschedulable, tell it to DRAIN (refuse new tasks, pin spools),
+        proactively hand its live tasks to replacements through the
+        mid-stream replay path (exactly-once splice — a PLANNED drain never
+        410-escalates), wait for the node to report DRAINED, then deregister
+        it from discovery. `signal` is journaled on `node.draining` so the
+        record says WHY the node was drained (autoscaler pressure reading,
+        rolling upgrade, operator action)."""
+        from ..utils import events
+
+        node = self.nodes.get(node_id)
+        if node is None:
+            raise ValueError(f"unknown worker node {node_id!r}")
+        self.nodes.set_draining(node_id, True)
+        events.emit("node.draining", severity=events.WARN, node=node_id,
+                    signal=signal or {})
+        try:
+            req = urllib.request.Request(f"{node.uri}/v1/info/state",
+                                         data=b'"DRAINING"', method="PUT")
+            urllib.request.urlopen(req, timeout=5.0).read()
+        except Exception:  # noqa: BLE001 - unreachable worker: the
+            pass           # schedulable gate + task sweep below still drain it
+
+        def sweep() -> tuple:
+            moved = left = 0
+            active = self.nodes.active_nodes()
+            for sched in list(self._schedulers.values()):
+                m, l_ = sched.drain_node(node_id, active)
+                moved += m
+                left += l_
+            return moved, left
+
+        from .retry import Backoff
+
+        moved, left = sweep()
+        state = self._worker_state(node)
+        deadline = time.monotonic() + wait_s
+        backoff = Backoff(initial_delay_s=0.05, max_delay_s=0.25)
+        while state == "DRAINING" and time.monotonic() < deadline:
+            backoff.failure()
+            backoff.wait()
+            # keep sweeping: a task created between the gate and the first
+            # sweep, or one whose handoff was refused, must not wedge the
+            # drain while its query still runs
+            m, left = sweep()
+            moved += m
+            state = self._worker_state(node)
+        drained = state in ("DRAINED", "SHUT_DOWN")
+        self.nodes.remove(node_id)
+        events.emit("node.drained", severity=events.INFO, node=node_id,
+                    drained=drained, state=state or "UNREACHABLE",
+                    tasks_handed_off=moved, signal=signal or {})
+        return {"node": node_id, "drained": drained,
+                "state": state or "UNREACHABLE", "tasks_handed_off": moved,
+                "tasks_left_in_place": left}
+
+    @staticmethod
+    def _worker_state(node: NodeInfo) -> Optional[str]:
+        """GET /v1/info/state — the drain-progress poll. None = unreachable
+        (a worker that died mid-drain; discovery expiry owns that case)."""
+        try:
+            with urllib.request.urlopen(f"{node.uri}/v1/info/state",
+                                        timeout=2.0) as resp:
+                return json.loads(resp.read()).get("state")
+        except Exception:  # noqa: BLE001 - dead node reads as UNREACHABLE
+            return None
+
     @property
     def metadata(self):
         return self.local.metadata
@@ -97,7 +170,7 @@ class ClusterQueryRunner:
         planner = LogicalPlanner(self.metadata, self.session)
         plan = planner.plan(stmt)
         plan = optimize(plan, self.metadata, self.session)
-        n = max(len(self.nodes.active_nodes()), 1)
+        n = max(len(self.nodes.schedulable_nodes()), 1)
         plan = add_exchanges(plan, planner.symbols, self.metadata, self.session,
                              n_workers=n)
         return fragment_plan(plan)
@@ -112,7 +185,9 @@ class ClusterQueryRunner:
         deadline = time.monotonic() + self.worker_wait_s
         backoff = Backoff(initial_delay_s=0.02, max_delay_s=0.25)
         while True:
-            nodes = self.nodes.active_nodes()
+            # placement view: draining nodes are alive (they keep serving
+            # their spooled streams) but never receive new tasks
+            nodes = self.nodes.schedulable_nodes()
             if exclude:
                 eligible = [n for n in nodes if n.node_id not in exclude]
                 # all survivors excluded = exclusion starved placement;

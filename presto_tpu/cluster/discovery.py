@@ -33,6 +33,10 @@ class NodeInfo:
     uri: str
     last_announce: float
     failure_ratio: float = 0.0
+    # planned drain (cluster lifecycle): the node is ALIVE — consumers keep
+    # pulling its spooled streams — but must receive no new placements.
+    # Distinct from failure_ratio gating: a draining node is healthy.
+    draining: bool = False
 
 
 class DiscoveryNodeManager:
@@ -48,6 +52,9 @@ class DiscoveryNodeManager:
             if node is None:
                 self._nodes[node_id] = NodeInfo(node_id, uri, time.monotonic())
             else:
+                # a re-announce refreshes liveness but never clears a drain:
+                # only remove() (DRAINED teardown) resets it, so a rejoining
+                # upgraded worker comes back schedulable under a fresh entry
                 node.uri = uri
                 node.last_announce = time.monotonic()
 
@@ -55,17 +62,39 @@ class DiscoveryNodeManager:
         with self._lock:
             self._nodes.pop(node_id, None)
 
+    def get(self, node_id: str) -> Optional[NodeInfo]:
+        with self._lock:
+            return self._nodes.get(node_id)
+
+    def set_draining(self, node_id: str, draining: bool = True) -> bool:
+        """Mark a node as draining (unschedulable but alive). False = the
+        node is unknown."""
+        with self._lock:
+            node = self._nodes.get(node_id)
+            if node is None:
+                return False
+            node.draining = bool(draining)
+            return True
+
     def all_nodes(self) -> List[NodeInfo]:
         with self._lock:
             return list(self._nodes.values())
 
     def active_nodes(self) -> List[NodeInfo]:
-        """Announced recently AND not gated by the failure detector."""
+        """Announced recently AND not gated by the failure detector.
+        DRAINING nodes are included: they are alive and still serve their
+        spooled exchange streams — treating them as dead would misread a
+        planned drain as a node death mid-query. Placement must use
+        schedulable_nodes()."""
         now = time.monotonic()
         with self._lock:
             return [n for n in self._nodes.values()
                     if now - n.last_announce < _EXPIRE_S
                     and n.failure_ratio < _FAILURE_RATIO_THRESHOLD]
+
+    def schedulable_nodes(self) -> List[NodeInfo]:
+        """Active AND not draining: the placement view of the cluster."""
+        return [n for n in self.active_nodes() if not n.draining]
 
 
 class HeartbeatFailureDetector:
@@ -161,6 +190,22 @@ class Announcer:
                 print(f"presto_tpu worker {self.node_id}: announcement to "
                       f"{self.coordinator_uri} failing ({n}x): {e!r}",
                       file=sys.stderr, flush=True)
+
+    def deregister(self) -> bool:
+        """Explicitly remove this node from the coordinator's registry
+        (DELETE /v1/announcement/{nodeId}) — the DRAINED handoff. Without
+        this, a stopped announcer leaves the node ACTIVE in discovery until
+        heartbeat decay gates it out, a full detector window in which the
+        scheduler keeps placing tasks at a gone worker. Best-effort: the
+        coordinator may already be down, and expiry still cleans up."""
+        req = urllib.request.Request(
+            f"{self.coordinator_uri}/v1/announcement/{self.node_id}",
+            method="DELETE")
+        try:
+            urllib.request.urlopen(req, timeout=5.0).read()
+            return True
+        except Exception:  # noqa: BLE001 - expiry is the fallback path
+            return False
 
     def _loop(self) -> None:
         while not self._stop.wait(_ANNOUNCE_PERIOD_S):

@@ -56,7 +56,17 @@ class ClusterMemoryManager:
         self.last_total = 0
         self.last_by_query: Dict[str, int] = {}
         self.last_revocable: Dict[str, int] = {}
+        # per-node activity from the same poll — the autoscaler's pressure
+        # feed (it must never add its own status-poll storm on top of this
+        # monitor loop's)
+        self.last_active_tasks: Dict[str, int] = {}
+        self.last_spooled: Dict[str, int] = {}
         self.killed: List[str] = []
+
+    def saturation(self) -> float:
+        """Cluster memory pressure 0.0..: last polled total reserved bytes
+        over the limit (>=1.0 means the OOM ladder is in play)."""
+        return self.last_total / self.limit_bytes if self.limit_bytes else 0.0
 
     # ------------------------------------------------------------------ api
 
@@ -73,19 +83,23 @@ class ClusterMemoryManager:
         by_query: Dict[str, int] = {}
         revocable: Dict[str, int] = {}
         per_node: Dict[str, Dict[str, int]] = {}
+        active_tasks: Dict[str, int] = {}
+        spooled: Dict[str, int] = {}
         total = 0
         for node in self.nodes.active_nodes():
             try:
                 status = self._fetch(node.uri)
             except Exception:  # noqa: BLE001 - dead nodes are the detector's job
                 continue
+            name = getattr(node, "node_id", None) or getattr(node, "uri", "?")
+            active_tasks[name] = int(status.get("activeTasks") or 0)
+            spooled[name] = int(status.get("spooledBytes") or 0)
             node_mem = {qid: int(b)
                         for qid, b in (status.get("queryMemory") or {}).items()}
             if node_mem:
-                # tolerate minimal node stand-ins (tests inject bare
+                # `name` tolerates minimal node stand-ins (tests inject bare
                 # uri-only objects); the uri always identifies the worker
-                per_node[getattr(node, "node_id", None)
-                         or getattr(node, "uri", "?")] = node_mem
+                per_node[name] = node_mem
             for qid, b in node_mem.items():
                 by_query[qid] = by_query.get(qid, 0) + b
                 total += b
@@ -94,6 +108,8 @@ class ClusterMemoryManager:
         self.last_total = total
         self.last_by_query = by_query
         self.last_revocable = revocable
+        self.last_active_tasks = active_tasks
+        self.last_spooled = spooled
         if total <= self.limit_bytes or not by_query:
             self._over_count = 0
             self._revoke_requested = False

@@ -38,6 +38,7 @@ from __future__ import annotations
 import dataclasses
 import http.client
 import statistics
+import threading
 import time
 import urllib.error
 import urllib.request
@@ -107,6 +108,16 @@ class RemoteTask:
                     raise RuntimeError(
                         f"worker {self.node.node_id} rejected task "
                         f"{self.task_id} ({e.code}): {detail}") from None
+                if e.code == 503 and "shutting down" in detail:
+                    # a DRAINING worker refuses placement by POLICY, not by
+                    # transient overload: retrying against it would burn the
+                    # whole backoff budget before the re-place. Escalate now
+                    # so _create_task excludes the node and re-places on a
+                    # healthy one immediately.
+                    raise retry.ClusterExecutionError(
+                        f"worker {self.node.node_id} is draining "
+                        f"(503 shutting down) for task {self.task_id}",
+                        node_id=self.node.node_id, retryable=True)
                 last = RuntimeError(f"HTTP {e.code}: {detail}")
             except (urllib.error.URLError, OSError) as e:
                 last = e
@@ -236,6 +247,10 @@ class SqlQueryScheduler:
         # self.stages, so check_failures never treats their failure as fatal.
         self._live_spec: Dict[Tuple[int, int], Tuple[str, RemoteTask]] = {}
         self._spec_done: Set[str] = set()  # base ids speculated once already
+        # serializes stage.tasks mutations between the pull loop's monitor
+        # (check_failures / maybe_speculate) and a concurrent planned drain
+        # (drain_node, called from the coordinator/autoscaler thread)
+        self._monitor_lock = threading.RLock()
 
     def _consumer_task_counts(self) -> Dict[int, int]:
         """fragment id -> number of tasks of its consuming fragment."""
@@ -353,7 +368,8 @@ class SqlQueryScheduler:
     def _pick_node(self, exclude: Set[str]) -> Optional[NodeInfo]:
         candidates = [node for node in self.selector.nodes
                       if node.node_id not in exclude
-                      and node.node_id not in self.excluded_nodes]
+                      and node.node_id not in self.excluded_nodes
+                      and not node.draining]
         if not candidates:
             return None
         # weigh the decayed failure ratio: re-place onto the node with the
@@ -391,6 +407,20 @@ class SqlQueryScheduler:
         active_ids = ({n.node_id for n in active_nodes}
                       if active_nodes is not None else None)
         pending: List[retry.ClusterExecutionError] = []
+        with self._monitor_lock:
+            self._check_failures_locked(active_ids, active_nodes, recover,
+                                        pending)
+        if pending:
+            # a dead NODE is the root cause; a FAILED task on a healthy node
+            # is often just a consumer of the dead node's stream — raise the
+            # node death first so retry placement excludes the right node
+            for failure in pending:
+                if isinstance(failure, NodeDiedError):
+                    raise failure
+            raise pending[0]
+
+    def _check_failures_locked(self, active_ids, active_nodes, recover,
+                               pending) -> None:
         for stage in self.stages.values():
             for idx, task in enumerate(stage.tasks):
                 info = task.poll_info()
@@ -425,19 +455,47 @@ class SqlQueryScheduler:
                     task_id=task.task_id, node=task.node.node_id,
                     message=str(failure)[:300])
                 pending.append(failure)
-        if pending:
-            # a dead NODE is the root cause; a FAILED task on a healthy node
-            # is often just a consumer of the dead node's stream — raise the
-            # node death first so retry placement excludes the right node
-            for failure in pending:
-                if isinstance(failure, NodeDiedError):
-                    raise failure
-            raise pending[0]
+
+    # ---------------------------------------------------------------- drain
+
+    def drain_node(self, node_id: str,
+                   active_nodes: List[NodeInfo]) -> Tuple[int, int]:
+        """Planned drain: proactively hand every live task on `node_id` to a
+        replacement through the same mid-stream replay path failure recovery
+        uses — exactly-once splice, consumers keep their chunk cursors, no
+        410 escalation (the drained worker's spools are pinned and intact).
+        Deliberately NOT gated on retry_policy: a drain is an operator
+        action, and "zero queries lost" must hold for NONE-policy tenants
+        too. Tasks recovery cannot move (attempt budget exhausted, root
+        consumer not yet registered) are left to finish naturally on the
+        draining node — it keeps serving until they do.
+        Returns (tasks handed off, live tasks left to finish in place)."""
+        moved = 0
+        left = 0
+        with self._monitor_lock:
+            candidates = [n for n in active_nodes
+                          if n.node_id != node_id
+                          and not getattr(n, "draining", False)]
+            for stage in self.stages.values():
+                for idx in range(len(stage.tasks)):
+                    task = stage.tasks[idx]
+                    if task.node.node_id != node_id:
+                        continue
+                    info = task.poll_info() or task.info
+                    if info is not None and info.state in DONE_STATES:
+                        continue
+                    if candidates and self._recover_task(
+                            stage, idx, candidates, failure=None,
+                            retry_kind="drain"):
+                        moved += 1
+                    else:
+                        left += 1
+        return moved, left
 
     def _recover_task(self, stage: StageExecution, idx: int,
                       active_nodes: List[NodeInfo],
-                      failure: Optional[retry.ClusterExecutionError] = None
-                      ) -> bool:
+                      failure: Optional[retry.ClusterExecutionError] = None,
+                      retry_kind: str = "in-place-recovery") -> bool:
         """In-place recovery of one failed task — leaf OR interior, mid-stream
         included. The replacement re-derives its output deterministically
         (leaf fragments re-scan the connector; interior fragments re-pull
@@ -463,10 +521,13 @@ class SqlQueryScheduler:
             # (recovery resets nothing the failure reads); escalate to the
             # BOUNDED query-level retry instead
             return False
-        candidates = [n for n in active_nodes
-                      if n.node_id != old.node.node_id
-                      and n.node_id not in self.excluded_nodes] \
-            or [n for n in active_nodes if n.node_id != old.node.node_id]
+        # draining nodes never receive replacements: moving a task onto a
+        # node that is itself leaving would just re-run this recovery
+        healthy = [n for n in active_nodes
+                   if n.node_id != old.node.node_id
+                   and not getattr(n, "draining", False)]
+        candidates = [n for n in healthy
+                      if n.node_id not in self.excluded_nodes] or healthy
         if not candidates:
             return False
         node = min(candidates, key=NodeScheduler._bucket)
@@ -485,7 +546,7 @@ class SqlQueryScheduler:
         from ..utils import events
         events.emit("task.retry", severity=events.WARN,
                     query_id=self.query_id, task_id=new_task.task_id,
-                    retry_kind="in-place-recovery", failed_task=old.task_id,
+                    retry_kind=retry_kind, failed_task=old.task_id,
                     failed_node=old.node.node_id, new_node=node.node_id,
                     attempt=attempt)
         self.task_retries += 1
@@ -557,6 +618,10 @@ class SqlQueryScheduler:
         if not self.session.get("speculative_execution") \
                 or self.retry_policy != retry.TASK:
             return
+        with self._monitor_lock:
+            self._maybe_speculate_locked(active_nodes)
+
+    def _maybe_speculate_locked(self, active_nodes: List[NodeInfo]) -> None:
         self._resolve_speculations(active_nodes)
         min_wall = float(self.session.get("speculation_min_wall_s") or 5.0)
         multiplier = float(self.session.get("speculation_multiplier") or 2.0)
@@ -582,7 +647,8 @@ class SqlQueryScheduler:
                     continue
                 candidates = [n for n in active_nodes
                               if n.node_id != task.node.node_id
-                              and n.node_id not in self.excluded_nodes]
+                              and n.node_id not in self.excluded_nodes
+                              and not getattr(n, "draining", False)]
                 if not candidates:
                     continue
                 node = min(candidates, key=NodeScheduler._bucket)

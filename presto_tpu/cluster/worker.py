@@ -11,8 +11,15 @@ Analogue of the worker role of server/PrestoServer.java + server/TaskResource
          (binary body; X-Next-Token / X-Complete headers; ?wait= long-poll)
   DELETE /v1/task/{taskId}/results/{buf}           release the client buffer
   GET    /v1/status                                heartbeat + node info
-  PUT    /v1/info/state                            "SHUTTING_DOWN" drains
+                                                   (+ per-task drain progress)
+  GET    /v1/info/state                            drain-progress poll: state
+                                                   + active tasks + spool
+  PUT    /v1/info/state                            "DRAINING" (or the legacy
+                                                   "SHUTTING_DOWN") enters the
+                                                   drain machine
                                                    (GracefulShutdownHandler.java:43)
+
+Lifecycle: ACTIVE → DRAINING → DRAINED → SHUT_DOWN (see _TRANSITIONS).
 
 Control-plane bodies are structured JSON (cluster/codec.py allow-list codec —
 the reference uses JSON/SMILE on the same boundary,
@@ -36,7 +43,24 @@ from .task import (DONE_STATES, SourceUpdateRequest, TaskUpdateRequest,
                    WorkerTaskManager)
 
 ACTIVE = "ACTIVE"
+DRAINING = "DRAINING"
+DRAINED = "DRAINED"
+SHUT_DOWN = "SHUT_DOWN"
+# legacy protocol alias (GracefulShutdownHandler.java wire vocabulary): a
+# PUT of "SHUTTING_DOWN" enters the drain machine at DRAINING
 SHUTTING_DOWN = "SHUTTING_DOWN"
+
+# the drain state machine: ACTIVE → DRAINING → DRAINED → SHUT_DOWN.
+# DRAINING refuses new tasks but keeps serving live streams; DRAINED means
+# every task reached a DONE state (finished, or its consumers were handed to
+# replacements) and the node deregistered from discovery; SHUT_DOWN is the
+# terminal hard stop. Anything else is an illegal transition.
+_TRANSITIONS = {
+    ACTIVE: {DRAINING, SHUT_DOWN},
+    DRAINING: {DRAINED, SHUT_DOWN},
+    DRAINED: {SHUT_DOWN},
+    SHUT_DOWN: set(),
+}
 
 
 def default_catalogs() -> CatalogManager:
@@ -119,7 +143,9 @@ class _WorkerHandler(BaseHTTPRequestHandler):
             return self._send(b"not found", 404)
         if self._inject("worker.task_create", task_id=m.group(1)):
             return
-        if self.worker.state == SHUTTING_DOWN:
+        if self.worker.state != ACTIVE:
+            # draining/drained workers refuse placement; the scheduler
+            # treats this 503 as "exclude + re-place NOW", not a transient
             return self._send(b"shutting down", 503)
         length = int(self.headers.get("Content-Length", 0))
         body = self.rfile.read(length)
@@ -182,10 +208,30 @@ class _WorkerHandler(BaseHTTPRequestHandler):
             return self._send_codec(task.info())
         if path.rstrip("/") == "/v1/status":
             import json
+            # a status poll doubles as a drain tick: DRAINING → DRAINED the
+            # moment the last task reaches a DONE state, so pollers observe
+            # the transition deterministically (no monitor-thread race)
+            self.worker.maybe_complete_drain()
             active = 0
             query_mem = {}
             live_queries = set()
             spooled = 0
+            drain_tasks = {}
+            if self.worker.state != ACTIVE:
+                # per-task drain progress: everything still pinning the node
+                # — live tasks, plus DONE tasks whose streams consumers are
+                # still pulling
+                for tid, t in self.worker.tasks.tasks.items():
+                    served = t.output.output_drained()
+                    if t.state in DONE_STATES and served:
+                        continue
+                    drain_tasks[tid] = {
+                        "state": t.state,
+                        "spooledBytes": t.output.spooled_bytes(),
+                        "retainedBytes": t.output.retained_bytes(),
+                        "replayable": t.output.replayable_all(),
+                        "outputDrained": served,
+                    }
             for t in self.worker.tasks.tasks.values():
                 if t.state in DONE_STATES:
                     continue
@@ -239,7 +285,43 @@ class _WorkerHandler(BaseHTTPRequestHandler):
                 # acked-frame replay spool across live tasks (also counted
                 # inside queryMemory via the shared pool)
                 "spooledBytes": spooled,
+                # per-task drain progress (empty map when ACTIVE): what an
+                # operator watches while the node works toward DRAINED
+                "drain": drain_tasks,
                 "uptime": round(time.monotonic() - self.worker.start_mono, 1),
+            }).encode(), 200, [("Content-Type", "application/json")])
+        if path.rstrip("/") == "/v1/info/state":
+            # drain-progress poll (the PUT's read side): state + what still
+            # pins the node, without the /v1/status memory side channels
+            import json
+            self.worker.maybe_complete_drain()
+            active = 0
+            draining = 0
+            spooled = 0
+            tasks = {}
+            for tid, t in self.worker.tasks.tasks.items():
+                done = t.state in DONE_STATES
+                served = t.output.output_drained()
+                if done and served:
+                    continue
+                if not done:
+                    active += 1
+                draining += 1
+                spooled += t.output.spooled_bytes()
+                tasks[tid] = {
+                    "state": t.state,
+                    "spooledBytes": t.output.spooled_bytes(),
+                    "replayable": t.output.replayable_all(),
+                    "outputDrained": served,
+                }
+            return self._send(json.dumps({
+                "state": self.worker.state,
+                "activeTasks": active,
+                # tasks that would pin a drain: live ones plus DONE tasks
+                # whose streams consumers are still pulling
+                "drainingTasks": draining,
+                "spooledBytes": spooled,
+                "tasks": tasks,
             }).encode(), 200, [("Content-Type", "application/json")])
         if path.rstrip("/").startswith("/v1/metrics"):
             # same surface as the coordinator: flat JSON, ?raw=1 (the
@@ -280,13 +362,24 @@ class _WorkerHandler(BaseHTTPRequestHandler):
             return self._send(b"", 204)
         self._send(b"not found", 404)
 
-    def do_PUT(self) -> None:  # noqa: N802 — graceful shutdown
+    def do_PUT(self) -> None:  # noqa: N802 — graceful shutdown / drain
         if self.path.rstrip("/") == "/v1/info/state":
             length = int(self.headers.get("Content-Length", 0))
             state = self.rfile.read(length).decode().strip().strip('"')
-            if state == SHUTTING_DOWN:
-                self.worker.begin_shutdown()
-                return self._send(b"", 200)
+            if state in (DRAINING, SHUTTING_DOWN):
+                # SHUTTING_DOWN is the legacy wire alias: both enter the
+                # drain machine (idle workers reach DRAINED immediately)
+                try:
+                    reached = self.worker.begin_drain()
+                except ValueError as e:
+                    return self._send(str(e).encode(), 409)
+                return self._send(f'"{reached}"'.encode(), 200,
+                                  [("Content-Type", "application/json")])
+            if state in (ACTIVE, DRAINED, SHUT_DOWN):
+                # real states, but not externally settable: DRAINED is
+                # earned by finishing tasks, SHUT_DOWN by stop()
+                return self._send(
+                    f"cannot request transition to {state}".encode(), 409)
             return self._send(b"bad state", 400)
         self._send(b"not found", 404)
 
@@ -308,6 +401,8 @@ class WorkerServer:
         self.metadata = MetadataManager(catalogs)
         self.tasks = WorkerTaskManager(self.metadata)
         self.state = ACTIVE
+        self._state_lock = threading.RLock()
+        self._drain_stop = threading.Event()
         self.start_time = time.time()      # wall timestamp (diagnostics)
         self.start_mono = time.monotonic()  # uptime duration base
         handler = type("BoundWorkerHandler", (_WorkerHandler,), {"worker": self})
@@ -344,18 +439,100 @@ class WorkerServer:
             self._announcer.start()
         return self
 
-    def begin_shutdown(self) -> None:
-        """Drain: stop accepting tasks, stop announcing; the process exits when
-        active tasks finish (GracefulShutdownHandler semantics)."""
-        self.state = SHUTTING_DOWN
+    # ------------------------------------------------- drain state machine
+
+    def transition(self, new_state: str) -> bool:
+        """Move the node through ACTIVE → DRAINING → DRAINED → SHUT_DOWN.
+        Same-state is an idempotent no-op (False); anything not in the
+        transition map raises — an illegal transition is a caller bug, not
+        a race to paper over."""
+        with self._state_lock:
+            if new_state == self.state:
+                return False
+            if new_state not in _TRANSITIONS.get(self.state, set()):
+                raise ValueError(
+                    f"illegal worker state transition "
+                    f"{self.state} -> {new_state}")
+            self.state = new_state
+            return True
+
+    def begin_drain(self, reason: str = "") -> str:
+        """Enter DRAINING: refuse new tasks (503), pin every live task's
+        output spool so its replay window stays complete for the consumer
+        handoff, and watch for the last task to reach a DONE state. Returns
+        the state reached NOW — an idle worker completes its drain
+        synchronously and returns DRAINED. Idempotent while draining;
+        raises from DRAINED/SHUT_DOWN (nothing left to drain)."""
+        with self._state_lock:
+            if self.state == DRAINING:
+                return self.state
+            self.transition(DRAINING)
+        from ..utils import events
+        events.emit("worker.draining", severity=events.WARN,
+                    node=self.node_id, reason=reason,
+                    active_tasks=self.active_task_count())
+        for t in list(self.tasks.tasks.values()):
+            # pin every spool (done tasks may still be serving): an acked
+            # frame retired during the handoff window would turn a planned
+            # drain into a 410 escalation
+            t.output.pin_spool()
+        if not self.maybe_complete_drain():
+            self._drain_thread = threading.Thread(
+                target=self._drain_loop, name=f"drain-{self.node_id}",
+                daemon=True)
+            self._drain_thread.start()
+        return self.state
+
+    def maybe_complete_drain(self) -> bool:
+        """DRAINING → DRAINED when nothing pins the node: every task is in a
+        DONE state (finished, or aborted after its consumers were handed to
+        a replacement) AND its output streams are fully delivered — a
+        FINISHED task still serving spooled chunks to live consumers keeps
+        the node DRAINING until they catch up or are rewired elsewhere.
+        Called by the drain monitor AND by the status/state endpoints so
+        pollers never race the monitor thread."""
+        with self._state_lock:
+            if self.state != DRAINING or self.draining_task_count() > 0:
+                return False
+            self.transition(DRAINED)
+        # the node is out of work: deregister EXPLICITLY so the scheduler
+        # stops seeing it now, not a heartbeat-decay window later
         if self._announcer:
             self._announcer.stop()
+            self._announcer.deregister()
+        from ..utils import events
+        events.emit("worker.drained", severity=events.INFO,
+                    node=self.node_id)
+        return True
+
+    def _drain_loop(self) -> None:
+        while not self._drain_stop.wait(0.1):
+            if self.state != DRAINING or self.maybe_complete_drain():
+                return
+
+    def begin_shutdown(self) -> None:
+        """Legacy entry point (the old one-flag shutdown): now an alias that
+        enters the drain machine. The process exits when active tasks finish
+        (GracefulShutdownHandler semantics) — and, unlike the old flag, the
+        coordinator is TOLD when the node is out of work (deregister at
+        DRAINED) instead of discovering it by heartbeat decay."""
+        self.begin_drain(reason="begin_shutdown")
 
     def active_task_count(self) -> int:
         return sum(1 for t in self.tasks.tasks.values()
                    if t.state not in DONE_STATES)
 
+    def draining_task_count(self) -> int:
+        """Tasks that still pin a DRAINING node: live, or done but with
+        consumers mid-pull on their output streams."""
+        return sum(1 for t in self.tasks.tasks.values()
+                   if t.state not in DONE_STATES
+                   or not t.output.output_drained())
+
     def stop(self) -> None:
+        with self._state_lock:
+            self.state = SHUT_DOWN  # hard stop: bypasses transition checks
+        self._drain_stop.set()
         if self._announcer:
             self._announcer.stop()
         for t in list(self.tasks.tasks.values()):
@@ -365,6 +542,9 @@ class WorkerServer:
         serve = getattr(self, "_serve_thread", None)
         if serve is not None:
             serve.join(timeout=5.0)
+        drain = getattr(self, "_drain_thread", None)
+        if drain is not None:
+            drain.join(timeout=5.0)
 
 
 def main(argv=None) -> None:

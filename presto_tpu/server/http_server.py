@@ -20,6 +20,11 @@ Endpoints:
                                   summed, histogram buckets merged,
                                   percentiles re-derived)
   GET    /v1/events?query_id=&since=&kind=       structured event journal
+  POST   /v1/announcement         worker service announcement (cluster mode)
+  DELETE /v1/announcement/{id}    explicit worker deregister (a DRAINED
+                                  node leaves NOW, not at heartbeat decay)
+  PUT    /v1/cluster/drain/{id}   gracefully drain one worker (202; watch
+                                  node.draining/node.drained events)
 
 Run: python -m presto_tpu.server [--port 8080] [--distributed] [--schema sf1]
     [--event-log events.jsonl]
@@ -265,6 +270,47 @@ class _Handler(BaseHTTPRequestHandler):
             self.send_header("Content-Length", "0")
             self.end_headers()
             return
+        m = re.fullmatch(r"/v1/announcement/([^/]+)", self.path)
+        if m:
+            # explicit deregister: a DRAINED worker removes itself from
+            # discovery instead of lingering until heartbeat decay
+            nodes = getattr(self.manager.runner, "nodes", None)
+            if nodes is None:
+                return self._not_found()
+            nodes.remove(m.group(1))
+            self.send_response(204)
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+            return
+        self._not_found()
+
+    def do_PUT(self) -> None:  # noqa: N802 — cluster lifecycle operations
+        if self._authenticate() is None:
+            return
+        m = re.fullmatch(r"/v1/cluster/drain/([^/]+)", self.path)
+        if m:
+            # operator drain: kicks the graceful-removal sequence off in the
+            # background (a drain can outlive any sane HTTP timeout) — 202,
+            # then progress is observable via the worker's /v1/info/state
+            # and the node.draining/node.drained journal events
+            runner = self.manager.runner
+            node_id = m.group(1)
+            drain = getattr(runner, "drain_worker", None)
+            nodes = getattr(runner, "nodes", None)
+            if drain is None or nodes is None:
+                return self._not_found()
+            if nodes.get(node_id) is None:
+                return self._send_json(
+                    {"error": {"message": f"unknown worker {node_id}"}},
+                    status=404)
+            t = threading.Thread(
+                target=lambda: drain(node_id,
+                                     signal={"trigger": "operator"}),
+                name=f"drain-{node_id}", daemon=True)
+            # retained on the listener so stop() can join in-flight drains
+            self.server._drain_threads.append(t)
+            t.start()
+            return self._send_json({"draining": node_id}, status=202)
         self._not_found()
 
     def _cluster_metrics(self, qs: str) -> None:
@@ -380,6 +426,7 @@ class PrestoTpuServer:
                        {"manager": self.manager,
                         "authenticator": authenticator})
         self.httpd = ThreadingHTTPServer(("0.0.0.0", port), handler)
+        self.httpd._drain_threads = []  # in-flight operator drains
         self.port = self.httpd.server_address[1]
 
     def serve(self) -> None:
@@ -398,6 +445,8 @@ class PrestoTpuServer:
             # after the listener is down: no new submissions can race the
             # join — and a raising socket teardown must not skip it
             self.manager.close()
+            for t in self.httpd._drain_threads:
+                t.join(timeout=5.0)
 
 
 def main(argv=None) -> None:

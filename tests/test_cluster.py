@@ -250,14 +250,19 @@ def test_rest_protocol_over_cluster():
 
 
 def test_graceful_shutdown_drains():
+    import json as _json
     import urllib.request
     w = WorkerServer(port=0).start()
     try:
+        # legacy wire alias: PUT "SHUTTING_DOWN" enters the drain machine;
+        # an idle worker has nothing to hand off and reaches DRAINED
+        # immediately (the full machine lives in test_cluster_lifecycle.py)
         req = urllib.request.Request(f"{w.uri}/v1/info/state",
                                      data=b'"SHUTTING_DOWN"', method="PUT")
-        urllib.request.urlopen(req, timeout=5.0).read()
-        assert w.state == "SHUTTING_DOWN"
-        # a shutting-down worker refuses new tasks
+        body = urllib.request.urlopen(req, timeout=5.0).read()
+        assert _json.loads(body) == "DRAINED"
+        assert w.state == "DRAINED"
+        # a draining/drained worker refuses new tasks
         req = urllib.request.Request(f"{w.uri}/v1/task/t1", data=b"x",
                                      method="POST")
         with pytest.raises(urllib.error.HTTPError) as exc:

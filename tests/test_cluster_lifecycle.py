@@ -1,0 +1,306 @@
+"""Elastic cluster lifecycle: drain state machine, coordinator drain,
+autoscaler.
+
+The invariant under test throughout: a PLANNED membership change loses no
+queries. A drained worker refuses new work (503), keeps serving its live
+streams from pinned spools until consumers are handed to replacements via
+the exactly-once replay splice, deregisters at DRAINED — and the queries it
+was serving finish with `query_attempts == 1` and rows identical to the
+single-node engine."""
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import pytest
+
+from presto_tpu.cluster import faults
+from presto_tpu.cluster.autoscaler import WorkerPoolAutoscaler
+from presto_tpu.cluster.coordinator import ClusterQueryRunner
+from presto_tpu.cluster.worker import (ACTIVE, DRAINED, DRAINING, SHUT_DOWN,
+                                       WorkerServer)
+from presto_tpu.metadata import Session
+from presto_tpu.runner import LocalQueryRunner
+from presto_tpu.utils import events
+from presto_tpu.utils.events import JOURNAL
+from presto_tpu.utils.testing import assert_rows_equal
+
+
+@pytest.fixture(autouse=True)
+def _isolated_injector():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+# ---------------------------------------------------------------------------
+# drain state machine (worker-side)
+# ---------------------------------------------------------------------------
+
+def test_transition_map_rejects_illegal_moves():
+    w = WorkerServer(port=0)  # not started: the machine needs no sockets
+    assert w.state == ACTIVE
+    with pytest.raises(ValueError):
+        w.transition(DRAINED)          # must pass through DRAINING
+    assert w.transition(ACTIVE) is False   # same-state: idempotent no-op
+    assert w.transition(DRAINING) is True
+    with pytest.raises(ValueError):
+        w.transition(ACTIVE)           # drains never un-drain
+    assert w.transition(DRAINED) is True
+    with pytest.raises(ValueError):
+        w.transition(DRAINING)
+    assert w.transition(SHUT_DOWN) is True
+    with pytest.raises(ValueError):
+        w.transition(ACTIVE)           # SHUT_DOWN is terminal
+
+
+def test_idle_drain_completes_immediately_and_refuses_tasks():
+    w = WorkerServer(port=0).start()
+    try:
+        seq0 = JOURNAL.last_seq()
+        req = urllib.request.Request(f"{w.uri}/v1/info/state",
+                                     data=b'"DRAINING"', method="PUT")
+        body = urllib.request.urlopen(req, timeout=5.0).read()
+        # nothing to hand off: the PUT's reply already reports DRAINED
+        assert json.loads(body) == DRAINED
+        assert w.state == DRAINED
+        kinds = [e["kind"] for e in JOURNAL.events(since=seq0)]
+        assert "worker.draining" in kinds and "worker.drained" in kinds
+        # DRAINING/DRAINED workers refuse task creation with 503 — the
+        # scheduler reads that as "re-place, don't retry here"
+        req = urllib.request.Request(f"{w.uri}/v1/task/t1", data=b"x",
+                                     method="POST")
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(req, timeout=5.0)
+        assert exc.value.code == 503
+        assert b"shutting down" in exc.value.read()
+    finally:
+        w.stop()
+
+
+def test_info_state_endpoint_shape_and_transition_guards():
+    w = WorkerServer(port=0).start()
+    try:
+        with urllib.request.urlopen(f"{w.uri}/v1/info/state",
+                                    timeout=5.0) as r:
+            st = json.loads(r.read())
+        assert st == {"state": ACTIVE, "activeTasks": 0, "drainingTasks": 0,
+                      "spooledBytes": 0, "tasks": {}}
+        # ACTIVE is a real state but not externally settable
+        req = urllib.request.Request(f"{w.uri}/v1/info/state",
+                                     data=b'"ACTIVE"', method="PUT")
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(req, timeout=5.0)
+        assert exc.value.code == 409
+        req = urllib.request.Request(f"{w.uri}/v1/info/state",
+                                     data=b'"BOGUS"', method="PUT")
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(req, timeout=5.0)
+        assert exc.value.code == 400
+    finally:
+        w.stop()
+
+
+def test_drained_worker_deregisters_from_discovery():
+    """Satellite fix: shutdown used to never tell the coordinator — now a
+    worker that reaches DRAINED sends DELETE /v1/announcement/{id} and the
+    discovery entry disappears without waiting out the liveness expiry."""
+    from presto_tpu.server.http_server import PrestoTpuServer
+
+    runner = ClusterQueryRunner(
+        session=Session(catalog="tpch", schema="tiny"), min_workers=1,
+        worker_wait_s=15.0)
+    server = PrestoTpuServer(runner, port=0)
+    server.start()
+    w = WorkerServer(port=0,
+                     coordinator_uri=f"http://127.0.0.1:{server.port}"
+                     ).start()
+    try:
+        deadline = _Deadline(10.0)
+        while runner.nodes.get(w.node_id) is None:
+            deadline.tick("worker never announced")
+        w.begin_drain(reason="test")
+        assert w.state == DRAINED
+        deadline = _Deadline(10.0)
+        while runner.nodes.get(w.node_id) is not None:
+            deadline.tick("DRAINED worker never deregistered")
+    finally:
+        w.stop()
+        runner.detector.stop()
+        server.stop()
+
+
+class _Deadline:
+    def __init__(self, seconds):
+        import time
+        self._time = time
+        self.t_end = time.time() + seconds
+
+    def tick(self, msg):
+        assert self._time.time() < self.t_end, msg
+        self._time.sleep(0.05)
+
+
+# ---------------------------------------------------------------------------
+# coordinator drain (planned re-placement, zero queries lost)
+# ---------------------------------------------------------------------------
+
+class _Cluster:
+    def __init__(self, properties=None, n_workers=2):
+        session = Session(catalog="tpch", schema="tiny",
+                          properties=dict(properties or {}))
+        self.runner = ClusterQueryRunner(session=session,
+                                         min_workers=n_workers,
+                                         worker_wait_s=10.0)
+        self.workers = [WorkerServer(port=0).start()
+                        for _ in range(n_workers)]
+        self._stop = threading.Event()
+        for w in self.workers:
+            self.runner.nodes.announce(w.node_id, w.uri)
+        threading.Thread(target=self._keep_alive, daemon=True).start()
+
+    def _keep_alive(self):
+        # announce ACTIVE and DRAINING workers (a draining node still
+        # serves streams); never a DRAINED one — the coordinator removed it
+        while not self._stop.wait(0.5):
+            for w in list(self.workers):
+                if w.state in (ACTIVE, DRAINING):
+                    self.runner.nodes.announce(w.node_id, w.uri)
+
+    def close(self):
+        self._stop.set()
+        self.runner.detector.stop()
+        for w in self.workers:
+            w.stop()
+
+
+def test_drain_worker_idle_cluster_emits_events_and_removes_node():
+    cluster = _Cluster()
+    victim = cluster.workers[0]
+    try:
+        seq0 = JOURNAL.last_seq()
+        out = cluster.runner.drain_worker(
+            victim.node_id, signal={"trigger": "test", "reason": "idle"})
+        assert out["drained"] and out["state"] == DRAINED
+        assert out["tasks_handed_off"] == 0
+        assert cluster.runner.nodes.get(victim.node_id) is None
+        assert [n.node_id for n in cluster.runner.nodes.schedulable_nodes()] \
+            == [cluster.workers[1].node_id]
+        draining = JOURNAL.events(since=seq0, kind="node.draining")
+        drained = JOURNAL.events(since=seq0, kind="node.drained")
+        assert draining and draining[0]["signal"]["trigger"] == "test"
+        assert drained and drained[0]["node"] == victim.node_id
+        with pytest.raises(ValueError):
+            cluster.runner.drain_worker("no-such-node")
+    finally:
+        cluster.close()
+
+
+def test_drain_hands_off_live_interior_tasks_mid_stream(local_runner=None):
+    """The tentpole path: drain a worker while a consumer is mid-stream on
+    its output (chunk 0 delivered AND acked). The handoff must splice the
+    replacement in exactly-once — rows identical, no query-level retry —
+    and journal the re-placement as task.retry with retry_kind='drain'."""
+    from presto_tpu.cluster.scheduler import _remote_source_ids
+
+    sql = ("select l_suppkey, count(*), sum(l_quantity) "
+           "from lineitem group by l_suppkey")
+    want = LocalQueryRunner(
+        session=Session(catalog="tpch", schema="tiny")).execute(sql)
+    cluster = _Cluster(properties={"retry_policy": "TASK",
+                                   "exchange_flush_rows": 256,
+                                   "retry_initial_delay_s": 0.01,
+                                   "retry_max_delay_s": 0.05})
+    victim = min(cluster.workers, key=lambda w: w.node_id)
+    try:
+        sub = cluster.runner.plan_sql(sql)
+        leaf = next(f.id for f in sub.fragments
+                    if not _remote_source_ids(f.root)
+                    and f.id != sub.root_fragment.id)
+        mid_stream = threading.Event()
+
+        def observe(ctx):
+            # fires in the victim's handler thread once a consumer asks for
+            # token >= 1 of its leaf stream: chunk 0 was delivered and
+            # acked, so the drain handoff below MUST replay mid-stream.
+            # Observes only — raises nothing.
+            token = int(ctx["path"].partition("?")[0]
+                        .rstrip("/").rsplit("/", 1)[-1])
+            if token >= 1:
+                mid_stream.set()
+
+        inj = faults.FaultInjector(seed=31)
+        inj.add("worker.results", faults.CALLBACK, node_id=victim.node_id,
+                task_re=rf"\.{leaf}\.0$", times=None, callback=observe)
+        faults.install(inj)
+
+        seq0 = JOURNAL.last_seq()
+        holder = {}
+        qt = threading.Thread(
+            target=lambda: holder.update(res=cluster.runner.execute(sql)))
+        qt.start()
+        assert mid_stream.wait(30.0), "query never went mid-stream"
+        out = cluster.runner.drain_worker(
+            victim.node_id, signal={"trigger": "test-mid-stream"})
+        qt.join(60.0)
+        res = holder["res"]
+
+        assert out["drained"] and out["tasks_handed_off"] >= 1, out
+        assert victim.state == DRAINED
+        assert_rows_equal(res.rows, want.rows, ordered=False)
+        # zero queries lost means zero query-LEVEL retries: the drain is a
+        # task-scope handoff, not a failure
+        assert res.stats["query_attempts"] == 1, res.stats
+        assert res.stats["task_retries"] >= 1, res.stats
+        retries = JOURNAL.events(since=seq0, kind="task.retry")
+        assert retries and all(e["retry_kind"] == "drain" for e in retries)
+    finally:
+        cluster.close()
+
+
+# ---------------------------------------------------------------------------
+# autoscaler (scale-up on pressure, scale-down only through drain)
+# ---------------------------------------------------------------------------
+
+def test_autoscaler_scales_up_on_queue_depth_and_down_through_drain():
+    cluster = _Cluster(n_workers=1)
+    scaler = WorkerPoolAutoscaler(
+        cluster.runner,
+        spawn_worker=lambda: WorkerServer(port=0).start(),
+        min_workers=1, max_workers=2, idle_polls_down=2)
+    scaler.adopt(cluster.workers[0])
+    spawned = []
+    try:
+        seq0 = JOURNAL.last_seq()
+        # pressure: an admission-queue event since the last poll
+        events.emit("query.queued", severity=events.INFO,
+                    query_id="q-test", queue_depth=3)
+        assert scaler.poll_once() == "scale_up"
+        assert len(scaler.managed) == 2
+        spawned = [h for nid, h in scaler.managed.items()
+                   if nid != cluster.workers[0].node_id]
+        assert cluster.runner.nodes.get(spawned[0].node_id) is not None
+        ups = JOURNAL.events(since=seq0, kind="autoscaler.scale_up")
+        assert ups and ups[0]["signal"]["queue_depth"] == 3
+
+        # quiet polls: shrink — but ONLY via the drain path
+        seq1 = JOURNAL.last_seq()
+        actions = [scaler.poll_once() for _ in range(3)]
+        assert "scale_down" in actions
+        assert len(scaler.managed) == 1
+        downs = JOURNAL.events(since=seq1, kind="autoscaler.scale_down")
+        assert downs
+        draining = JOURNAL.events(since=seq1, kind="node.draining")
+        assert draining and \
+            draining[0]["signal"]["trigger"] == "autoscaler.scale_down"
+        assert JOURNAL.events(since=seq1, kind="node.drained")
+        # the victim was drained then stopped, never killed mid-serve
+        victim = [h for h in [cluster.workers[0]] + spawned
+                  if h.node_id == downs[0]["node"]][0]
+        assert victim.state == SHUT_DOWN
+        assert cluster.runner.nodes.get(victim.node_id) is None
+    finally:
+        scaler.stop()
+        for h in spawned:
+            h.stop()
+        cluster.close()

@@ -301,6 +301,34 @@ def test_task_policy_replaces_node_rejecting_creates(local_runner):
     assert inj.rules[0].fired >= 3  # at least one full backoff budget
 
 
+def test_draining_worker_503_excludes_node_without_burning_backoff(
+        local_runner):
+    """Regression (elastic lifecycle): a worker the scheduler doesn't yet
+    know is draining answers task create with a REAL 503 "shutting down".
+    That answer is definitive — the node must be excluded and the task
+    re-placed on the survivor immediately, not hammered through the
+    Backoff budget like a transient 5xx, and the query must finish on its
+    first attempt."""
+    cluster = _Cluster(properties={"retry_policy": "TASK",
+                                   "retry_initial_delay_s": 0.01,
+                                   "retry_max_delay_s": 0.02})
+    victim = cluster.workers[0]
+    # drain WORKER-side only: discovery keeps the node schedulable, so the
+    # scheduler walks into the 503 (the late-drain race the fast-path covers)
+    victim.begin_drain(reason="test")
+    assert victim.state == "DRAINED"  # idle: drained immediately, still up
+    try:
+        got = cluster.runner.execute(AGG_SQL)
+    finally:
+        cluster.close()
+    want = local_runner.execute(AGG_SQL)
+    assert_rows_equal(got.rows, want.rows, ordered=False)
+    assert got.stats["query_attempts"] == 1, "re-placement, not query retry"
+    # the drained worker never hosted a task — every placement that hit it
+    # bounced with 503 and landed on the survivor
+    assert not victim.tasks.tasks
+
+
 def test_create_backoff_budget_honored_then_fail_fast():
     cluster = _Cluster(properties={"remote_task_error_budget_s": 0.0,
                                    "retry_initial_delay_s": 0.01,
