@@ -1289,6 +1289,36 @@ def cpu_baseline_rows_per_sec(sample_rows: int = 2_000_000) -> float:
     return n / dt
 
 
+# (env var, presto_tpu.utils module, result-blob key, what it would skew)
+_SANITIZERS = (
+    ("PRESTO_TPU_LOCKSAN", "locksan", "locksan",
+     "instrumented locks would skew every number"),
+    ("PRESTO_TPU_LEAKSAN", "leaksan", "leaksan",
+     "instrumented lifecycles would skew the numbers"),
+    ("PRESTO_TPU_COMPILESAN", "compilesan", "compilesan",
+     "per-build key tracking would skew compile-path timings"),
+)
+
+
+def _strip_sanitizer_env():
+    """Never benchmark instrumented code: a stray sanitizer env var from a
+    debugging run would silently tax the hot path in the numbers. Strip
+    each env (subprocess rungs inherit it), uninstall if the import hook
+    already fired, and RECORD the off state in the result blob."""
+    import importlib
+
+    for env, mod_name, key, why in _SANITIZERS:
+        if os.environ.pop(env, None):
+            print(f"bench: {env} was set — sanitizer disabled for "
+                  f"benchmarking ({why})", file=sys.stderr)
+            try:
+                mod = importlib.import_module(f"presto_tpu.utils.{mod_name}")
+                mod.uninstall()
+            except Exception:  # noqa: BLE001 - presto_tpu not imported yet: env strip suffices
+                pass
+        DETAIL[key] = False
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--sf", type=float, default=10.0)
@@ -1302,33 +1332,7 @@ def main():
     args = ap.parse_args()
     sf = 1.0 if args.quick else args.sf
 
-    # never benchmark instrumented locks: a stray PRESTO_TPU_LOCKSAN from a
-    # sanitizer run would silently tax every lock acquisition in the numbers.
-    # Strip the env (subprocess rungs inherit it), uninstall if the import
-    # hook already fired, and RECORD the off state in the result blob.
-    if os.environ.pop("PRESTO_TPU_LOCKSAN", None):
-        print("bench: PRESTO_TPU_LOCKSAN was set — sanitizer disabled for "
-              "benchmarking (instrumented locks would skew every number)",
-              file=sys.stderr)
-        try:
-            from presto_tpu.utils import locksan
-            locksan.uninstall()
-        except Exception:  # noqa: BLE001 - presto_tpu not imported yet: env strip suffices
-            pass
-    DETAIL["locksan"] = False
-
-    # same hygiene for the leak sanitizer: its method wrappers tax every
-    # reservation/spill call on the hot path — never benchmark them
-    if os.environ.pop("PRESTO_TPU_LEAKSAN", None):
-        print("bench: PRESTO_TPU_LEAKSAN was set — leak sanitizer disabled "
-              "for benchmarking (instrumented lifecycles would skew the "
-              "numbers)", file=sys.stderr)
-        try:
-            from presto_tpu.utils import leaksan
-            leaksan.uninstall()
-        except Exception:  # noqa: BLE001 - presto_tpu not imported yet: env strip suffices
-            pass
-    DETAIL["leaksan"] = False
+    _strip_sanitizer_env()
 
     if args.platform:
         os.environ["JAX_PLATFORMS"] = args.platform

@@ -82,4 +82,13 @@ from .utils import leaksan as _leaksan  # noqa: E402
 
 _leaksan.install_from_env()
 
+# Runtime recompile sanitizer: PRESTO_TPU_COMPILESAN=1 wraps the kernel-cache
+# compile funnel (fused segments, exchange programs, every cached jit
+# closure) with per-call-site distinct-key tracking; a site compiling past
+# its pow2-shape-bucket budget becomes a compile-storm finding. Installed
+# with leaksan's timing: nothing compiles before the first query.
+from .utils import compilesan as _compilesan  # noqa: E402
+
+_compilesan.install_from_env()
+
 __version__ = "0.1.0"

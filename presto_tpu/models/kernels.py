@@ -39,6 +39,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..utils import kernel_cache
 from ..utils.batching import take_rows
 
 # 1998-12-01 minus 90 days, as days since epoch (the Q1 shipdate cutoff)
@@ -214,7 +215,9 @@ def q1_stream(sf: float, seconds_budget: float = 60.0,
     for t in threads:
         t.start()
 
-    step = jax.jit(q1_lane_step, donate_argnums=(7,))
+    step = kernel_cache.get_or_install(
+        ("q1-lane-step", "donate"),
+        lambda: jax.jit(q1_lane_step, donate_argnums=(7,)))
     acc = jnp.zeros((_N_SEG, _L), dtype=jnp.float64)
 
     pend: list = []           # leftover numpy chunks, re-batched to batch_rows
@@ -308,7 +311,8 @@ def q1_resident(sf: float, batch_rows: int = 1 << 22, runs: int = 10):
     args = tuple(jax.device_put(a, dev) for a in args)
     jax.block_until_ready(args)
 
-    step = jax.jit(q1_lane_step)
+    step = kernel_cache.get_or_install(
+        ("q1-lane-step", "plain"), lambda: jax.jit(q1_lane_step))
     acc = jnp.zeros((_N_SEG, _L), dtype=jnp.float64)
     acc = step(*args, acc)
     jax.block_until_ready(acc)          # compile + one warm batch

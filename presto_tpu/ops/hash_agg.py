@@ -48,7 +48,7 @@ from .operator import Operator, OperatorContext, OperatorFactory, timed
 from .sorting import lexsort_fast
 
 
-def _builder_key(tag: str, b, page: "Page" = None, input_dicts=None) -> tuple:
+def _builder_key(tag, b, page: "Page" = None, input_dicts=None) -> tuple:
     """Kernel-cache identity of a builder's static config: everything its
     jitted kernel reads from `self` (channels, call fingerprints, domains)
     PLUS the input page's dictionary versions — _call_contributions embeds
@@ -603,7 +603,7 @@ class GroupedAggregationBuilder:
     def _install_hash_kernel(self, page: Page, slots: int) -> None:
         if self._hash_kernel is None:
             self._hash_kernel = kernel_cache.get_or_install(
-                _builder_key(f"pallas-hash-{slots}", self, page),
+                _builder_key(("pallas-hash", slots), self, page),
                 lambda: jax.jit(self._page_hash_partial,
                                 static_argnames=("slots",)))
 

@@ -1023,7 +1023,10 @@ class LookupJoinOperator(Operator):
                      tuple(f.filter_build_channels)),
                     lambda: jax.jit(cfg.chunk))
             else:
-                self.f._semi_kernel = jax.jit(cfg.chunk)
+                # no planner fingerprint for the ad-hoc filter fn: a
+                # per-factory compile IS the contract here (the kernel is
+                # memoized on the factory and reused across its chunks)
+                self.f._semi_kernel = jax.jit(cfg.chunk)  # prestocheck: ignore[cache-key-hygiene]
         for c in range(max(0, -(-total // cap))):
             any_match = self.f._semi_kernel(
                 page, tuple(probe_keys), lo, offsets, src.sorted_row,

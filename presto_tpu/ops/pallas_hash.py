@@ -255,7 +255,10 @@ def probe_table(slot_keys: jnp.ndarray, slot_rows: jnp.ndarray,
     signature stays small); masked rows never match."""
     keys = keys.astype(jnp.int64)
     n = keys.shape[0]
-    return pl.pallas_call(
+    # traceable helper: only ever invoked inside the module-level-jitted
+    # probe_match_pallas wrapper (ops/hash_join.py), so the fresh
+    # pallas_call identity is cached by the outer trace, not re-dispatched
+    return pl.pallas_call(  # prestocheck: ignore[cache-key-hygiene]
         _probe_body(slot_keys.shape[0], trips),
         out_shape=jax.ShapeDtypeStruct((n,), jnp.int32),
         interpret=interpret_mode(),

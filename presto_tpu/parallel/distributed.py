@@ -25,6 +25,7 @@ from jax.sharding import PartitionSpec as P
 from .mesh import shard_map
 
 from ..ops.hash_agg import sort_group_reduce
+from ..utils import kernel_cache
 from .exchange import repartition
 from .mesh import WORKER_AXIS, MeshContext
 
@@ -46,9 +47,13 @@ def dist_q1_step(mesh_ctx: MeshContext, n_flags: int = 3, n_status: int = 2):
 
     mesh = mesh_ctx.mesh
     sharded = P(WORKER_AXIS)
-    return jax.jit(shard_map(stage, mesh=mesh,
-                             in_specs=(sharded,) * 8,
-                             out_specs=(P(),) * 6))
+    # per-(mesh, group-domain) program: rebuilding the stage for every query
+    # submission was a fresh jit identity (a silent recompile) per call
+    return kernel_cache.get_or_install(
+        ("dist-q1", mesh, n_flags, n_status),
+        lambda: jax.jit(shard_map(stage, mesh=mesh,
+                                  in_specs=(sharded,) * 8,
+                                  out_specs=(P(),) * 6)))
 
 
 def dist_join_agg_step(mesh_ctx: MeshContext, probe_cap_per_peer: int):
@@ -90,8 +95,10 @@ def dist_join_agg_step(mesh_ctx: MeshContext, probe_cap_per_peer: int):
 
     mesh = mesh_ctx.mesh
     s = P(WORKER_AXIS)
-    return jax.jit(shard_map(stage, mesh=mesh, in_specs=(s,) * 6,
-                             out_specs=(P(), P(), P())))
+    return kernel_cache.get_or_install(
+        ("dist-join-agg", mesh, probe_cap_per_peer),
+        lambda: jax.jit(shard_map(stage, mesh=mesh, in_specs=(s,) * 6,
+                                  out_specs=(P(), P(), P()))))
 
 
 def dist_grouped_agg_step(mesh_ctx: MeshContext, n_keys: int, n_states: int,
@@ -126,5 +133,8 @@ def dist_grouped_agg_step(mesh_ctx: MeshContext, n_keys: int, n_states: int,
     s = P(WORKER_AXIS)
     n_in = n_keys + n_states + 1
     n_out = n_keys + n_states + 2
-    return jax.jit(shard_map(stage, mesh=mesh, in_specs=(s,) * n_in,
-                             out_specs=(s,) * (n_out - 1) + (P(),)))
+    return kernel_cache.get_or_install(
+        ("dist-grouped-agg", mesh, n_keys, n_states, tuple(kinds),
+         tuple(identities), max_groups),
+        lambda: jax.jit(shard_map(stage, mesh=mesh, in_specs=(s,) * n_in,
+                                  out_specs=(s,) * (n_out - 1) + (P(),))))

@@ -1,0 +1,235 @@
+"""Runtime recompile sanitizer (presto_tpu/utils/compilesan.py).
+
+Unit tests drive the census directly (pow2 bucketing, the compile-storm
+verdict, budget overrides, dump shape); the install tests wrap the real
+kernel-cache funnel; the reconciliation test is the sanitizer's ground
+truth — its per-family build totals must agree with the engine's OWN
+compile counters (fused-segment compiles, exchange collective_compiles,
+kernel-cache misses) on a real distributed Q3, with rows identical to a
+sanitizer-off run.
+"""
+import json
+import os
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from presto_tpu.utils import compilesan, kernel_cache  # noqa: E402
+from presto_tpu.utils.compilesan import SANITIZER, pow2_bucket  # noqa: E402
+from presto_tpu.utils.metrics import METRICS  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _clean_sanitizer():
+    """Each test gets a fresh census and leaves the funnel unpatched —
+    a leaked install would silently tax every later test's compiles."""
+    prior = SANITIZER.findings()
+    SANITIZER.reset()
+    yield
+    compilesan.uninstall()
+    SANITIZER.reset()
+    SANITIZER.absorb(prior)
+
+
+def _note(key):
+    SANITIZER.note_build(key)
+
+
+def _feed(key):
+    """Two helper frames between test and note_build so every test build
+    is charged to ONE stable site (the `_note` call line below) no matter
+    which test line issued it — the per-site census is the unit under
+    test, not stack attribution."""
+    _note(key)
+
+
+def _only_site():
+    sites = SANITIZER.site_stats()
+    assert len(sites) == 1, sites
+    return next(iter(sites))
+
+
+# ------------------------------------------------------------- canonical form
+
+def test_pow2_bucket():
+    assert [pow2_bucket(n) for n in (0, 1, 2, 3, 64, 100, 1 << 20)] == \
+        [0, 1, 2, 4, 64, 128, 1 << 20]
+
+
+def test_canonical_buckets_only_shape_scale_ints():
+    # small discrete domains (channel indices, worker counts) are identity;
+    # shape-scale ints collapse to their pow2 bucket, recursively
+    assert compilesan._canonical(("k", 3, 100, (65, True))) == \
+        ("k", 3, 128, (128, True))
+    # bool is not an int bucket, and unhashables fall back to repr
+    assert compilesan._canonical((True, [1, 2])) == (True, "[1, 2]")
+
+
+# ------------------------------------------------------------- storm verdict
+
+def test_storm_when_one_signature_absorbs_data_tracking_keys():
+    # three exact row counts in one pow2 bucket: the classic per-page storm
+    for n in (100, 101, 102):
+        _feed(("kern", n))
+    findings = SANITIZER.findings()
+    assert len(findings) == 1, findings
+    f = findings[0]
+    assert f["kind"] == "compile-storm"
+    assert "3 distinct 'kern' kernels" in f["message"]
+    assert f["site"].startswith("tests/test_compilesan.py:")
+
+
+def test_no_storm_for_distinct_discrete_domains():
+    # three channel indices are three legitimately distinct kernels
+    for n in (1, 2, 3):
+        _feed(("kern", n))
+    # and three distinct pow2 capacities are three distinct shapes
+    for n in (128, 256, 512):
+        _feed(("cap", n))
+    assert SANITIZER.findings() == []
+    assert SANITIZER.total_builds() == 6
+
+
+def test_two_keys_sharing_a_bucket_is_not_yet_a_storm():
+    # two literals colliding in one bucket is coincidence (_STORM_MULT=3)
+    for n in (100, 120):
+        _feed(("kern", n))
+    SANITIZER.check_exit()
+    assert SANITIZER.findings() == []
+
+
+def test_budget_extra_raises_one_site_above_the_bucket_default():
+    for n in (100, 101):
+        _feed(("kern", n))
+    SANITIZER.set_budget_extra(_only_site(), 2)
+    _feed(("kern", 102))  # keys=3, budget=1+2=3: not exceeded
+    SANITIZER.check_exit()
+    assert SANITIZER.findings() == []
+    _feed(("kern", 103))  # keys=4 > 3 and mult=4 >= 3: storm
+    assert len(SANITIZER.findings()) == 1
+
+
+def test_rebuild_of_the_same_key_is_not_a_distinct_key():
+    for _ in range(5):
+        _feed(("kern", 128))
+    stats = SANITIZER.site_stats()[_only_site()]
+    assert stats["builds"] == 5 and stats["distinct_keys"] == 1
+    assert SANITIZER.findings() == []
+
+
+def test_dump_shape_and_absorb(tmp_path):
+    for n in (100, 101, 102):
+        _feed(("kern", n))
+    path = SANITIZER.dump(str(tmp_path / "dump.json"))
+    doc = json.load(open(path))
+    assert doc["total_builds"] == 3
+    assert set(doc["families"]) == {"fused-segment", "exchange", "other"}
+    assert doc["families"]["other"] == 3
+    (site,) = doc["sites"]
+    assert site["distinct_keys"] == 3 and site["budget"] == 1
+    assert len(doc["findings"]) == 1
+    kept = SANITIZER.findings()
+    SANITIZER.reset()
+    assert SANITIZER.findings() == []
+    SANITIZER.absorb(kept)
+    assert SANITIZER.findings() == kept
+
+
+# ---------------------------------------------------------------- the funnel
+
+def test_install_observes_builds_not_hits():
+    compilesan.install()
+    key = ("compilesan-test", 128)
+    kernel_cache.get_or_build(key, lambda: "kernel")
+    kernel_cache.get_or_build(key, lambda: "kernel")  # hit: not charged
+    assert SANITIZER.total_builds() == 1
+    stats = SANITIZER.site_stats()
+    (site,) = stats
+    # the funnel's own frame is elided: the site is THIS test, not
+    # kernel_cache.py
+    assert site.startswith("tests/test_compilesan.py:"), stats
+    assert stats[site]["prefix"] == "compilesan-test"
+    gauges = METRICS.snapshot("compilesan")
+    assert gauges["compilesan.builds"] == 1
+    assert gauges["compilesan.storm_sites"] == 0
+
+
+def test_uninstall_restores_the_raw_funnel():
+    compilesan.install()
+    raw = kernel_cache.get_or_build
+    compilesan.uninstall()
+    assert kernel_cache.get_or_build is not raw
+    kernel_cache.get_or_build(("compilesan-test", 256), lambda: "kernel")
+    assert SANITIZER.total_builds() == 0
+    assert not compilesan.enabled()
+
+
+def test_install_from_env(monkeypatch):
+    monkeypatch.setenv("PRESTO_TPU_COMPILESAN", "0")
+    assert not compilesan.install_from_env()
+    monkeypatch.setenv("PRESTO_TPU_COMPILESAN", "1")
+    assert compilesan.install_from_env()
+    assert compilesan.enabled()
+
+
+# ----------------------------------------------------- counter reconciliation
+
+def test_compile_reconciliation_distributed_q3(eight_devices):
+    """Satellite gate: the sanitizer's family totals are not a parallel
+    bookkeeping universe — on a cold distributed Q3 they must EQUAL the
+    engine's own counters (fused-segment compiles, the exchange books'
+    collective_compiles, the kernel-cache misses that built), and the
+    instrumented run must be row-identical to the sanitizer-off run."""
+    from presto_tpu.metadata import Session
+    from presto_tpu.models.tpch_sql import QUERIES
+    from presto_tpu.parallel.mesh import MeshContext
+    from presto_tpu.parallel.runner import DistributedQueryRunner
+
+    assert len(eight_devices) >= 2, eight_devices
+    mesh = MeshContext(eight_devices[:2])
+
+    def run_q3():
+        return DistributedQueryRunner(
+            mesh, session=Session(catalog="tpch", schema="tiny",
+                                  properties={"exchange_chunk_rows": 256})
+        ).execute(QUERIES[3])
+
+    off = run_q3()  # sanitizer off: the oracle rows
+
+    compilesan.install()
+    SANITIZER.reset()
+    kernel_cache.clear()  # force real builds inside the sanitized window
+    misses0 = METRICS.counter_value("kernel_cache.misses")
+    seg0 = METRICS.counter_value("segments.compiles")
+
+    on = run_q3()
+
+    assert on.rows == off.rows, "sanitizer changed query results"
+    fam = SANITIZER.family_totals()
+    total = SANITIZER.total_builds()
+    assert total > 0, "cold run compiled nothing — funnel not observed"
+    # every family total reconciles against the engine's own counter
+    assert total == METRICS.counter_value("kernel_cache.misses") - misses0
+    assert fam["fused-segment"] == \
+        METRICS.counter_value("segments.compiles") - seg0
+    ex = (on.stats or {}).get("exchange", {})
+    assert fam["exchange"] == ex.get("collective_compiles", 0), (fam, ex)
+    SANITIZER.assert_clean()
+
+    # per-query stats reconciliation runs on the LOCAL engine: the
+    # distributed aggregation reports per-worker operator stats, not the
+    # coordinator-side funnel view the sanitizer observes
+    from presto_tpu.runner import LocalQueryRunner
+
+    SANITIZER.reset()
+    kernel_cache.clear()
+    lr = LocalQueryRunner(
+        session=Session(catalog="tpch", schema="tiny")).execute(QUERIES[3])
+    fam = SANITIZER.family_totals()
+    seg_stats = (lr.stats or {}).get("segments") or {"compiles": 0}
+    assert fam["fused-segment"] == seg_stats["compiles"], (fam, seg_stats)
+    assert fam["fused-segment"] > 0, "local Q3 fused no segment?"
+    SANITIZER.assert_clean()

@@ -25,7 +25,8 @@ EXPECTED_PASSES = {"undefined-name", "tracer-safety", "lock-discipline",
                    "unbounded-cache", "wallclock-duration",
                    "shared-state-race", "thread-lifecycle",
                    "print-hygiene", "tempfile-hygiene",
-                   "resource-discipline", "close-propagation"}
+                   "resource-discipline", "close-propagation",
+                   "retrace-risk", "cache-key-hygiene"}
 
 
 def _scan(tmp_path, source, select=None, name="mod.py"):
@@ -1692,6 +1693,11 @@ def test_cli_list_passes_json_and_exit_codes(tmp_path):
         [sys.executable, "-m", "tools.prestocheck", "--select", "nope"],
         capture_output=True, text=True, cwd=REPO, env=env)
     assert unknown.returncode == 2
+    # fail fast AND name the valid ids — "see --list-passes" alone was a
+    # second round trip for every typo
+    assert "valid pass ids:" in unknown.stderr
+    assert "cache-key-hygiene" in unknown.stderr
+    assert "retrace-risk" in unknown.stderr
 
     # a nonexistent path must be a hard error, not a silent 0-file pass
     nopath = subprocess.run(
@@ -1870,3 +1876,211 @@ def test_cli_sarif_round_trips_with_json(tmp_path):
                    phys["region"]["startColumn"],
                    r["message"]["text"]))
     assert skeys == jkeys and len(skeys) == 2
+
+
+# --------------------------------------------------------------- retrace-risk
+
+def test_retrace_risk_flags_data_derived_static_args(tmp_path):
+    msgs = _messages(_scan(tmp_path, """
+        import jax
+        import functools
+
+        def kernel(x, n):
+            return x
+
+        step = jax.jit(kernel, static_argnames=("n",))
+
+        @functools.partial(jax.jit, static_argnums=(1,))
+        def kern2(x, trips):
+            return x
+
+        def run(page):
+            return step(page.data, n=len(page.rows))
+
+        def probe(arr):
+            return kern2(arr, trips=int(arr.max()))
+        """, select=["retrace-risk"]))
+    assert len(msgs) == 2, msgs
+    assert any("`n`" in m and "len(...)" in m for m in msgs)
+    assert any("`trips`" in m and "int(...)" in m for m in msgs)
+
+
+def test_retrace_risk_canonicalized_and_bounded_are_clean(tmp_path):
+    assert _scan(tmp_path, """
+        import jax
+
+        def kernel(x, n):
+            return x
+
+        step = jax.jit(kernel, static_argnames=("n",))
+
+        def run(page, _pow2):
+            return step(page.data, n=_pow2(len(page.rows)))
+
+        def run2(page):
+            return step(page.data, n=clamp_capacity(page.rows.shape[0], 64))
+
+        def run3(page):
+            return step(page.data, n=8)
+        """, select=["retrace-risk"]) == []
+
+
+def test_retrace_risk_sees_kernel_cache_bindings(tmp_path):
+    msgs = _messages(_scan(tmp_path, """
+        import jax
+        from utils import kernel_cache as kc
+
+        def body(x, slots):
+            return x
+
+        class Op:
+            def install(self):
+                self._k = kc.get_or_install(
+                    ("op", 1),
+                    lambda: jax.jit(body, static_argnames=("slots",)))
+
+            def run(self, x):
+                return self._k(x, slots=x.shape[0])
+        """, select=["retrace-risk"]))
+    assert len(msgs) == 1 and ".shape" in msgs[0], msgs
+
+
+def test_retrace_risk_unbounded_domain_and_suppression(tmp_path):
+    src = """
+        import jax
+
+        def kernel(x, tag):
+            return x
+
+        step = jax.jit(kernel, static_argnames=("tag",))
+
+        def run(x, name):
+            return step(x, tag=f"v-{name}")
+
+        def run2(x, a, b):
+            return step(x, tag=a / b)  # prestocheck: ignore[retrace-risk]
+        """
+    msgs = _messages(_scan(tmp_path, src, select=["retrace-risk"]))
+    assert len(msgs) == 1 and "f-string" in msgs[0], msgs
+
+
+# ----------------------------------------------------------- cache-key-hygiene
+
+def test_cache_key_hygiene_flags_jit_built_outside_funnel(tmp_path):
+    msgs = _messages(_scan(tmp_path, """
+        import jax
+        from jax.experimental import pallas as pl
+
+        def hot(fn, x):
+            step = jax.jit(fn)
+            return step(x)
+
+        def hot_pallas(body, shape, x):
+            return pl.pallas_call(body, out_shape=shape)(x)
+        """, select=["cache-key-hygiene"]))
+    assert len(msgs) == 2, msgs
+    assert any("jax.jit callable built inside `hot`" in m for m in msgs)
+    assert any("pl.pallas_call callable built inside `hot_pallas`" in m
+               for m in msgs)
+
+
+def test_cache_key_hygiene_funnel_lru_and_module_scope_are_clean(tmp_path):
+    assert _scan(tmp_path, """
+        import functools
+        import jax
+        from utils.kernel_cache import get_or_build, get_or_install
+
+        def body(x):
+            return x
+
+        step = jax.jit(body)                      # module scope: once ever
+
+        def cached(fn, x):
+            k, _ = get_or_build(("k", 1), lambda: jax.jit(fn))
+            return k(x)
+
+        def _build_program(fn):
+            return jax.jit(fn)                    # builder passed to funnel
+
+        def install(fn):
+            return get_or_install(("p", 2), lambda: _build_program(fn))
+
+        @functools.lru_cache(maxsize=8)
+        def make_step(n):
+            return jax.jit(lambda x: x + n)       # memoized factory
+        """, select=["cache-key-hygiene"]) == []
+
+
+def test_cache_key_hygiene_audits_key_components(tmp_path):
+    msgs = _messages(_scan(tmp_path, """
+        import time
+        from utils.kernel_cache import get_or_build
+
+        def install(page, make):
+            key = ("k", f"v{page.n}", float(page.x), [1, 2],
+                   id(page), time.time(), len(page.rows))
+            return get_or_build(key, make)
+        """, select=["cache-key-hygiene"]))
+    assert len(msgs) == 6, msgs
+    for needle in ("f-string", "float()", "unhashable", "id(...)",
+                   "`time.time()`", "raw len(...)"):
+        assert any(needle in m for m in msgs), (needle, msgs)
+
+
+def test_cache_key_hygiene_canonicalized_key_and_helper_returns(tmp_path):
+    msgs = _messages(_scan(tmp_path, """
+        from utils.kernel_cache import get_or_build
+
+        def _mk_key(page):
+            return ("k", f"layout-{page.n}")
+
+        def install_bad(page, make):
+            return get_or_build(_mk_key(page), make)
+
+        def install_ok(page, make, _pow2):
+            key = ("k", _pow2(len(page.rows)), page.data.shape)
+            return get_or_build(key, make)
+        """, select=["cache-key-hygiene"]))
+    # the helper's f-string return is found; the pow2-canonicalized key
+    # vouches for its len/.shape components
+    assert len(msgs) == 1 and "f-string" in msgs[0], msgs
+
+
+def test_cache_key_hygiene_suppression(tmp_path):
+    assert _scan(tmp_path, """
+        import jax
+
+        def fallback(fn, x):
+            step = jax.jit(fn)  # prestocheck: ignore[cache-key-hygiene]
+            return step(x)
+        """, select=["cache-key-hygiene"]) == []
+
+
+# ------------------------------------------- --changed-only / --format compose
+
+def test_changed_only_composes_with_sarif(tmp_path, monkeypatch, capsys):
+    """Regression: --changed-only must compose with --format sarif — both
+    when changed files have findings and when the changed set is empty
+    (an empty run is still a well-formed SARIF document)."""
+    import tools.prestocheck.__main__ as cli
+
+    bad = tmp_path / "bad.py"
+    bad.write_text("def f(xs=[]):\n    return unknown_name\n")
+
+    monkeypatch.setattr(cli, "git_changed_files", lambda: [str(bad)])
+    rc = cli.main(["--changed-only", "--format", "sarif", str(tmp_path)])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    (run_,) = doc["runs"]
+    assert {r["ruleId"] for r in run_["results"]} == \
+        {"mutable-default-args", "undefined-name"}
+    assert all(r["baselineState"] == "new" for r in run_["results"])
+
+    monkeypatch.setattr(cli, "git_changed_files", lambda: [])
+    rc = cli.main(["--changed-only", "--format", "sarif", str(tmp_path)])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    (run_,) = doc["runs"]
+    assert run_["results"] == []
+    rules = {r["id"] for r in run_["tool"]["driver"]["rules"]}
+    assert EXPECTED_PASSES <= rules

@@ -107,6 +107,12 @@ def main(argv: Optional[List[str]] = None) -> int:
                              "runtime residue findings onto the static "
                              "resource-discipline acquire sites and "
                              "report the static pass's blind spots")
+    parser.add_argument("--compile-diff", metavar="DUMP_JSON",
+                        help="map a compilesan SANITIZER.dump() file's "
+                             "compile-storm findings and per-site build "
+                             "census onto the static jit/pallas/funnel "
+                             "compile sites and report the retrace-risk/"
+                             "cache-key-hygiene passes' blind spots")
     args = parser.parse_args(argv)
     if args.as_json and args.format is None:
         args.format = "json"
@@ -190,6 +196,40 @@ def main(argv: Optional[List[str]] = None) -> int:
         # informational (exit 0): like the lock-graph diff, the output's
         # job is to turn runtime residue into static-pass fixtures
         return 0
+    if args.compile_diff:
+        from .compilediff import diff_dump_path as compile_diff_dump_path
+
+        try:
+            diff = compile_diff_dump_path(args.compile_diff, paths)
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"prestocheck: cannot read compile dump: {e}",
+                  file=sys.stderr)
+            return 2
+        if args.as_json:
+            print(json.dumps(diff, indent=1))
+        else:
+            for m in diff["matched"]:
+                print(f"storm confirmed by both halves: [{m['kind']}] "
+                      f"{m['compile_site']} at {m['frame']}")
+            for m in diff["missing"]:
+                print(f"storm the static passes judged clean: "
+                      f"[{m['kind']}] {m['compile_site']} at {m['frame']} "
+                      f"— candidate fixture")
+            for u in diff["unmapped"]:
+                print(f"unmapped storm: [{u['kind']}] at {u['site']}")
+        attr = diff["site_attribution"]
+        print(f"prestocheck: compile diff — "
+              f"{diff['runtime_findings']} runtime finding(s), "
+              f"{len(diff['matched'])} matched, "
+              f"{len(diff['missing'])} missing, "
+              f"{len(diff['unmapped'])} unmapped; "
+              f"{attr['mapped']}/{attr['mapped'] + attr['unmapped']} "
+              f"runtime sites attributed "
+              f"({diff['compile_sites']} static compile sites)",
+              file=sys.stderr)
+        # informational (exit 0): the diff turns runtime compile evidence
+        # into static-pass fixtures, it does not gate CI itself
+        return 0
     if args.changed_only and args.update_baseline:
         # the update would rewrite the baseline from only the changed files,
         # silently dropping every unchanged file's grandfathered entries
@@ -214,15 +254,23 @@ def main(argv: Optional[List[str]] = None) -> int:
             if args.as_json:
                 print(json.dumps({"files": 0, "new": [], "baselined": [],
                                   "pass_wall_s": {}}, indent=1))
+            elif args.format == "sarif":
+                # an empty run is still a well-formed SARIF document — a
+                # code-scanning consumer fed "" instead would error out
+                print(json.dumps(to_sarif([]), indent=1))
             return 0
     select = ([s.strip() for s in args.select.split(",") if s.strip()]
               if args.select else None)
     try:
         passes_ok = select is None or all(s in all_pass_ids() for s in select)
         if not passes_ok:
+            # fail fast AND name the valid ids: "see --list-passes" alone
+            # sends the user on a second round trip to learn what a typo'd
+            # pass should have been called
             bad = [s for s in select if s not in all_pass_ids()]
-            print(f"unknown pass id(s): {', '.join(bad)} "
-                  f"(see --list-passes)", file=sys.stderr)
+            known = ", ".join(sorted(all_pass_ids()))
+            print(f"unknown pass id(s): {', '.join(bad)}; "
+                  f"valid pass ids: {known}", file=sys.stderr)
             return 2
         if args.update_baseline:
             modules = load_modules(paths)

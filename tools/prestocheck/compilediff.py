@@ -1,0 +1,159 @@
+"""Runtime -> static compile diff.
+
+The runtime recompile sanitizer (presto_tpu/utils/compilesan.py) records
+every kernel-cache build with the REAL call stack and flags compile storms
+— sites whose distinct-key census outruns their pow2-shape-bucket budget.
+The static ``cache-key-hygiene`` / ``retrace-risk`` passes reason about
+the same compile discipline from the AST. This module closes the loop:
+
+    python -m tools.prestocheck --compile-diff dump.json [paths...]
+
+where ``dump.json`` is :meth:`CompileSanitizer.dump` output. Every runtime
+storm finding's stack is resolved against an AST scan for compile sites
+(``jax.jit(...)`` / ``pl.pallas_call(...)`` constructions and
+``get_or_build`` / ``get_or_install`` funnel calls):
+
+- **matched**: the storm's site is a known compile site AND one of the
+  static passes also flags that file — the two halves agree; fix the key.
+- **missing**: the storm maps to a known compile site the static passes
+  judged clean — a static blind spot (a key component whose cardinality
+  only runtime can see); each one is a candidate fixture for the passes.
+- **unmapped**: no stack frame resolves to a known compile site (the
+  build was issued outside the scanned roots).
+
+Beyond findings, every runtime SITE in the dump is attributed the same
+way (``site_attribution``), so a zero-finding run still proves the static
+site registry covers the funnel's real callers.
+
+Informational, exit 0 — like ``--leak-diff``, the diff's job is to turn
+runtime evidence into static-pass fixtures, not to gate CI itself.
+"""
+from __future__ import annotations
+
+import ast
+import json
+import os
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from .core import Module, load_modules
+from .passes.cache_key_hygiene import (CacheKeyHygienePass, _creates_jit,
+                                       _is_funnel_call)
+from .passes.retrace_risk import RetraceRiskPass
+
+
+class _SiteMap:
+    """(relpath, lineno) -> compile-site label for every construction."""
+
+    def __init__(self):
+        # path -> [(lo_line, hi_line, site label)]
+        self.ranges: Dict[str, List[Tuple[int, int, str]]] = {}
+
+    def add(self, path: str, lo: int, hi: int, label: str) -> None:
+        self.ranges.setdefault(path, []).append((lo, hi, label))
+
+    def resolve_site(self, site: str) -> Optional[str]:
+        """'presto_tpu/ops/hash_agg.py:605' -> site label, or None."""
+        path, _, lineno = site.rpartition(":")
+        try:
+            line = int(lineno)
+        except ValueError:
+            return None
+        for lo, hi, label in self.ranges.get(path.replace(os.sep, "/"), ()):
+            if lo <= line <= hi:
+                return label
+        return None
+
+
+def _scan_compile_sites(modules: Sequence[Module]) -> _SiteMap:
+    """Map every statement that builds a compiled callable — a jit/pallas
+    construction or a kernel-cache funnel call (where compilesan stacks
+    actually land, since the sanitizer filters kernel_cache.py frames) —
+    to a site label."""
+    from .core import REPO_ROOT
+
+    smap = _SiteMap()
+    for module in modules:
+        if module.tree is None:
+            continue
+        rel = os.path.relpath(os.path.abspath(module.path), REPO_ROOT)
+        rel = rel.replace(os.sep, "/")
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if _is_funnel_call(node):
+                smap.add(rel, node.lineno,
+                         getattr(node, "end_lineno", node.lineno),
+                         "funnel:get_or_build")
+            else:
+                kind = _creates_jit(node)
+                if kind is not None:
+                    smap.add(rel, node.lineno,
+                             getattr(node, "end_lineno", node.lineno),
+                             kind)
+    return smap
+
+
+def diff_dump(dump: dict, paths: Sequence[str]) -> dict:
+    """Compare a compilesan SANITIZER.dump() document against the static
+    retrace-risk + cache-key-hygiene analysis over `paths`.
+
+    -> {"runtime_findings", "compile_sites", "site_attribution",
+        "matched": [...], "missing": [...], "unmapped": [...]} where
+    `missing` lists storms whose compile site the static passes considered
+    clean (their blind spots — candidate fixtures) and `unmapped` lists
+    findings no stack frame could be attributed."""
+    from .core import REPO_ROOT
+
+    modules = load_modules(paths)
+    smap = _scan_compile_sites(modules)
+    static_files = set()
+    for p in (RetraceRiskPass(), CacheKeyHygienePass()):
+        for m in modules:
+            for f in p.check_module(m) or ():
+                static_files.add(os.path.relpath(
+                    os.path.abspath(f.file), REPO_ROOT).replace(os.sep, "/"))
+
+    def attribute(frames: Sequence[str]) -> Optional[Tuple[str, str]]:
+        for frame in frames:
+            label = smap.resolve_site(frame)
+            if label is not None:
+                return frame, label
+        return None
+
+    matched: List[dict] = []
+    missing: List[dict] = []
+    unmapped: List[dict] = []
+    findings = dump.get("findings", [])
+    for f in findings:
+        frames = [f.get("site", "")] + list(f.get("stack", []))
+        hit = attribute(frames)
+        if hit is None:
+            unmapped.append({"kind": f.get("kind", ""),
+                             "site": f.get("site", ""),
+                             "stack": list(f.get("stack", []))})
+            continue
+        frame, label = hit
+        entry = {"kind": f.get("kind", ""), "frame": frame,
+                 "compile_site": label, "message": f.get("message", "")}
+        if frame.rpartition(":")[0] in static_files:
+            matched.append(entry)
+        else:
+            missing.append(entry)
+
+    # attribute every runtime site too: a clean run still proves coverage
+    attribution = {"mapped": 0, "unmapped": 0}
+    for s in dump.get("sites", []):
+        frames = [s.get("site", "")] + list(s.get("stack", []))
+        attribution["mapped" if attribute(frames) else "unmapped"] += 1
+
+    return {"runtime_findings": len(findings),
+            "compile_sites": sum(len(v) for v in smap.ranges.values()),
+            "site_attribution": attribution,
+            "matched": matched,
+            "missing": missing,
+            "unmapped": unmapped}
+
+
+def diff_dump_path(dump_path: str, paths: Sequence[str]) -> dict:
+    with open(dump_path, "r", encoding="utf-8") as f:
+        return diff_dump(json.load(f), paths)
